@@ -1,0 +1,376 @@
+"""Autograd: imperative tape + reverse-mode backward.
+
+TPU-native analogue of the reference's autograd
+(``src/imperative/imperative.cc`` ``RecordOp``/``Backward``, the nnvm
+``Gradient`` pass, and ``python/mxnet/autograd.py`` [unverified]).
+
+Design: while ``record()`` is active, every imperative op invocation whose
+inputs connect to a gradient-requiring leaf is executed through ``jax.vjp``,
+which returns the primal outputs plus a VJP closure holding residuals on
+device. Tape nodes link VJP closures through their input NDArrays (the
+``AGInfo`` analogue). ``backward()`` topologically sorts reachable nodes and
+pulls cotangents backwards, accumulating into leaf ``.grad`` buffers honoring
+``grad_req`` in {'write', 'add', 'null'}.
+
+Because residuals are captured at call time, later in-place mutation of an
+input cannot corrupt gradients — the role the reference's engine version
+counters played is filled by functional capture.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "record",
+    "pause",
+    "train_mode",
+    "predict_mode",
+    "is_recording",
+    "is_training",
+    "set_recording",
+    "set_training",
+    "backward",
+    "grad",
+    "mark_variables",
+    "Function",
+]
+
+_STATE = threading.local()
+
+
+def _st():
+    if not hasattr(_STATE, "recording"):
+        _STATE.recording = False
+        _STATE.training = False
+    return _STATE
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+def set_recording(flag: bool) -> bool:
+    st = _st()
+    prev, st.recording = st.recording, bool(flag)
+    return prev
+
+
+def set_training(flag: bool) -> bool:
+    st = _st()
+    prev, st.training = st.training, bool(flag)
+    return prev
+
+
+@contextlib.contextmanager
+def _scope(recording: Optional[bool], training: Optional[bool]):
+    st = _st()
+    prev_r, prev_t = st.recording, st.training
+    if recording is not None:
+        st.recording = recording
+    if training is not None:
+        st.training = training
+    try:
+        yield
+    finally:
+        st.recording, st.training = prev_r, prev_t
+
+
+def record(train_mode: bool = True):
+    """Scope in which imperative ops are recorded for backward()."""
+    return _scope(True, train_mode)
+
+
+def pause(train_mode: bool = False):
+    return _scope(False, train_mode)
+
+
+def train_mode():
+    return _scope(None, True)
+
+
+def predict_mode():
+    return _scope(None, False)
+
+
+# --------------------------------------------------------------------- tape
+class _Node:
+    """One recorded invocation (reference: autograd tape node / AGInfo)."""
+
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_out", "freed")
+
+    def __init__(self, vjp_fn, inputs, out_avals, multi_out):
+        self.vjp_fn = vjp_fn
+        self.inputs = inputs  # list of (NDArray | None) — None for untracked
+        self.out_avals = out_avals  # [(shape, dtype)] per output
+        self.multi_out = multi_out
+        self.freed = False
+
+
+class _AGInfo:
+    __slots__ = ("node", "index")
+
+    def __init__(self, node: Optional[_Node], index: int = 0):
+        self.node = node
+        self.index = index
+
+
+def _attach_grad(arr: NDArray, grad_req: str = "write"):
+    """Mark ``arr`` as a gradient-requiring leaf (reference: attach_grad)."""
+    if grad_req not in ("write", "add", "null"):
+        raise MXNetError(f"invalid grad_req {grad_req!r}")
+    arr._grad_req = grad_req
+    if grad_req != "null":
+        arr._grad = NDArray(jnp.zeros_like(arr.data))
+    else:
+        arr._grad = None
+    arr._ag = _AGInfo(None)  # leaf marker
+
+
+def _is_tracked(arr) -> bool:
+    return isinstance(arr, NDArray) and arr._ag is not None
+
+
+def _should_record(args) -> bool:
+    return is_recording() and any(_is_tracked(a) for a in args)
+
+
+def _record(fn: Callable, args, datas):
+    """Execute ``fn`` under jax.vjp and build a tape node."""
+    outs, vjp_fn = jax.vjp(fn, *datas)
+    multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if multi else (outs,)
+    avals = [(o.shape, o.dtype) for o in outs_t]
+    inputs = [a if _is_tracked(a) else None for a in args]
+    node = _Node(vjp_fn, inputs, avals, multi)
+    return outs, node
+
+
+def _mark_output(nd: NDArray, node: _Node, index: int):
+    nd._ag = _AGInfo(node, index)
+
+
+# ----------------------------------------------------------------- backward
+def backward(
+    heads: Sequence[NDArray],
+    head_grads: Optional[Sequence[Optional[NDArray]]] = None,
+    retain_graph: bool = False,
+    train_mode: bool = True,
+):
+    """Reverse pass from ``heads`` (reference: ``Imperative::Backward``)."""
+    heads = list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    if len(head_grads) != len(heads):
+        raise MXNetError("head_grads length mismatch")
+
+    # output cotangent accumulator keyed by (id(node), out_index)
+    cotangents = {}
+    # leaf cotangent accumulator keyed by id(leaf NDArray)
+    leaf_cts = {}
+    leaves = {}
+
+    roots = []
+    for h, hg in zip(heads, head_grads):
+        if h._ag is None:
+            raise MXNetError(
+                "cannot differentiate: output is not connected to any "
+                "variable created under autograd.record() with attach_grad()"
+            )
+        g = hg.data if isinstance(hg, NDArray) else (hg if hg is not None else jnp.ones_like(h.data))
+        node = h._ag.node
+        if node is None:  # head IS a leaf variable
+            leaf_cts.setdefault(id(h), []).append(g)
+            leaves[id(h)] = h
+            continue
+        key = (id(node), h._ag.index)
+        cotangents.setdefault(key, []).append(g)
+        roots.append(node)
+
+    order = _toposort(roots)
+
+    node_by_id = {id(n): n for n in order}
+    for node in order:  # already reverse topological
+        outs = []
+        any_ct = False
+        for i, (shape, dtype) in enumerate(node.out_avals):
+            cts = cotangents.pop((id(node), i), None)
+            if cts:
+                any_ct = True
+                ct = cts[0]
+                for extra in cts[1:]:
+                    ct = ct + extra
+            else:
+                ct = jnp.zeros(shape, dtype)
+            outs.append(ct)
+        if not any_ct:
+            continue
+        if node.freed:
+            raise MXNetError(
+                "graph already freed: call backward(retain_graph=True) to "
+                "backprop through the same graph twice"
+            )
+        ct_arg = tuple(outs) if node.multi_out else outs[0]
+        in_cts = node.vjp_fn(ct_arg)
+        if not retain_graph:
+            node.vjp_fn = None
+            node.freed = True
+        for arr, ict in zip(node.inputs, in_cts):
+            if arr is None or ict is None:
+                continue
+            if hasattr(ict, "dtype") and ict.dtype == jax.dtypes.float0:
+                continue
+            sub = arr._ag.node
+            if sub is None:
+                leaf_cts.setdefault(id(arr), []).append(ict)
+                leaves[id(arr)] = arr
+            else:
+                cotangents.setdefault((id(sub), arr._ag.index), []).append(ict)
+
+    # write leaf grads honoring grad_req
+    for lid, cts in leaf_cts.items():
+        leaf = leaves[lid]
+        total = cts[0]
+        for extra in cts[1:]:
+            total = total + extra
+        req = leaf._grad_req
+        if req == "null":
+            continue
+        if leaf._grad is None:
+            leaf._grad = NDArray(jnp.zeros_like(leaf.data))
+        if req == "write":
+            leaf._grad._rebind(total.astype(leaf.data.dtype))
+        elif req == "add":
+            leaf._grad._rebind(leaf._grad.data + total.astype(leaf.data.dtype))
+
+
+def _toposort(roots: List[_Node]) -> List[_Node]:
+    """Reverse-topological order (outputs first) over the tape DAG."""
+    visited = set()
+    post = []
+    # iterative DFS to survive deep chains (RNN tapes)
+    for root in roots:
+        if id(root) in visited:
+            continue
+        stack = [(root, iter(_parents(root)))]
+        visited.add(id(root))
+        while stack:
+            node, it = stack[-1]
+            advanced = False
+            for p in it:
+                if id(p) not in visited:
+                    visited.add(id(p))
+                    stack.append((p, iter(_parents(p))))
+                    advanced = True
+                    break
+            if not advanced:
+                post.append(node)
+                stack.pop()
+    post.reverse()  # outputs first
+    return post
+
+
+def _parents(node: _Node):
+    for arr in node.inputs:
+        if arr is not None and arr._ag is not None and arr._ag.node is not None:
+            yield arr._ag.node
+
+
+# ------------------------------------------------------------------ helpers
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Reference: ``autograd.mark_variables``."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._grad_req = req
+        v._grad = g
+        v._ag = _AGInfo(None)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None, create_graph=False,
+         train_mode=True):
+    """Compute and RETURN grads of heads wrt variables (reference API)."""
+    if create_graph:
+        raise MXNetError("create_graph=True (higher order) not supported yet")
+    variables = list(variables)
+    saved = [(v._grad, v._grad_req, v._ag) for v in variables]
+    for v in variables:
+        if v._ag is None:
+            raise MXNetError("variables must be tracked (attach_grad or used "
+                             "as recorded outputs)")
+        v._grad = NDArray(jnp.zeros_like(v.data))
+        if v._grad_req == "null":
+            v._grad_req = "write"
+    heads = [heads] if isinstance(heads, NDArray) else list(heads)
+    backward(heads, head_grads, retain_graph=bool(retain_graph))
+    outs = [v._grad for v in variables]
+    for v, (g, req, ag) in zip(variables, saved):
+        v._grad, v._grad_req = g, req
+    return outs
+
+
+def get_symbol(x):  # legacy API stub
+    raise MXNetError("the symbolic tape export has no TPU-native equivalent; "
+                     "use HybridBlock.export instead")
+
+
+class Function:
+    """Custom differentiable function (reference: ``autograd.Function``).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def backward(self, *output_grads):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        with pause():
+            outputs = self.forward(*inputs)
+        if not is_recording() or not any(_is_tracked(i) for i in inputs):
+            return outputs
+        multi = isinstance(outputs, (tuple, list))
+        outs_t = tuple(outputs) if multi else (outputs,)
+
+        func = self
+
+        def vjp_fn(cts):
+            cts_t = cts if isinstance(cts, (tuple, list)) else (cts,)
+            with pause():
+                in_grads = func.backward(*[NDArray(c) for c in cts_t])
+            in_grads_t = in_grads if isinstance(in_grads, (tuple, list)) else (in_grads,)
+            return tuple(
+                g.data if isinstance(g, NDArray) else g for g in in_grads_t
+            )
+
+        avals = [(o.data.shape, o.data.dtype) for o in outs_t]
+        node = _Node(vjp_fn, [a if _is_tracked(a) else None for a in inputs],
+                     avals, multi)
+        for i, o in enumerate(outs_t):
+            _mark_output(o, node, i)
+        return outputs

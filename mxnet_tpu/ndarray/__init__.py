@@ -1,0 +1,120 @@
+"""``mx.nd``: the imperative NDArray namespace.
+
+Reference: ``python/mxnet/ndarray/`` [unverified] — NDArray class plus op
+functions generated from the registry at import time, with creation ops and
+the ``random`` sub-namespace defined natively.
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .ndarray import NDArray, array, empty, from_jax, waitall, _unwrap
+from ..context import Context, current_context
+from .. import ops as _ops  # ensure registry is populated
+from . import register as _register
+from . import random_ops as random  # mx.nd.random
+
+_DEFAULT = jnp.float32
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype) if dtype is not None else _DEFAULT
+
+
+# ----------------------------------------------------------------- creation
+def zeros(shape, ctx=None, dtype=None, **kw) -> NDArray:
+    return NDArray(jnp.zeros(shape, _dt(dtype)), ctx=ctx)
+
+
+def ones(shape, ctx=None, dtype=None, **kw) -> NDArray:
+    return NDArray(jnp.ones(shape, _dt(dtype)), ctx=ctx)
+
+
+def full(shape, val, ctx=None, dtype=None, **kw) -> NDArray:
+    return NDArray(jnp.full(shape, val, _dt(dtype)), ctx=ctx)
+
+
+def zeros_like(data, **kw) -> NDArray:
+    return NDArray(jnp.zeros_like(_unwrap(data)))
+
+
+def ones_like(data, **kw) -> NDArray:
+    return NDArray(jnp.ones_like(_unwrap(data)))
+
+
+def full_like(data, fill_value, **kw) -> NDArray:
+    return NDArray(jnp.full_like(_unwrap(data), fill_value))
+
+
+def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None, **kw) -> NDArray:
+    out = jnp.arange(start, stop, step, _dt(dtype))
+    if repeat > 1:
+        out = jnp.repeat(out, repeat)
+    return NDArray(out, ctx=ctx)
+
+
+def linspace(start, stop, num, endpoint=True, ctx=None, dtype=None, **kw) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, endpoint=endpoint, dtype=_dt(dtype)), ctx=ctx)
+
+
+# --------------------------------------------------------------- conversion
+def save(fname: str, data):
+    """Save NDArrays (reference: ``mx.nd.save`` binary format; here .npz)."""
+    from ..util import save_ndarrays
+
+    save_ndarrays(fname, data)
+
+
+def load(fname: str):
+    from ..util import load_ndarrays
+
+    return load_ndarrays(fname)
+
+
+def concatenate(arrays, axis=0, always_copy=True) -> NDArray:
+    from ..imperative import invoke_fn
+
+    return invoke_fn(lambda *xs: jnp.concatenate(xs, axis=axis), *arrays)
+
+
+def add_n(*args, **kw) -> NDArray:
+    from ..imperative import invoke_fn
+
+    return invoke_fn(lambda *xs: sum(xs[1:], xs[0]), *args)
+
+
+ElementWiseSum = add_n
+
+
+def moveaxis(data, source, destination) -> NDArray:
+    from ..imperative import invoke_fn
+
+    return invoke_fn(lambda d: jnp.moveaxis(d, source, destination), data)
+
+
+def batch_take(a, indices) -> NDArray:
+    from ..imperative import invoke_fn
+
+    return invoke_fn(
+        lambda d, i: jnp.take_along_axis(d, i.astype(jnp.int32)[:, None], axis=1)[:, 0],
+        a, indices,
+    )
+
+
+def true_divide(lhs, rhs):
+    return lhs / rhs
+
+
+def waitall_():  # legacy alias
+    waitall()
+
+
+# generated op functions (mx.nd.dot, mx.nd.Convolution, ...)
+_register.populate_module(sys.modules[__name__], namespace="nd")
+
+from . import sparse  # noqa: E402  (facade; row_sparse/csr)

@@ -1,0 +1,683 @@
+"""NDArray: a mutable, asynchronous n-dimensional array over ``jax.Array``.
+
+TPU-native analogue of the reference's ``NDArray``
+(``src/ndarray/ndarray.cc``, ``include/mxnet/ndarray.h`` [unverified]).
+
+The reference NDArray is a *mutable* buffer with in-place ops, storage-sharing
+views, and engine-managed async readiness. ``jax.Array`` is immutable and
+functional. The bridge (the "mutability shim", SURVEY.md section 7):
+
+- Each root NDArray owns a ``_Chunk`` holding the current ``jax.Array`` plus a
+  version counter. In-place ops REBIND the chunk to a new functional value
+  (copy-on-write at the XLA level; buffer reuse comes from XLA donation on the
+  jitted paths, mirroring the reference's ``static_alloc``).
+- Views (``Slice``/``Reshape`` in the reference share storage) hold a parent
+  reference plus an index/shape. Reads recompute lazily from the parent (and
+  are cached against the root chunk's version); writes propagate back through
+  the parent chain via lazy scatter (``.at[idx].set``), so aliasing semantics
+  match the reference: writing through a view is visible in the base and in
+  sibling views. Under ``autograd.record()`` slicing/reshaping of tracked
+  arrays instead dispatches as a recorded op (no aliasing), matching the
+  reference's restriction on differentiating through in-place writes.
+- Asynchrony: jax dispatch is async by nature; ``wait_to_read`` blocks like
+  the reference's ``Engine::WaitForVar``, ``asnumpy()`` is the sync point.
+
+Autograd state (``_ag``) is attached by ``mxnet_tpu.autograd`` — the analogue
+of the per-entry ``AGInfo`` in ``src/imperative/imperative.cc`` [unverified].
+"""
+
+from __future__ import annotations
+
+import functools
+import operator
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..engine import engine
+
+__all__ = ["NDArray", "array", "empty", "from_jax", "waitall"]
+
+_DEFAULT_DTYPE = jnp.float32
+
+
+class _Chunk:
+    """Rebindable storage cell (reference: ``NDArray::Chunk``)."""
+
+    __slots__ = ("data", "version")
+
+    def __init__(self, data: jax.Array):
+        self.data = data
+        self.version = 0
+
+    def rebind(self, data: jax.Array):
+        self.data = data
+        self.version += 1
+
+
+class _View:
+    """View descriptor: how to derive this array from its parent."""
+
+    __slots__ = ("parent", "kind", "index", "shape")
+
+    def __init__(self, parent: "NDArray", kind: str, index=None, shape=None):
+        self.parent = parent
+        self.kind = kind  # 'slice' | 'reshape'
+        self.index = index
+        self.shape = shape
+
+
+def _unwrap(x):
+    return x.data if isinstance(x, NDArray) else x
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, NDArray):
+        d = idx.data
+        return d.astype(jnp.int32) if jnp.issubdtype(d.dtype, jnp.floating) else d
+    if isinstance(idx, list):
+        return jnp.asarray(idx)
+    return idx
+
+
+def _invoke(fn, *args, **static):
+    from ..imperative import invoke_fn
+
+    return invoke_fn(fn, *args, **static)
+
+
+def _recording_tracked(arr) -> bool:
+    from .. import autograd
+
+    return autograd.is_recording() and autograd._is_tracked(arr)
+
+
+def _check_inplace_ok(arr):
+    """In-place mutation of an array participating in a recorded graph would
+    silently desync the tape's captured residuals from the visible value, so
+    raise like the reference does (version-counter check in the engine)."""
+    if _recording_tracked(arr):
+        from ..base import MXNetError
+
+        raise MXNetError(
+            "in-place operations on arrays that are part of a recorded "
+            "computation are not supported inside autograd.record(); use "
+            "functional ops or mutate outside the record scope"
+        )
+
+
+class NDArray:
+    """Mutable array handle. See module docstring for the storage model."""
+
+    __array_priority__ = 1000.0
+
+    __slots__ = (
+        "_chunk",
+        "_view",
+        "_root",
+        "_cache",
+        "_cache_version",
+        "_ag",
+        "_grad",
+        "_grad_req",
+        "__weakref__",
+    )
+
+    # ------------------------------------------------------------------ init
+    def __init__(self, data, ctx: Optional[Context] = None, _view: Optional[_View] = None):
+        self._view = _view
+        self._cache = None
+        self._cache_version = -1
+        self._ag = None
+        self._grad = None
+        self._grad_req = "null"
+        if _view is not None:
+            self._chunk = None
+            self._root = _view.parent._root_array()
+        else:
+            if not isinstance(data, jax.Array):
+                data = jnp.asarray(data)
+            if ctx is not None:
+                data = jax.device_put(data, ctx.jax_device())
+            self._chunk = _Chunk(data)
+            self._root = None
+
+    def _root_array(self) -> "NDArray":
+        return self._root if self._root is not None else self
+
+    # ------------------------------------------------------------ data cell
+    @property
+    def data(self) -> jax.Array:
+        """Current functional value of this array (lazy for views)."""
+        if self._view is None:
+            return self._chunk.data
+        root = self._root_array()
+        if self._cache is not None and self._cache_version == root._chunk.version:
+            return self._cache
+        v = self._view
+        pdata = v.parent.data
+        if v.kind == "slice":
+            out = pdata[v.index]
+        elif v.kind == "reshape":
+            out = pdata.reshape(v.shape)
+        else:  # pragma: no cover
+            raise MXNetError(f"unknown view kind {v.kind}")
+        self._cache = out
+        self._cache_version = root._chunk.version
+        return out
+
+    def _rebind(self, new_data: jax.Array):
+        """Point this array at a new value; views write back to their parent."""
+        if self._view is None:
+            self._chunk.rebind(new_data)
+        else:
+            v = self._view
+            if v.kind == "slice":
+                v.parent._rebind(v.parent.data.at[v.index].set(new_data))
+            elif v.kind == "reshape":
+                v.parent._rebind(jnp.reshape(new_data, v.parent.shape))
+            self._cache = None
+        engine().on_outputs([new_data])
+
+    # ------------------------------------------------------------ properties
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return tuple(self.data.shape)
+
+    @property
+    def dtype(self):
+        return _np.dtype(str(self.data.dtype))
+
+    @property
+    def size(self) -> int:
+        return int(functools.reduce(operator.mul, self.shape, 1))
+
+    @property
+    def ndim(self) -> int:
+        return len(self.shape)
+
+    @property
+    def ctx(self) -> Context:
+        d = self.data
+        try:
+            dev = next(iter(d.devices()))
+        except Exception:  # traced/abstract value
+            return current_context()
+        if dev.platform == "cpu":
+            return Context("cpu", dev.id)
+        return Context("tpu", dev.id)
+
+    @property
+    def context(self) -> Context:
+        return self.ctx
+
+    @property
+    def T(self) -> "NDArray":
+        return _invoke(jnp.transpose, self)
+
+    @property
+    def grad(self) -> Optional["NDArray"]:
+        return self._grad
+
+    @property
+    def stype(self) -> str:
+        return "default"
+
+    # ------------------------------------------------------------- sync API
+    def wait_to_read(self):
+        d = self.data
+        if hasattr(d, "block_until_ready"):
+            d.block_until_ready()
+        return self
+
+    def asnumpy(self) -> _np.ndarray:
+        return _np.asarray(self.data)
+
+    def asscalar(self):
+        if self.size != 1:
+            raise MXNetError("The current array is not a scalar")
+        return self.asnumpy().reshape(())[()]
+
+    def item(self):
+        return self.asscalar()
+
+    def tolist(self):
+        return self.asnumpy().tolist()
+
+    def __array__(self, dtype=None):
+        a = self.asnumpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def __dlpack__(self, **kw):  # zero-copy interop
+        return self.data.__dlpack__(**kw)
+
+    # --------------------------------------------------------------- dunder
+    def __len__(self) -> int:
+        if self.ndim == 0:
+            raise TypeError("len() of unsized object")
+        return self.shape[0]
+
+    def __bool__(self):
+        if self.size != 1:
+            raise MXNetError(
+                "The truth value of an NDArray with multiple elements is ambiguous"
+            )
+        return bool(self.asscalar())
+
+    def __float__(self):
+        return float(self.asscalar())
+
+    def __int__(self):
+        return int(self.asscalar())
+
+    def __index__(self):
+        return int(self.asscalar())
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __repr__(self):
+        try:
+            vals = _np.array2string(self.asnumpy(), precision=4, suppress_small=True)
+        except Exception:  # traced / abstract
+            vals = f"<abstract {self.data}>"
+        shape = "x".join(str(s) for s in self.shape)
+        return f"\n{vals}\n<NDArray {shape} @{self.ctx}>"
+
+    # ------------------------------------------------------------- indexing
+    def __getitem__(self, idx) -> "NDArray":
+        idx = _unwrap_index(idx)
+        if _recording_tracked(self):
+            return _invoke(lambda d: d[idx], self)
+        return NDArray(None, _view=_View(self, "slice", index=idx))
+
+    def __setitem__(self, idx, value):
+        _check_inplace_ok(self)
+        idx = _unwrap_index(idx)
+        value = _unwrap(value)
+        if idx is Ellipsis or (isinstance(idx, slice) and idx == slice(None)):
+            new = jnp.broadcast_to(
+                jnp.asarray(value, dtype=self.data.dtype), self.shape
+            )
+            self._rebind(new)
+            return
+        self._rebind(self.data.at[idx].set(jnp.asarray(value)))
+
+    def slice(self, begin, end, step=None) -> "NDArray":
+        idx = tuple(
+            slice(b, e, s)
+            for b, e, s in zip(begin, end, step or [None] * len(begin))
+        )
+        return self[idx]
+
+    def slice_axis(self, axis: int, begin: int, end: Optional[int]) -> "NDArray":
+        idx = [slice(None)] * self.ndim
+        idx[axis] = slice(begin, end)
+        return self[tuple(idx)]
+
+    def take(self, indices, axis=0, mode="clip") -> "NDArray":
+        ind = _unwrap_index(indices)
+        return _invoke(
+            lambda d: jnp.take(d, ind, axis=axis, mode=mode), self
+        )
+
+    def pick(self, index, axis=-1, keepdims=False) -> "NDArray":
+        ind = _unwrap_index(index)
+        return _invoke(
+            lambda d: jnp.take_along_axis(
+                d, jnp.expand_dims(ind.astype(jnp.int32), axis), axis=axis
+            ).squeeze(axis)
+            if not keepdims
+            else jnp.take_along_axis(
+                d, jnp.expand_dims(ind.astype(jnp.int32), axis), axis=axis
+            ),
+            self,
+        )
+
+    # ------------------------------------------------------- shape changing
+    def reshape(self, *shape, **kwargs) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        shape = tuple(int(s) for s in shape)
+        # mxnet convention: 0 keeps the input dim, -1 infers
+        new = []
+        for i, s in enumerate(shape):
+            if s == 0 and not kwargs.get("reverse", False):
+                new.append(self.shape[i])
+            else:
+                new.append(s)
+        new = tuple(new)
+        if _recording_tracked(self):
+            return _invoke(lambda d: jnp.reshape(d, new), self)
+        return NDArray(None, _view=_View(self, "reshape", shape=new))
+
+    def reshape_like(self, other: "NDArray") -> "NDArray":
+        return self.reshape(other.shape)
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        return _invoke(lambda d: jnp.expand_dims(d, axis), self)
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return _invoke(lambda d: jnp.squeeze(d, axis), self)
+
+    def flatten(self) -> "NDArray":
+        return self.reshape(self.shape[0], -1) if self.ndim > 1 else self.reshape(-1)
+
+    def transpose(self, *axes) -> "NDArray":
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _invoke(lambda d: jnp.transpose(d, axes or None), self)
+
+    def swapaxes(self, a, b) -> "NDArray":
+        return _invoke(lambda d: jnp.swapaxes(d, a, b), self)
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return _invoke(lambda d: jnp.broadcast_to(d, tuple(shape)), self)
+
+    def broadcast_like(self, other) -> "NDArray":
+        return self.broadcast_to(other.shape)
+
+    def tile(self, reps) -> "NDArray":
+        return _invoke(lambda d: jnp.tile(d, reps), self)
+
+    def repeat(self, repeats, axis=None) -> "NDArray":
+        return _invoke(lambda d: jnp.repeat(d, repeats, axis=axis), self)
+
+    # ------------------------------------------------------------ dtype/ctx
+    def astype(self, dtype, copy=True) -> "NDArray":
+        dt = jnp.dtype(dtype)
+        return _invoke(lambda d: d.astype(dt), self)
+
+    def copy(self) -> "NDArray":
+        return NDArray(jnp.array(self.data))
+
+    def copyto(self, other) -> "NDArray":
+        if isinstance(other, NDArray):
+            try:
+                dev = next(iter(other.data.devices()))
+                other._rebind(jax.device_put(self.data, dev))
+            except Exception:
+                other._rebind(self.data)
+            return other
+        if isinstance(other, Context):
+            return NDArray(jax.device_put(self.data, other.jax_device()))
+        raise TypeError(f"copyto does not support type {type(other)}")
+
+    def as_in_context(self, ctx: Context) -> "NDArray":
+        if ctx == self.ctx:
+            return self
+        return NDArray(jax.device_put(self.data, ctx.jax_device()))
+
+    as_in_ctx = as_in_context
+
+    def as_nd_ndarray(self):
+        return self
+
+    def as_np_ndarray(self):
+        return self
+
+    def tostype(self, stype: str) -> "NDArray":
+        if stype != "default":
+            raise MXNetError("sparse storage conversion: use mxnet_tpu.ndarray.sparse")
+        return self
+
+    def detach(self) -> "NDArray":
+        return NDArray(self.data)
+
+    # ------------------------------------------------------------- autograd
+    def attach_grad(self, grad_req: str = "write", stype=None):
+        from .. import autograd
+
+        autograd._attach_grad(self, grad_req)
+
+    def backward(self, out_grad=None, retain_graph=False, train_mode=True):
+        from .. import autograd
+
+        autograd.backward(
+            [self],
+            head_grads=[out_grad] if out_grad is not None else None,
+            retain_graph=retain_graph,
+            train_mode=train_mode,
+        )
+
+    def zero_grad(self):
+        if self._grad is not None:
+            self._grad._rebind(jnp.zeros_like(self._grad.data))
+
+    # ------------------------------------------------------------ arithmetic
+    def _binop(self, other, fn, reverse=False):
+        a, b = (other, self) if reverse else (self, other)
+        return _invoke(fn, a, b)
+
+    def __add__(self, other):
+        return self._binop(other, jnp.add)
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, jnp.subtract)
+
+    def __rsub__(self, other):
+        return self._binop(other, jnp.subtract, reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, jnp.multiply)
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, jnp.divide)
+
+    def __rtruediv__(self, other):
+        return self._binop(other, jnp.divide, reverse=True)
+
+    def __floordiv__(self, other):
+        return self._binop(other, jnp.floor_divide)
+
+    def __rfloordiv__(self, other):
+        return self._binop(other, jnp.floor_divide, reverse=True)
+
+    def __mod__(self, other):
+        return self._binop(other, jnp.mod)
+
+    def __rmod__(self, other):
+        return self._binop(other, jnp.mod, reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, jnp.power)
+
+    def __rpow__(self, other):
+        return self._binop(other, jnp.power, reverse=True)
+
+    def __matmul__(self, other):
+        return self._binop(other, jnp.matmul)
+
+    def __rmatmul__(self, other):
+        return self._binop(other, jnp.matmul, reverse=True)
+
+    def __neg__(self):
+        return _invoke(jnp.negative, self)
+
+    def __abs__(self):
+        return _invoke(jnp.abs, self)
+
+    # in-place: rebind (reference mutated the buffer in place)
+    def __iadd__(self, other):
+        _check_inplace_ok(self)
+        self._rebind(jnp.add(self.data, _unwrap(other)))
+        return self
+
+    def __isub__(self, other):
+        _check_inplace_ok(self)
+        self._rebind(jnp.subtract(self.data, _unwrap(other)))
+        return self
+
+    def __imul__(self, other):
+        _check_inplace_ok(self)
+        self._rebind(jnp.multiply(self.data, _unwrap(other)))
+        return self
+
+    def __itruediv__(self, other):
+        _check_inplace_ok(self)
+        self._rebind(jnp.divide(self.data, _unwrap(other)))
+        return self
+
+    # comparisons (not differentiated; mxnet returns same-dtype 0/1 arrays)
+    def __eq__(self, other):
+        if other is None:
+            return NotImplemented
+        return NDArray(jnp.equal(self.data, _unwrap(other)).astype(self.data.dtype))
+
+    def __ne__(self, other):
+        if other is None:
+            return NotImplemented
+        return NDArray(jnp.not_equal(self.data, _unwrap(other)).astype(self.data.dtype))
+
+    def __lt__(self, other):
+        return NDArray(jnp.less(self.data, _unwrap(other)).astype(self.data.dtype))
+
+    def __le__(self, other):
+        return NDArray(jnp.less_equal(self.data, _unwrap(other)).astype(self.data.dtype))
+
+    def __gt__(self, other):
+        return NDArray(jnp.greater(self.data, _unwrap(other)).astype(self.data.dtype))
+
+    def __ge__(self, other):
+        return NDArray(jnp.greater_equal(self.data, _unwrap(other)).astype(self.data.dtype))
+
+    def __hash__(self):
+        return id(self)
+
+    # --------------------------------------------------------- reduce sugar
+    def _reduce(self, fn, axis=None, keepdims=False):
+        return _invoke(lambda d: fn(d, axis=axis, keepdims=keepdims), self)
+
+    def sum(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.sum, axis, keepdims)
+
+    def mean(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.mean, axis, keepdims)
+
+    def max(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.max, axis, keepdims)
+
+    def min(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.min, axis, keepdims)
+
+    def prod(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.prod, axis, keepdims)
+
+    def std(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.std, axis, keepdims)
+
+    def var(self, axis=None, keepdims=False, **kw):
+        return self._reduce(jnp.var, axis, keepdims)
+
+    def norm(self, ord=None, axis=None, keepdims=False):
+        return _invoke(
+            lambda d: jnp.linalg.norm(d, ord=ord, axis=axis, keepdims=keepdims), self
+        )
+
+    def argmax(self, axis=None, **kw):
+        return NDArray(jnp.argmax(self.data, axis=axis).astype(_DEFAULT_DTYPE))
+
+    def argmin(self, axis=None, **kw):
+        return NDArray(jnp.argmin(self.data, axis=axis).astype(_DEFAULT_DTYPE))
+
+    def argsort(self, axis=-1, is_ascend=True):
+        order = jnp.argsort(self.data, axis=axis)
+        if not is_ascend:
+            order = jnp.flip(order, axis=axis)
+        return NDArray(order.astype(_DEFAULT_DTYPE))
+
+    def clip(self, a_min=None, a_max=None):
+        return _invoke(lambda d: jnp.clip(d, a_min, a_max), self)
+
+    def abs(self):
+        return _invoke(jnp.abs, self)
+
+    def sqrt(self):
+        return _invoke(jnp.sqrt, self)
+
+    def square(self):
+        return _invoke(jnp.square, self)
+
+    def exp(self):
+        return _invoke(jnp.exp, self)
+
+    def log(self):
+        return _invoke(jnp.log, self)
+
+    def round(self):
+        return _invoke(jnp.round, self)
+
+    def floor(self):
+        return _invoke(jnp.floor, self)
+
+    def ceil(self):
+        return _invoke(jnp.ceil, self)
+
+    def sign(self):
+        return _invoke(jnp.sign, self)
+
+    def relu(self):
+        return _invoke(lambda d: jnp.maximum(d, 0), self)
+
+    def sigmoid(self):
+        return _invoke(jax.nn.sigmoid, self)
+
+    def tanh(self):
+        return _invoke(jnp.tanh, self)
+
+    def softmax(self, axis=-1):
+        return _invoke(lambda d: jax.nn.softmax(d, axis=axis), self)
+
+    def log_softmax(self, axis=-1):
+        return _invoke(lambda d: jax.nn.log_softmax(d, axis=axis), self)
+
+    def dot(self, other):
+        return self._binop(other, jnp.dot)
+
+    def one_hot(self, depth, on_value=1.0, off_value=0.0):
+        return NDArray(
+            jax.nn.one_hot(self.data.astype(jnp.int32), depth)
+            * (on_value - off_value)
+            + off_value
+        )
+
+
+# --------------------------------------------------------------- factories
+def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
+    """Create an NDArray from any array-like (reference: ``mx.nd.array``)."""
+    if isinstance(source, NDArray):
+        data = source.data
+    else:
+        data = jnp.asarray(source)
+    if dtype is not None:
+        data = data.astype(jnp.dtype(dtype))
+    elif data.dtype == jnp.float64:
+        data = data.astype(_DEFAULT_DTYPE)
+    return NDArray(data, ctx=ctx)
+
+
+def empty(shape, ctx=None, dtype=None) -> NDArray:
+    return NDArray(
+        jnp.zeros(shape, dtype=jnp.dtype(dtype) if dtype else _DEFAULT_DTYPE), ctx=ctx
+    )
+
+
+def from_jax(data: jax.Array) -> NDArray:
+    return NDArray(data)
+
+
+def waitall():
+    from ..engine import wait_for_all
+
+    wait_for_all()

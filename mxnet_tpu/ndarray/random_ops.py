@@ -1,0 +1,123 @@
+"""Random sampling functions for ``mx.nd.random`` / ``mx.random``.
+
+Reference: ``src/operator/random/`` samplers behind ``mx.nd.random.*``
+[unverified]. Stateful API over splittable jax keys (see
+``mxnet_tpu.random``); per-call key draws keep eager semantics while the
+key-supply scope keeps hybridized graphs pure.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..context import Context
+from ..random import next_key
+from .ndarray import NDArray, _unwrap
+
+__all__ = [
+    "uniform", "normal", "randn", "randint", "gamma", "exponential",
+    "poisson", "negative_binomial", "generalized_negative_binomial",
+    "multinomial", "shuffle", "bernoulli",
+]
+
+
+def _wrap(data, ctx=None, dtype=None):
+    if dtype is not None:
+        data = data.astype(jnp.dtype(dtype))
+    return NDArray(data, ctx=ctx if isinstance(ctx, Context) else None)
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(shape)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    data = jax.random.uniform(
+        next_key(), _shape(shape), minval=low, maxval=high, dtype=jnp.dtype(dtype)
+    )
+    if out is not None:
+        out._rebind(data)
+        return out
+    return _wrap(data, ctx)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, out=None, **kw):
+    data = loc + scale * jax.random.normal(next_key(), _shape(shape), dtype=jnp.dtype(dtype))
+    if out is not None:
+        out._rebind(data)
+        return out
+    return _wrap(data, ctx)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None, **kw):
+    return normal(loc, scale, shape, dtype=dtype, ctx=ctx)
+
+
+def randint(low, high=None, shape=None, dtype="int32", ctx=None, out=None, **kw):
+    if high is None:
+        low, high = 0, low
+    data = jax.random.randint(next_key(), _shape(shape), low, high, dtype=jnp.dtype(dtype))
+    if out is not None:
+        out._rebind(data)
+        return out
+    return _wrap(data, ctx)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    data = jax.random.gamma(next_key(), alpha, _shape(shape), dtype=jnp.dtype(dtype)) * beta
+    return _wrap(data, ctx)
+
+
+def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    data = scale * jax.random.exponential(next_key(), _shape(shape), dtype=jnp.dtype(dtype))
+    return _wrap(data, ctx)
+
+
+def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    data = jax.random.poisson(next_key(), lam, _shape(shape)).astype(jnp.dtype(dtype))
+    return _wrap(data, ctx)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    g = jax.random.gamma(next_key(), k, _shape(shape)) * ((1 - p) / p)
+    data = jax.random.poisson(next_key(), g).astype(jnp.dtype(dtype))
+    return _wrap(data, ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32",
+                                  ctx=None, **kw):
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    return negative_binomial(r, p, shape, dtype=dtype, ctx=ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    probs = _unwrap(data)
+    logits = jnp.log(jnp.maximum(probs, 1e-30))
+    n = 1
+    if shape:
+        n = shape if isinstance(shape, int) else int(jnp.prod(jnp.asarray(shape)))
+    out_shape = (probs.shape[0], n) if probs.ndim == 2 else (n,)
+    samp = jax.random.categorical(next_key(), logits, axis=-1, shape=(
+        (n, probs.shape[0]) if probs.ndim == 2 else (n,)
+    ))
+    if probs.ndim == 2:
+        samp = samp.T
+    if shape is None:
+        samp = samp.squeeze(-1) if samp.ndim > probs.ndim - 1 else samp
+    return NDArray(samp.astype(jnp.dtype(dtype)))
+
+
+def bernoulli(prob=0.5, shape=None, dtype="float32", ctx=None, **kw):
+    p = _unwrap(prob)
+    data = jax.random.bernoulli(next_key(), p, _shape(shape) or None)
+    return _wrap(data.astype(jnp.dtype(dtype)), ctx)
+
+
+def shuffle(data, **kw):
+    return NDArray(jax.random.permutation(next_key(), _unwrap(data), axis=0))

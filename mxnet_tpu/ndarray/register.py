@@ -1,0 +1,42 @@
+"""Build the ``mx.nd.*`` namespace from the op registry at import time.
+
+TPU-native analogue of ``python/mxnet/ndarray/register.py`` [unverified]:
+the reference listed the nnvm registry through the C ABI and code-generated
+Python functions with docstrings; here we wrap each registered ``Operator``
+in a dispatcher through ``imperative.invoke`` and install it on the target
+module — same structural idea, one registry serving every frontend.
+"""
+
+from __future__ import annotations
+
+import functools
+
+from ..ops import registry as _registry
+
+
+def _make_op_func(op: _registry.Operator):
+    def op_func(*args, out=None, **kwargs):
+        from ..imperative import invoke
+
+        return invoke(op, *args, out=out, **kwargs)
+
+    op_func.__name__ = op.name
+    op_func.__qualname__ = op.name
+    op_func.__doc__ = op.fn.__doc__ or f"Operator ``{op.name}``."
+    return op_func
+
+
+def populate_module(module, namespace: str = "nd"):
+    """Install generated functions for all ops exposed in ``namespace``."""
+    installed = []
+    for name in _registry.list_ops():
+        op = _registry.get(name)
+        if namespace not in op.namespaces:
+            continue
+        fn = _make_op_func(op)
+        setattr(module, name, fn)
+        installed.append(name)
+        for a in op.aliases:
+            setattr(module, a, fn)
+            installed.append(a)
+    return installed

@@ -1,0 +1,111 @@
+"""Sparse NDArray facade: ``row_sparse`` and ``csr`` storage types.
+
+Reference: ``src/ndarray/`` row_sparse/CSR storage + ``src/operator/tensor``
+sparse kernels [unverified]. On TPU, XLA has no sparse buffer type and the
+MXU wants dense tiles, so the TPU-native stance is: keep the *API* (creation,
+``.indices``/``.data``, conversion, sparse ``dot``) while backing storage
+densely the moment it reaches device; ``row_sparse`` keeps its compressed
+(indices, values) host-side identity for the cases the reference optimized
+(embedding gradients, kvstore push), which our Trainer handles by scatter-add
+on device instead.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, _unwrap
+
+__all__ = [
+    "BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+    "row_sparse_array", "csr_matrix", "zeros",
+]
+
+
+class BaseSparseNDArray(NDArray):
+    """Common base; behaves as a dense NDArray with sparse metadata."""
+
+    _stype = "default"
+
+    @property
+    def stype(self):
+        return self._stype
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self.data)
+        if stype == self._stype:
+            return self
+        raise MXNetError(f"cannot convert {self._stype} to {stype}")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    _stype = "row_sparse"
+
+    @property
+    def indices(self) -> NDArray:
+        nz = _np.nonzero(_np.any(self.asnumpy() != 0, axis=tuple(range(1, self.ndim))))[0]
+        return NDArray(jnp.asarray(nz, jnp.int32))
+
+    @property
+    def values(self) -> NDArray:  # data rows at indices
+        return NDArray(jnp.take(self.data, self.indices.data.astype(jnp.int32), axis=0))
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        keep = jnp.zeros((self.shape[0],), bool).at[_unwrap(indices).astype(jnp.int32)].set(True)
+        out = jnp.where(keep.reshape((-1,) + (1,) * (self.ndim - 1)), self.data, 0)
+        return RowSparseNDArray(out)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    _stype = "csr"
+
+    @property
+    def indptr(self) -> NDArray:
+        a = self.asnumpy()
+        counts = (a != 0).sum(axis=1)
+        return NDArray(jnp.asarray(_np.concatenate([[0], _np.cumsum(counts)]), jnp.int32))
+
+    @property
+    def indices(self) -> NDArray:
+        a = self.asnumpy()
+        return NDArray(jnp.asarray(_np.nonzero(a)[1], jnp.int32))
+
+    @property
+    def values(self) -> NDArray:
+        a = self.asnumpy()
+        return NDArray(jnp.asarray(a[a != 0]))
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 2 and not _np.isscalar(arg1[0]):
+        values, indices = arg1
+        values = _unwrap(values)
+        idx = _np.asarray(_unwrap(indices)).astype(_np.int32)
+        full_shape = shape or ((int(idx.max()) + 1,) + tuple(values.shape[1:]))
+        dense = jnp.zeros(full_shape, values.dtype if dtype is None else jnp.dtype(dtype))
+        dense = dense.at[idx].set(values)
+        return RowSparseNDArray(dense, ctx=ctx)
+    return RowSparseNDArray(jnp.asarray(_unwrap(arg1)), ctx=ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = (_np.asarray(_unwrap(a)) for a in arg1)
+        n_rows = len(indptr) - 1
+        n_cols = shape[1] if shape else int(indices.max()) + 1
+        dense = _np.zeros((n_rows, n_cols), dtype=data.dtype if dtype is None else dtype)
+        for r in range(n_rows):
+            cols = indices[indptr[r]:indptr[r + 1]].astype(int)
+            dense[r, cols] = data[indptr[r]:indptr[r + 1]]
+        return CSRNDArray(jnp.asarray(dense), ctx=ctx)
+    return CSRNDArray(jnp.asarray(_unwrap(arg1)), ctx=ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    import jax.numpy as jnp
+
+    cls = {"row_sparse": RowSparseNDArray, "csr": CSRNDArray, "default": NDArray}[stype]
+    return cls(jnp.zeros(shape, jnp.dtype(dtype) if dtype else jnp.float32), ctx=ctx)

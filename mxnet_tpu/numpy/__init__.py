@@ -1,0 +1,194 @@
+"""``mx.np``: NumPy-semantics array API.
+
+Reference: ``python/mxnet/numpy/`` (multiarray.py) [unverified] — the 2.0-era
+NumPy-compatible surface GluonNLP models use. Here every function wraps the
+corresponding ``jax.numpy`` function through the imperative invoke path, so
+autograd records it and ``hybridize()`` traces it; the array type is the same
+NDArray as ``mx.nd`` (the reference kept two array classes; one suffices when
+both namespaces share one functional backend).
+"""
+
+from __future__ import annotations
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as _onp
+
+from ..context import Context
+from ..ndarray.ndarray import NDArray, _unwrap
+
+ndarray = NDArray
+
+# constants / dtypes re-exported for API parity
+pi = _onp.pi
+e = _onp.e
+inf = _onp.inf
+nan = _onp.nan
+newaxis = None
+float16 = _onp.float16
+float32 = _onp.float32
+float64 = _onp.float64
+bfloat16 = jnp.bfloat16
+int8 = _onp.int8
+int32 = _onp.int32
+int64 = _onp.int64
+uint8 = _onp.uint8
+bool_ = _onp.bool_
+
+_f32 = jnp.float32
+
+
+def _invoke(fn, *args, **static):
+    from ..imperative import invoke_fn
+
+    return invoke_fn(fn, *args, **static)
+
+
+def array(obj, dtype=None, ctx=None, device=None) -> NDArray:
+    from ..ndarray.ndarray import array as _array
+
+    return _array(obj, ctx=ctx or device, dtype=dtype)
+
+
+def _creation(jfn):
+    def fn(*args, ctx=None, device=None, dtype=None, **kw):
+        out = jfn(*args, **({"dtype": jnp.dtype(dtype)} if dtype else {}), **kw)
+        if out.dtype == jnp.float64:
+            out = out.astype(_f32)
+        return NDArray(out, ctx=ctx or device)
+
+    fn.__name__ = jfn.__name__
+    return fn
+
+
+zeros = _creation(jnp.zeros)
+ones = _creation(jnp.ones)
+empty = _creation(jnp.zeros)
+full = _creation(jnp.full)
+arange = _creation(jnp.arange)
+linspace = _creation(jnp.linspace)
+logspace = _creation(jnp.logspace)
+eye = _creation(jnp.eye)
+identity = _creation(jnp.identity)
+tri = _creation(jnp.tri)
+
+
+def zeros_like(a, dtype=None, **kw):
+    return _invoke(lambda d: jnp.zeros_like(d, dtype=jnp.dtype(dtype) if dtype else None), a)
+
+
+def ones_like(a, dtype=None, **kw):
+    return _invoke(lambda d: jnp.ones_like(d, dtype=jnp.dtype(dtype) if dtype else None), a)
+
+
+def full_like(a, fill_value, dtype=None, **kw):
+    return _invoke(
+        lambda d: jnp.full_like(d, fill_value, dtype=jnp.dtype(dtype) if dtype else None), a
+    )
+
+
+# array-consuming jnp functions exposed verbatim; positional args are treated
+# as (potential) arrays, keyword args as static parameters.
+_PASSTHROUGH = [
+    # elementwise
+    "add", "subtract", "multiply", "divide", "true_divide", "floor_divide",
+    "mod", "remainder", "power", "float_power", "negative", "positive", "absolute",
+    "abs", "fabs", "sign", "rint", "round", "floor", "ceil", "trunc",
+    "sqrt", "cbrt", "square", "reciprocal", "exp", "expm1", "exp2", "log",
+    "log2", "log10", "log1p", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "arctan2", "sinh", "cosh", "tanh", "arcsinh", "arccosh", "arctanh",
+    "hypot", "degrees", "radians", "deg2rad", "rad2deg", "maximum", "minimum",
+    "fmax", "fmin", "clip", "logaddexp", "logaddexp2", "copysign", "nextafter",
+    "ldexp", "heaviside", "gcd", "lcm",
+    # logic
+    "logical_and", "logical_or", "logical_xor", "logical_not", "equal",
+    "not_equal", "greater", "greater_equal", "less", "less_equal", "isnan",
+    "isinf", "isfinite", "isposinf", "isneginf", "isclose", "array_equal",
+    "signbit",
+    # reductions
+    "sum", "prod", "mean", "std", "var", "min", "max", "amin", "amax", "ptp",
+    "median", "average", "nansum", "nanprod", "nanmean", "nanstd", "nanvar",
+    "nanmin", "nanmax", "cumsum", "cumprod", "nancumsum", "all", "any",
+    "count_nonzero", "argmax", "argmin", "nanargmax", "nanargmin",
+    # shape
+    "reshape", "ravel", "transpose", "swapaxes", "moveaxis", "rollaxis",
+    "expand_dims", "squeeze", "broadcast_to", "broadcast_arrays", "atleast_1d",
+    "atleast_2d", "atleast_3d", "concatenate", "stack", "vstack", "hstack",
+    "dstack", "column_stack", "split", "array_split", "vsplit", "hsplit",
+    "dsplit", "tile", "repeat", "flip", "fliplr", "flipud", "roll", "rot90",
+    "pad", "append", "delete", "insert", "resize", "trim_zeros", "flatnonzero",
+    # indexing / selection
+    "take", "take_along_axis", "choose", "compress", "diag", "diagonal",
+    "diagflat", "tril", "triu", "where", "extract", "searchsorted", "nonzero",
+    "argwhere", "unravel_index", "ravel_multi_index", "ix_", "indices",
+    "select", "piecewise", "putmask",
+    # sorting
+    "sort", "argsort", "lexsort", "partition", "argpartition", "unique",
+    # linalg-ish
+    "dot", "vdot", "inner", "outer", "matmul", "tensordot", "einsum", "kron",
+    "cross", "trace",
+    # other
+    "interp", "convolve", "correlate", "diff", "ediff1d", "gradient",
+    "histogram", "bincount", "digitize", "corrcoef", "cov", "floor_divide",
+    "angle", "real", "imag", "conj", "conjugate", "i0", "sinc", "nan_to_num",
+    "meshgrid", "apply_along_axis", "apply_over_axes",
+]
+
+from ._passthrough import install as _install_passthrough
+
+_install_passthrough(sys.modules[__name__], jnp, _PASSTHROUGH, "mx.np")
+
+
+def asarray(obj, dtype=None):
+    return array(obj, dtype=dtype)
+
+
+def ascontiguousarray(obj, dtype=None):
+    return array(obj, dtype=dtype)
+
+
+def copy(a):
+    return NDArray(jnp.array(_unwrap(a)))
+
+
+def shape(a):
+    return tuple(_unwrap(a).shape)
+
+
+def ndim(a):
+    return _unwrap(a).ndim
+
+
+def size(a):
+    return int(_unwrap(a).size)
+
+
+def may_share_memory(a, b):
+    return False
+
+
+def shares_memory(a, b):
+    return False
+
+
+def dtype(d):
+    return _onp.dtype(d)
+
+
+def result_type(*args):
+    return _onp.result_type(*[(_unwrap(a).dtype if isinstance(a, NDArray) else a) for a in args])
+
+
+def can_cast(from_, to):
+    return _onp.can_cast(from_, to)
+
+
+def issubdtype(a, b):
+    return _onp.issubdtype(a, b)
+
+
+from . import linalg  # noqa: E402
+from . import random  # noqa: E402
+from . import fft  # noqa: E402

@@ -1,0 +1,34 @@
+"""Shared factory for numpy-namespace passthrough wrappers.
+
+One generator used by ``mx.np``, ``mx.np.linalg`` and ``mx.np.fft``: wraps a
+``jax.numpy``-level function so positional args are treated as (potential)
+arrays dispatched through the imperative invoke path (autograd-recorded,
+jit-traceable) and keyword args as static parameters.
+"""
+
+from __future__ import annotations
+
+
+def make_wrapper(jfn, prefix: str):
+    def fn(*args, **kwargs):
+        from ..imperative import invoke_fn
+
+        return invoke_fn(lambda *xs: jfn(*xs, **kwargs), *args)
+
+    fn.__name__ = jfn.__name__
+    fn.__qualname__ = jfn.__name__
+    fn.__doc__ = f"{prefix}.{jfn.__name__} — numpy-semantics wrapper over the jax equivalent."
+    return fn
+
+
+def install(module, source, names, prefix: str):
+    """Install wrappers for every ``names`` entry present on ``source``."""
+    installed = []
+    seen = set()
+    for name in names:
+        if name in seen or not hasattr(source, name):
+            continue
+        seen.add(name)
+        setattr(module, name, make_wrapper(getattr(source, name), prefix))
+        installed.append(name)
+    return installed

@@ -1,0 +1,14 @@
+"""``mx.np.fft`` over ``jnp.fft``."""
+
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+
+from ._passthrough import install as _install
+
+_FUNCS = ["fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "fftn", "ifftn",
+          "fftshift", "ifftshift", "fftfreq", "rfftfreq"]
+
+_install(sys.modules[__name__], jnp.fft, _FUNCS, "mx.np.fft")

@@ -1,0 +1,17 @@
+"""``mx.np.linalg`` over ``jnp.linalg`` (reference: mxnet.numpy.linalg)."""
+
+from __future__ import annotations
+
+import sys
+
+import jax.numpy as jnp
+
+from ._passthrough import install as _install
+
+_FUNCS = [
+    "norm", "svd", "cholesky", "qr", "inv", "pinv", "det", "slogdet", "eig",
+    "eigh", "eigvals", "eigvalsh", "solve", "lstsq", "matrix_rank",
+    "matrix_power", "multi_dot", "tensorinv", "tensorsolve",
+]
+
+_install(sys.modules[__name__], jnp.linalg, _FUNCS, "mx.np.linalg")

@@ -1,0 +1,130 @@
+"""``mx.np.random``: numpy-style random sampling over the stateful key."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ndarray.ndarray import NDArray, _unwrap
+from ..random import next_key, seed  # re-export seed
+
+__all__ = ["seed", "uniform", "normal", "randn", "rand", "randint", "choice",
+           "shuffle", "permutation", "beta", "gamma", "exponential", "chisquare",
+           "multinomial", "bernoulli", "laplace", "gumbel", "logistic", "pareto",
+           "power", "rayleigh", "weibull", "lognormal", "multivariate_normal"]
+
+
+def _shape(size):
+    if size is None:
+        return ()
+    return (size,) if isinstance(size, int) else tuple(size)
+
+
+def uniform(low=0.0, high=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    data = jax.random.uniform(next_key(), _shape(size), minval=low, maxval=high,
+                              dtype=jnp.dtype(dtype) if dtype else jnp.float32)
+    return NDArray(data)
+
+
+def normal(loc=0.0, scale=1.0, size=None, dtype=None, ctx=None, device=None, out=None):
+    data = loc + scale * jax.random.normal(next_key(), _shape(size),
+                                           dtype=jnp.dtype(dtype) if dtype else jnp.float32)
+    return NDArray(data)
+
+
+def randn(*size):
+    return normal(0.0, 1.0, size or None)
+
+
+def rand(*size):
+    return uniform(0.0, 1.0, size or None)
+
+
+def randint(low, high=None, size=None, dtype="int32", ctx=None, device=None):
+    if high is None:
+        low, high = 0, low
+    return NDArray(jax.random.randint(next_key(), _shape(size), low, high,
+                                      dtype=jnp.dtype(dtype)))
+
+
+def choice(a, size=None, replace=True, p=None, ctx=None, device=None):
+    arr = jnp.arange(a) if isinstance(a, int) else _unwrap(a)
+    pd = _unwrap(p) if p is not None else None
+    return NDArray(jax.random.choice(next_key(), arr, _shape(size), replace=replace, p=pd))
+
+
+def shuffle(x):
+    x._rebind(jax.random.permutation(next_key(), x.data, axis=0))
+
+
+def permutation(x):
+    if isinstance(x, int):
+        return NDArray(jax.random.permutation(next_key(), x))
+    return NDArray(jax.random.permutation(next_key(), _unwrap(x), axis=0))
+
+
+def beta(a, b, size=None):
+    return NDArray(jax.random.beta(next_key(), a, b, _shape(size)))
+
+
+def gamma(shape, scale=1.0, size=None):
+    return NDArray(jax.random.gamma(next_key(), shape, _shape(size)) * scale)
+
+
+def exponential(scale=1.0, size=None):
+    return NDArray(scale * jax.random.exponential(next_key(), _shape(size)))
+
+
+def chisquare(df, size=None):
+    return NDArray(2.0 * jax.random.gamma(next_key(), df / 2.0, _shape(size)))
+
+
+def multinomial(n, pvals, size=None):
+    p = _unwrap(pvals)
+    counts = jax.random.multinomial(next_key(), n, p, shape=_shape(size) or None)
+    return NDArray(counts)
+
+
+def bernoulli(prob, size=None, dtype="float32"):
+    return NDArray(jax.random.bernoulli(next_key(), _unwrap(prob), _shape(size) or None)
+                   .astype(jnp.dtype(dtype)))
+
+
+def laplace(loc=0.0, scale=1.0, size=None):
+    return NDArray(loc + scale * jax.random.laplace(next_key(), _shape(size)))
+
+
+def gumbel(loc=0.0, scale=1.0, size=None):
+    return NDArray(loc + scale * jax.random.gumbel(next_key(), _shape(size)))
+
+
+def logistic(loc=0.0, scale=1.0, size=None):
+    return NDArray(loc + scale * jax.random.logistic(next_key(), _shape(size)))
+
+
+def pareto(a, size=None):
+    return NDArray(jax.random.pareto(next_key(), a, _shape(size)) - 1.0)
+
+
+def power(a, size=None):
+    u = jax.random.uniform(next_key(), _shape(size))
+    return NDArray(jnp.power(u, 1.0 / a))
+
+
+def rayleigh(scale=1.0, size=None):
+    u = jax.random.uniform(next_key(), _shape(size), minval=1e-12)
+    return NDArray(scale * jnp.sqrt(-2.0 * jnp.log(u)))
+
+
+def weibull(a, size=None):
+    u = jax.random.uniform(next_key(), _shape(size), minval=1e-12)
+    return NDArray(jnp.power(-jnp.log(u), 1.0 / a))
+
+
+def lognormal(mean=0.0, sigma=1.0, size=None):
+    return NDArray(jnp.exp(mean + sigma * jax.random.normal(next_key(), _shape(size))))
+
+
+def multivariate_normal(mean, cov, size=None):
+    return NDArray(jax.random.multivariate_normal(
+        next_key(), _unwrap(mean), _unwrap(cov), _shape(size) or None))

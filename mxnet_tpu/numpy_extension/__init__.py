@@ -1,0 +1,33 @@
+"""``mx.npx``: operator extensions beyond the NumPy standard.
+
+Reference: ``python/mxnet/numpy_extension/`` [unverified] — neural-net ops
+(softmax, batch_norm, convolution, embedding...) exposed with numpy-semantics
+arrays. Wraps the same op registry as ``mx.nd``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..ndarray import register as _register
+from ..util import (  # noqa: F401 - API parity
+    is_np_array,
+    is_np_shape,
+    reset_np,
+    set_np,
+    use_np,
+    use_np_array,
+    use_np_shape,
+)
+
+# npx exposes the nn/contrib op surface with numpy arrays; the registry is
+# shared, so just install every op here too.
+_register.populate_module(sys.modules[__name__], namespace="nd")
+
+from ..context import cpu, current_context, gpu, num_gpus, tpu  # noqa: F401, E402
+
+
+def seed(s):
+    from ..random import seed as _seed
+
+    _seed(s)

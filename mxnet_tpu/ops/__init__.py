@@ -1,0 +1,14 @@
+"""Operator library.
+
+TPU-native analogue of ``src/operator/**`` [unverified]: every op is a pure
+function over jax arrays registered in ``registry``; XLA replaces the
+reference's hand-written CPU/CUDA kernels for everything ``tensor/``-like,
+and Pallas kernels (``ops.pallas``) replace hand-written CUDA where fusion
+alone is not enough (attention, fused optimizers).
+"""
+
+from . import registry
+from .registry import Operator, register, get, list_ops, alias
+from . import tensor  # noqa: F401 - registers tensor ops
+from . import nn  # noqa: F401 - registers nn ops
+from . import contrib  # noqa: F401 - registers contrib ops

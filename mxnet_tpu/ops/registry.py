@@ -1,0 +1,133 @@
+"""Operator registry: the single registry serving every frontend namespace.
+
+TPU-native analogue of the reference's nnvm op registry
+(``NNVM_REGISTER_OP`` + attribute maps, ``3rdparty/tvm/nnvm`` [unverified]).
+Key structural fact preserved from the reference (SURVEY.md section 1): ONE op
+registry is consumed by the imperative path, the hybridized (jit) path, and
+the generated Python namespaces (``mx.nd.*`` / ``mx.np.*``), whose functions
+are built at import time by listing this registry.
+
+What changed for TPU: an op here is a *pure function over jax.Arrays*
+(compute == FCompute; shape/dtype inference comes free from jax tracing, so
+there are no separate FInferShape/FInferType attrs; gradients come from
+``jax.vjp`` over the same function, so there is no FGradient registry except
+for ops that opt into a custom VJP, e.g. Pallas kernels).
+"""
+
+from __future__ import annotations
+
+import functools
+import inspect
+from typing import Callable, Dict, List, Optional, Sequence
+
+__all__ = ["Operator", "register", "get", "maybe_get", "list_ops", "alias"]
+
+
+class Operator:
+    """A registered op.
+
+    Attributes:
+        name: canonical registered name (e.g. ``"dot"``, ``"Convolution"``).
+        fn: pure function ``fn(*jax_arrays, **params) -> array | tuple``.
+        num_outputs: static output count (None if param-dependent).
+        namespaces: which generated namespaces expose it ('nd', 'np', 'npx').
+        wrap_outputs: if False the fn returns non-array python data.
+        differentiable: participates in autograd recording.
+        mutates_input: index of input mutated in-place (fused optimizer
+            update ops write their first arg, reference
+            ``src/operator/optimizer_op`` [unverified]); the imperative
+            runtime rebinds that NDArray to output 0.
+    """
+
+    __slots__ = (
+        "name",
+        "fn",
+        "num_outputs",
+        "namespaces",
+        "differentiable",
+        "mutates_input",
+        "aliases",
+    )
+
+    def __init__(
+        self,
+        name: str,
+        fn: Callable,
+        num_outputs: Optional[int] = 1,
+        namespaces: Sequence[str] = ("nd",),
+        differentiable: bool = True,
+        mutates_input: Optional[int] = None,
+    ):
+        self.name = name
+        self.fn = fn
+        self.num_outputs = num_outputs
+        self.namespaces = tuple(namespaces)
+        self.differentiable = differentiable
+        self.mutates_input = mutates_input
+        self.aliases: List[str] = []
+
+    def __repr__(self):
+        return f"<Operator {self.name}>"
+
+
+_REGISTRY: Dict[str, Operator] = {}
+_ALIASES: Dict[str, str] = {}
+
+
+def register(
+    name: Optional[str] = None,
+    *,
+    aliases: Sequence[str] = (),
+    num_outputs: Optional[int] = 1,
+    namespaces: Sequence[str] = ("nd",),
+    differentiable: bool = True,
+    mutates_input: Optional[int] = None,
+):
+    """Decorator registering a pure jax-level function as a framework op."""
+
+    def deco(fn: Callable) -> Callable:
+        opname = name or fn.__name__
+        if opname in _REGISTRY:
+            raise ValueError(f"op {opname!r} registered twice")
+        op = Operator(
+            opname,
+            fn,
+            num_outputs=num_outputs,
+            namespaces=namespaces,
+            differentiable=differentiable,
+            mutates_input=mutates_input,
+        )
+        _REGISTRY[opname] = op
+        for a in aliases:
+            alias(a, opname)
+        fn.op = op  # backlink for introspection
+        return fn
+
+    return deco
+
+
+def alias(new_name: str, existing: str):
+    if existing not in _REGISTRY:
+        raise KeyError(f"alias target {existing!r} not registered")
+    _ALIASES[new_name] = existing
+    _REGISTRY[existing].aliases.append(new_name)
+
+
+def get(name: str) -> Operator:
+    op = maybe_get(name)
+    if op is None:
+        raise KeyError(f"operator {name!r} is not registered")
+    return op
+
+
+def maybe_get(name: str) -> Optional[Operator]:
+    if name in _REGISTRY:
+        return _REGISTRY[name]
+    target = _ALIASES.get(name)
+    return _REGISTRY.get(target) if target else None
+
+
+def list_ops(namespace: Optional[str] = None) -> List[str]:
+    if namespace is None:
+        return sorted(_REGISTRY)
+    return sorted(n for n, op in _REGISTRY.items() if namespace in op.namespaces)

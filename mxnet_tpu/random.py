@@ -1,0 +1,80 @@
+"""RNG state: stateful seeding API over functional jax keys.
+
+The reference kept per-device parallel RNG states handed to ops as engine
+resources (``src/common/random_generator.h``, ``ResourceRequest::kRandom``
+[unverified]) behind a stateful ``mx.random.seed()`` API. Here the same API
+fronts a splittable jax PRNG key:
+
+- Eager ops draw keys by splitting a module-global key (stateful, like the
+  reference).
+- Under ``hybridize()``/jit tracing, drawing from global state would bake a
+  constant into the compiled program, so a *key supply* scope provides a
+  traced key that stochastic ops split deterministically; the CachedOp passes
+  a fresh key argument per call, keeping dropout random across steps while
+  the compiled program stays pure.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["seed", "next_key", "key_supply", "KeySupply", "current_key_supply"]
+
+_LOCK = threading.Lock()
+_GLOBAL_KEY = jax.random.PRNGKey(0)
+_SUPPLY = threading.local()
+
+
+def seed(seed_state: int, ctx=None):
+    """Reference: ``mx.random.seed``; ctx accepted for compatibility."""
+    global _GLOBAL_KEY
+    with _LOCK:
+        _GLOBAL_KEY = jax.random.PRNGKey(int(seed_state))
+
+
+class KeySupply:
+    """Deterministic key splitter for one traced invocation."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def next(self):
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+
+def current_key_supply() -> Optional[KeySupply]:
+    stack = getattr(_SUPPLY, "stack", None)
+    return stack[-1] if stack else None
+
+
+class key_supply:
+    """Context manager installing a KeySupply for jit-traced stochastic ops."""
+
+    def __init__(self, key):
+        self._supply = KeySupply(key)
+
+    def __enter__(self):
+        if not hasattr(_SUPPLY, "stack"):
+            _SUPPLY.stack = []
+        _SUPPLY.stack.append(self._supply)
+        return self._supply
+
+    def __exit__(self, *exc):
+        _SUPPLY.stack.pop()
+        return False
+
+
+def next_key():
+    """Draw a fresh PRNG key (supply-scoped if tracing, else global state)."""
+    supply = current_key_supply()
+    if supply is not None:
+        return supply.next()
+    global _GLOBAL_KEY
+    with _LOCK:
+        _GLOBAL_KEY, sub = jax.random.split(_GLOBAL_KEY)
+    return sub

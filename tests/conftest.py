@@ -1,0 +1,31 @@
+"""Test config: run everything on an 8-device virtual CPU mesh.
+
+Mirrors the reference's test leverage (SURVEY.md section 4): one suite,
+re-runnable across contexts; distributed behavior tested in-process — here by
+asking XLA for 8 virtual CPU devices so every sharding/collective path
+compiles and executes without TPU hardware (the driver separately dry-runs
+the multi-chip path).
+"""
+
+import os
+
+# must be set before jax import
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+import numpy as np
+import pytest
+
+# numeric parity checks assume true f32 matmuls (TPU perf path uses bf16 via
+# AMP explicitly; the default low-precision dot would fail fp32 tolerance)
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+@pytest.fixture(autouse=True)
+def _seed_rng():
+    import mxnet_tpu as mx
+
+    mx.random.seed(42)
+    np.random.seed(42)
+    yield

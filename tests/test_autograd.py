@@ -1,0 +1,265 @@
+"""Autograd: record/backward semantics + finite-difference gradient checks.
+
+Models the reference's ``tests/python/unittest/test_autograd.py`` and the
+``check_numeric_gradient`` harness from ``mx.test_utils`` [unverified].
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def assert_close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(
+        a.asnumpy() if isinstance(a, mx.NDArray) else a,
+        b.asnumpy() if isinstance(b, mx.NDArray) else b,
+        rtol=rtol, atol=atol,
+    )
+
+
+def numeric_grad(f, x, eps=1e-3):
+    """Central finite differences of scalar-valued f at numpy point x."""
+    g = np.zeros_like(x)
+    it = np.nditer(x, flags=["multi_index"])
+    while not it.finished:
+        i = it.multi_index
+        xp, xm = x.copy(), x.copy()
+        xp[i] += eps
+        xm[i] -= eps
+        g[i] = (f(xp) - f(xm)) / (2 * eps)
+        it.iternext()
+    return g
+
+
+class TestBasics:
+    def test_square_grad(self):
+        x = nd.array([1.0, 2.0, 3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x
+        y.backward()
+        assert_close(x.grad, np.array([2.0, 4.0, 6.0]))
+
+    def test_chain(self):
+        x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+        x.attach_grad()
+        with autograd.record():
+            y = nd.exp(x).sum()
+        y.backward()
+        assert_close(x.grad, np.exp(x.asnumpy()), rtol=1e-3)
+
+    def test_dot_grads(self):
+        a = nd.array(np.random.rand(3, 4).astype(np.float32))
+        b = nd.array(np.random.rand(4, 2).astype(np.float32))
+        a.attach_grad()
+        b.attach_grad()
+        with autograd.record():
+            loss = nd.dot(a, b).sum()
+        loss.backward()
+        assert_close(a.grad, np.ones((3, 2)) @ b.asnumpy().T, rtol=1e-3)
+        assert_close(b.grad, a.asnumpy().T @ np.ones((3, 2)), rtol=1e-3)
+
+    def test_not_recorded_outside_scope(self):
+        x = nd.array([1.0])
+        x.attach_grad()
+        y = x * x  # outside record
+        with pytest.raises(mx.MXNetError):
+            y.backward()
+
+    def test_head_grad(self):
+        x = nd.array([1.0, 2.0])
+        x.attach_grad()
+        with autograd.record():
+            y = 3 * x
+        y.backward(out_grad=nd.array([10.0, 20.0]))
+        assert_close(x.grad, np.array([30.0, 60.0]))
+
+    def test_grad_req_add(self):
+        x = nd.array([2.0])
+        x.attach_grad(grad_req="add")
+        for _ in range(3):
+            with autograd.record():
+                y = x * x
+            y.backward()
+        assert_close(x.grad, np.array([12.0]))  # 3 * 2x
+
+    def test_grad_req_write_overwrites(self):
+        x = nd.array([2.0])
+        x.attach_grad()  # write
+        for _ in range(2):
+            with autograd.record():
+                y = x * x
+            y.backward()
+        assert_close(x.grad, np.array([4.0]))
+
+    def test_retain_graph(self):
+        x = nd.array([3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x
+        y.backward(retain_graph=True)
+        y.backward()
+        assert_close(x.grad, np.array([6.0]))
+
+    def test_double_backward_without_retain_raises(self):
+        x = nd.array([3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x
+        y.backward()
+        with pytest.raises(mx.MXNetError):
+            y.backward()
+
+    def test_fan_out_accumulation(self):
+        x = nd.array([2.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x + x * 3
+        y.backward()
+        assert_close(x.grad, np.array([7.0]))  # 2x + 3
+
+    def test_multi_output_op(self):
+        x = nd.array(np.random.rand(2, 6).astype(np.float32))
+        x.attach_grad()
+        with autograd.record():
+            parts = nd.split(x, num_outputs=2, axis=1)
+            loss = (parts[0] * 2).sum() + (parts[1] * 3).sum()
+        loss.backward()
+        expect = np.concatenate([np.full((2, 3), 2.0), np.full((2, 3), 3.0)], axis=1)
+        assert_close(x.grad, expect)
+
+    def test_detach_blocks_grad(self):
+        x = nd.array([2.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x
+            z = y.detach() * x
+        z.backward()
+        assert_close(x.grad, np.array([4.0]))  # only d(z)/dx via second factor
+
+    def test_stop_gradient_op(self):
+        x = nd.array([2.0])
+        x.attach_grad()
+        with autograd.record():
+            y = nd.BlockGrad(x * x) + x
+        y.backward()
+        assert_close(x.grad, np.array([1.0]))
+
+    def test_grad_function(self):
+        x = nd.array([1.0, 2.0])
+        x.attach_grad()
+        with autograd.record():
+            y = (x * x).sum()
+        (gx,) = autograd.grad(y, [x], retain_graph=False)
+        assert_close(gx, np.array([2.0, 4.0]))
+
+    def test_training_flags(self):
+        assert not autograd.is_training()
+        with autograd.record(train_mode=True):
+            assert autograd.is_training()
+            assert autograd.is_recording()
+            with autograd.predict_mode():
+                assert not autograd.is_training()
+        assert not autograd.is_recording()
+
+
+class TestNumericGradients:
+    """Finite-difference checks: the reference's core op-test technique."""
+
+    @pytest.mark.parametrize("opname,fn", [
+        ("tanh", np.tanh),
+        ("sigmoid", lambda v: 1 / (1 + np.exp(-v))),
+        ("log", np.log),
+    ])
+    def test_unary_numeric(self, opname, fn):
+        x = np.random.rand(3, 3).astype(np.float32) + 0.5
+        a = nd.array(x)
+        a.attach_grad()
+        with autograd.record():
+            y = getattr(nd, opname)(a).sum()
+        y.backward()
+        num = numeric_grad(lambda v: fn(v).sum(), x.astype(np.float64))
+        assert_close(a.grad, num.astype(np.float32), rtol=2e-2, atol=1e-3)
+
+    def test_softmax_numeric(self):
+        x = np.random.rand(2, 4).astype(np.float32)
+        a = nd.array(x)
+        a.attach_grad()
+        w = np.random.rand(2, 4).astype(np.float32)
+        with autograd.record():
+            y = (nd.softmax(a) * nd.array(w)).sum()
+        y.backward()
+
+        def ref(v):
+            e = np.exp(v - v.max(-1, keepdims=True))
+            return ((e / e.sum(-1, keepdims=True)) * w).sum()
+
+        num = numeric_grad(ref, x.astype(np.float64))
+        assert_close(a.grad, num.astype(np.float32), rtol=2e-2, atol=1e-3)
+
+    def test_layer_norm_numeric(self):
+        x = np.random.rand(2, 5).astype(np.float32)
+        g = np.random.rand(5).astype(np.float32) + 0.5
+        b = np.random.rand(5).astype(np.float32)
+        a = nd.array(x)
+        a.attach_grad()
+        with autograd.record():
+            y = nd.LayerNorm(a, nd.array(g), nd.array(b), eps=1e-5).sum()
+        y.backward()
+
+        def ref(v):
+            m = v.mean(-1, keepdims=True)
+            s = v.var(-1, keepdims=True)
+            return (((v - m) / np.sqrt(s + 1e-5)) * g + b).sum()
+
+        num = numeric_grad(ref, x.astype(np.float64))
+        assert_close(a.grad, num.astype(np.float32), rtol=5e-2, atol=2e-3)
+
+
+class TestCustomFunction:
+    def test_function_forward_backward(self):
+        class Scale3(autograd.Function):
+            def forward(self, x):
+                return x * 3
+
+            def backward(self, dy):
+                return dy * 3
+
+        x = nd.array([1.0, 2.0])
+        x.attach_grad()
+        f = Scale3()
+        with autograd.record():
+            y = f(x)
+        y.backward()
+        assert_close(y, np.array([3.0, 6.0]))
+        assert_close(x.grad, np.array([3.0, 3.0]))
+
+    def test_function_saved_tensors(self):
+        class Square(autograd.Function):
+            def forward(self, x):
+                self.save_for_backward(x)
+                return x * x
+
+            def backward(self, dy):
+                (x,) = self.saved_tensors
+                return dy * 2 * x
+
+        x = nd.array([2.0, 3.0])
+        x.attach_grad()
+        with autograd.record():
+            y = Square()(x)
+        y.backward()
+        assert_close(x.grad, np.array([4.0, 6.0]))
+
+
+class TestMarkVariables:
+    def test_mark_variables(self):
+        x = nd.array([1.0, 2.0])
+        g = nd.zeros((2,))
+        autograd.mark_variables([x], [g])
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+        assert_close(g, np.array([2.0, 4.0]))

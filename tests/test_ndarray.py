@@ -1,0 +1,249 @@
+"""NDArray core semantics: creation, mutation, views, ops, async API.
+
+Models the reference's ``tests/python/unittest/test_ndarray.py`` [unverified]
+coverage: mutability (in-place ops, setitem), storage-sharing views, dtype
+and context handling, operator parity vs NumPy.
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def assert_close(a, b, rtol=1e-5, atol=1e-6):
+    np.testing.assert_allclose(
+        a.asnumpy() if isinstance(a, mx.NDArray) else a,
+        b.asnumpy() if isinstance(b, mx.NDArray) else b,
+        rtol=rtol, atol=atol,
+    )
+
+
+class TestCreation:
+    def test_array_roundtrip(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        a = nd.array(x)
+        assert a.shape == (3, 4)
+        assert a.dtype == np.float32
+        assert_close(a, x)
+
+    def test_zeros_ones_full(self):
+        assert_close(nd.zeros((2, 3)), np.zeros((2, 3)))
+        assert_close(nd.ones((2, 3)), np.ones((2, 3)))
+        assert_close(nd.full((2, 2), 7.0), np.full((2, 2), 7.0))
+
+    def test_arange_linspace(self):
+        assert_close(nd.arange(0, 10, 2), np.arange(0, 10, 2, dtype=np.float32))
+        assert_close(nd.linspace(0, 1, 5), np.linspace(0, 1, 5, dtype=np.float32))
+
+    def test_float64_demotes_to_default_dtype(self):
+        a = nd.array(np.random.rand(3))  # float64 input
+        assert a.dtype == np.float32
+
+    def test_ctx_placement(self):
+        a = nd.ones((2, 2), ctx=mx.cpu(0))
+        assert a.ctx.device_type == "cpu"
+
+
+class TestMutability:
+    def test_setitem_full(self):
+        a = nd.zeros((3, 3))
+        a[:] = 5.0
+        assert_close(a, np.full((3, 3), 5.0))
+
+    def test_setitem_slice(self):
+        a = nd.zeros((4, 4))
+        a[1:3, 1:3] = 1.0
+        expect = np.zeros((4, 4), np.float32)
+        expect[1:3, 1:3] = 1.0
+        assert_close(a, expect)
+
+    def test_inplace_add(self):
+        a = nd.ones((2, 2))
+        b = a  # same handle
+        a += 1.0
+        assert_close(b, np.full((2, 2), 2.0))
+
+    def test_view_write_back(self):
+        """Writing through a slice view updates the base (storage sharing)."""
+        a = nd.zeros((4, 4))
+        v = a[1:3]
+        v[:] = 3.0
+        expect = np.zeros((4, 4), np.float32)
+        expect[1:3] = 3.0
+        assert_close(a, expect)
+
+    def test_view_sees_base_mutation(self):
+        a = nd.zeros((4,))
+        v = a[1:3]
+        a[:] = 2.0
+        assert_close(v, np.full((2,), 2.0))
+
+    def test_reshape_view_write_back(self):
+        a = nd.zeros((2, 3))
+        r = a.reshape(6)
+        r[0] = 9.0
+        assert float(a[0, 0].asscalar()) == 9.0
+
+    def test_sibling_views(self):
+        a = nd.zeros((4,))
+        v1, v2 = a[0:2], a[1:3]
+        v1[:] = 1.0
+        assert_close(v2, np.array([1.0, 0.0], np.float32))
+
+    def test_out_kwarg(self):
+        a, b = nd.ones((2, 2)), nd.ones((2, 2))
+        c = nd.zeros((2, 2))
+        nd.broadcast_add(a, b, out=c)
+        assert_close(c, np.full((2, 2), 2.0))
+
+
+class TestOps:
+    def test_arith_matches_numpy(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(3, 4).astype(np.float32) + 0.5
+        a, b = nd.array(x), nd.array(y)
+        assert_close(a + b, x + y)
+        assert_close(a - b, x - y)
+        assert_close(a * b, x * y)
+        assert_close(a / b, x / y, rtol=1e-4)
+        assert_close(a ** 2, x ** 2)
+        assert_close(-a, -x)
+        assert_close(2.0 - a, 2.0 - x)
+
+    def test_dot(self):
+        x = np.random.rand(3, 4).astype(np.float32)
+        y = np.random.rand(4, 5).astype(np.float32)
+        assert_close(nd.dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-4)
+        assert_close(
+            nd.dot(nd.array(x), nd.array(y.T), transpose_b=True), x @ y, rtol=1e-4
+        )
+
+    def test_batch_dot(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        y = np.random.rand(2, 4, 5).astype(np.float32)
+        assert_close(nd.batch_dot(nd.array(x), nd.array(y)), x @ y, rtol=1e-4)
+
+    def test_reductions(self):
+        x = np.random.rand(3, 4, 5).astype(np.float32)
+        a = nd.array(x)
+        assert_close(nd.sum(a, axis=1), x.sum(axis=1), rtol=1e-4)
+        assert_close(nd.mean(a), x.mean(), rtol=1e-4)
+        assert_close(nd.max(a, axis=(0, 2)), x.max(axis=(0, 2)))
+        assert_close(nd.sum(a, axis=1, exclude=True), x.sum(axis=(0, 2)), rtol=1e-4)
+
+    def test_unary(self):
+        x = np.random.rand(10).astype(np.float32) + 0.1
+        a = nd.array(x)
+        assert_close(nd.sqrt(a), np.sqrt(x), rtol=1e-4)
+        assert_close(nd.exp(a), np.exp(x), rtol=1e-4)
+        assert_close(nd.log(a), np.log(x), rtol=1e-3, atol=1e-4)
+        assert_close(nd.sigmoid(a), 1 / (1 + np.exp(-x)), rtol=1e-4)
+        assert_close(nd.relu(nd.array(x - 0.5)), np.maximum(x - 0.5, 0))
+
+    def test_softmax(self):
+        x = np.random.rand(2, 5).astype(np.float32)
+        e = np.exp(x - x.max(axis=-1, keepdims=True))
+        assert_close(nd.softmax(nd.array(x)), e / e.sum(-1, keepdims=True), rtol=1e-4)
+
+    def test_concat_split_stack(self):
+        x = np.random.rand(2, 3).astype(np.float32)
+        y = np.random.rand(2, 3).astype(np.float32)
+        assert_close(nd.concat(nd.array(x), nd.array(y), dim=1),
+                     np.concatenate([x, y], 1))
+        assert_close(nd.stack(nd.array(x), nd.array(y), axis=0), np.stack([x, y]))
+        parts = nd.split(nd.array(x), num_outputs=3, axis=1)
+        assert len(parts) == 3
+        assert_close(parts[0], x[:, 0:1])
+
+    def test_reshape_special_codes(self):
+        x = np.random.rand(2, 3, 4).astype(np.float32)
+        assert nd.reshape(nd.array(x), shape=(0, -1)).shape == (2, 12)
+        assert nd.reshape(nd.array(x), shape=(-1,)).shape == (24,)
+        assert nd.reshape(nd.array(x), shape=(0, 0, 2, 2)).shape == (2, 3, 2, 2)
+
+    def test_take_embedding(self):
+        w = np.random.rand(10, 4).astype(np.float32)
+        idx = np.array([1, 3, 5], np.float32)
+        out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10, output_dim=4)
+        assert_close(out, w[idx.astype(int)])
+
+    def test_topk_sort(self):
+        x = np.array([[3.0, 1.0, 2.0]], np.float32)
+        idx = nd.topk(nd.array(x), k=2)
+        np.testing.assert_array_equal(idx.asnumpy(), [[0.0, 2.0]])
+        v, i = nd.topk(nd.array(x), k=2, ret_typ="both")
+        np.testing.assert_array_equal(v.asnumpy(), [[3.0, 2.0]])
+        assert_close(nd.sort(nd.array(x)), np.sort(x))
+
+    def test_where_clip(self):
+        x = np.random.randn(3, 3).astype(np.float32)
+        a = nd.array(x)
+        assert_close(nd.clip(a, 0.0, 0.5), np.clip(x, 0.0, 0.5))
+        cond = nd.array((x > 0).astype(np.float32))
+        assert_close(nd.where(cond, a, a * 0), np.where(x > 0, x, 0))
+
+    def test_comparison_dtype(self):
+        a = nd.array([1.0, 2.0, 3.0])
+        b = nd.array([2.0, 2.0, 2.0])
+        out = a > b
+        assert out.dtype == np.float32
+        np.testing.assert_array_equal(out.asnumpy(), [0.0, 0.0, 1.0])
+
+    def test_sequence_mask(self):
+        x = np.ones((4, 2, 3), np.float32)
+        out = nd.SequenceMask(nd.array(x), nd.array([2.0, 4.0]),
+                              use_sequence_length=True, value=-1.0)
+        o = out.asnumpy()
+        assert (o[:2, 0] == 1).all() and (o[2:, 0] == -1).all()
+        assert (o[:, 1] == 1).all()
+
+
+class TestAsync:
+    def test_wait_to_read_and_waitall(self):
+        a = nd.ones((100, 100))
+        b = nd.dot(a, a)
+        b.wait_to_read()
+        mx.waitall()
+        assert_close(b[0, 0], np.array(100.0, np.float32))
+
+    def test_naive_engine_mode(self, monkeypatch):
+        import mxnet_tpu.engine as eng
+
+        prev = eng.engine().is_async()
+        eng.engine().set_async(False)
+        try:
+            a = nd.ones((4, 4))
+            c = a * 2
+            assert_close(c, np.full((4, 4), 2.0))
+        finally:
+            eng.engine().set_async(prev)
+
+
+class TestSaveLoad:
+    def test_save_load_dict(self, tmp_path):
+        f = str(tmp_path / "params")
+        d = {"w": nd.ones((2, 2)), "b": nd.zeros((3,))}
+        nd.save(f, d)
+        loaded = nd.load(f)
+        assert set(loaded) == {"w", "b"}
+        assert_close(loaded["w"], np.ones((2, 2)))
+
+    def test_save_load_list(self, tmp_path):
+        f = str(tmp_path / "arrays")
+        nd.save(f, [nd.ones((2,)), nd.zeros((3,))])
+        loaded = nd.load(f)
+        assert isinstance(loaded, list) and len(loaded) == 2
+
+
+class TestSparseFacade:
+    def test_row_sparse(self):
+        vals = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        rs = mx.nd.sparse.row_sparse_array((vals, [1, 3]), shape=(5, 2))
+        assert rs.stype == "row_sparse"
+        assert rs.shape == (5, 2)
+        np.testing.assert_array_equal(rs.indices.asnumpy(), [1, 3])
+        dense = rs.tostype("default")
+        assert dense.stype == "default"
+        assert float(dense[1, 0].asscalar()) == 1.0

@@ -1,0 +1,247 @@
+"""Per-op numerics (nn + contrib) vs NumPy references.
+
+Models the reference's ``tests/python/unittest/test_operator.py``
+[unverified]: forward parity against NumPy implementations, including
+regression cases found in review (topk mask, reverse reshape, adaptive
+pooling, roi pooling max, out= under autograd).
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def assert_close(a, b, rtol=1e-4, atol=1e-5):
+    np.testing.assert_allclose(
+        a.asnumpy() if isinstance(a, mx.NDArray) else a,
+        b.asnumpy() if isinstance(b, mx.NDArray) else b,
+        rtol=rtol, atol=atol,
+    )
+
+
+class TestNNOps:
+    def test_fully_connected(self):
+        x = np.random.rand(4, 6).astype(np.float32)
+        w = np.random.rand(3, 6).astype(np.float32)
+        b = np.random.rand(3).astype(np.float32)
+        out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b), num_hidden=3)
+        assert_close(out, x @ w.T + b, rtol=1e-3)
+
+    def test_fully_connected_no_flatten_4d(self):
+        x = np.random.rand(2, 5, 6).astype(np.float32)
+        w = np.random.rand(3, 6).astype(np.float32)
+        out = nd.FullyConnected(nd.array(x), nd.array(w), None, num_hidden=3,
+                                no_bias=True, flatten=False)
+        assert out.shape == (2, 5, 3)
+        assert_close(out, x @ w.T, rtol=1e-3)
+
+    def test_convolution_matches_explicit(self):
+        # 1x1 conv == channel mixing matmul
+        x = np.random.rand(2, 3, 5, 5).astype(np.float32)
+        w = np.random.rand(4, 3, 1, 1).astype(np.float32)
+        out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(1, 1),
+                             num_filter=4, no_bias=True)
+        expect = np.einsum("nchw,oc->nohw", x, w[:, :, 0, 0])
+        assert_close(out, expect, rtol=1e-3)
+
+    def test_convolution_padding_stride(self):
+        x = np.random.rand(1, 1, 6, 6).astype(np.float32)
+        w = np.ones((1, 1, 3, 3), np.float32)
+        out = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                             stride=(2, 2), pad=(1, 1), num_filter=1, no_bias=True)
+        assert out.shape == (1, 1, 3, 3)
+        # output (1,1): window starts at 1*stride - pad = 1 -> rows/cols 1:4
+        assert_close(out[0, 0, 1, 1], x[0, 0, 1:4, 1:4].sum(), rtol=1e-3)
+
+    def test_pooling_max_avg(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        mx_max = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="max")
+        assert_close(mx_max, np.array([[[[5, 7], [13, 15]]]], np.float32))
+        mx_avg = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2), pool_type="avg")
+        assert_close(mx_avg, np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32))
+
+    def test_global_pooling(self):
+        x = np.random.rand(2, 3, 4, 4).astype(np.float32)
+        out = nd.Pooling(nd.array(x), global_pool=True, pool_type="avg")
+        assert_close(out, x.mean(axis=(2, 3), keepdims=True), rtol=1e-4)
+
+    def test_batch_norm_training_stats(self):
+        x = np.random.rand(4, 3, 2, 2).astype(np.float32)
+        g = np.ones(3, np.float32)
+        b = np.zeros(3, np.float32)
+        mm, mv = np.zeros(3, np.float32), np.ones(3, np.float32)
+        out, mean, var = nd.BatchNorm(nd.array(x), nd.array(g), nd.array(b),
+                                      nd.array(mm), nd.array(mv),
+                                      fix_gamma=False, training=True, eps=1e-5)
+        assert_close(mean, x.mean(axis=(0, 2, 3)), rtol=1e-4)
+        norm = (x - x.mean((0, 2, 3), keepdims=True).reshape(1, 3, 1, 1)) / np.sqrt(
+            x.var((0, 2, 3)).reshape(1, 3, 1, 1) + 1e-5)
+        assert_close(out, norm, rtol=1e-3, atol=1e-4)
+
+    def test_batch_norm_inference_uses_moving(self):
+        x = np.random.rand(4, 3).astype(np.float32)
+        mm = np.array([0.1, 0.2, 0.3], np.float32)
+        mv = np.array([1.0, 2.0, 3.0], np.float32)
+        out, _, _ = nd.BatchNorm(nd.array(x), nd.ones((3,)), nd.zeros((3,)),
+                                 nd.array(mm), nd.array(mv), training=False,
+                                 fix_gamma=True, eps=1e-5, axis=1)
+        assert_close(out, (x - mm) / np.sqrt(mv + 1e-5), rtol=1e-3)
+
+    def test_dropout_train_vs_predict(self):
+        x = nd.ones((1000,))
+        with autograd.record(train_mode=True):
+            y = nd.Dropout(x, p=0.5)
+        kept = (y.asnumpy() != 0).mean()
+        assert 0.4 < kept < 0.6
+        assert_close(y.asnumpy()[y.asnumpy() != 0], 2.0)
+        y2 = nd.Dropout(x, p=0.5)  # predict mode: identity
+        assert_close(y2, np.ones(1000, np.float32))
+
+    def test_rnn_lstm_shapes(self):
+        T, N, I, H = 5, 2, 3, 4
+        x = nd.random.normal(0, 1, (T, N, I))
+        nparams = 4 * H * (I + H) + 8 * H
+        params = nd.random.normal(0, 0.1, (nparams,))
+        h0 = nd.zeros((1, N, H))
+        c0 = nd.zeros((1, N, H))
+        out, hT, cT = nd.RNN(x, params, h0, c0, state_size=H, num_layers=1,
+                             mode="lstm", state_outputs=True)
+        assert out.shape == (T, N, H)
+        assert hT.shape == (1, N, H)
+        assert cT.shape == (1, N, H)
+
+    def test_rnn_gru_bidirectional(self):
+        T, N, I, H = 3, 2, 3, 4
+        x = nd.random.normal(0, 1, (T, N, I))
+        size_per_dir = 3 * H * (I + H) + 6 * H
+        params = nd.random.normal(0, 0.1, (2 * size_per_dir,))
+        h0 = nd.zeros((2, N, H))
+        out, hT = nd.RNN(x, params, h0, state_size=H, num_layers=1,
+                         bidirectional=True, mode="gru")
+        assert out.shape == (T, N, 2 * H)
+
+    def test_layer_norm_forward(self):
+        x = np.random.rand(2, 5).astype(np.float32)
+        g = np.random.rand(5).astype(np.float32)
+        b = np.random.rand(5).astype(np.float32)
+        out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b), eps=1e-5)
+        m, v = x.mean(-1, keepdims=True), x.var(-1, keepdims=True)
+        assert_close(out, (x - m) / np.sqrt(v + 1e-5) * g + b, rtol=1e-3)
+
+
+class TestContribAttention:
+    def test_selfatt_qk_parity(self):
+        L, B, H, C = 4, 2, 3, 5
+        qkv = np.random.rand(L, B, H * 3 * C).astype(np.float32)
+        out = nd.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+        assert out.shape == (B * H, L, L)
+        x = qkv.reshape(L, B, H, 3, C)
+        q, k = x[..., 0, :], x[..., 1, :]
+        expect = np.einsum("lbhc,mbhc->bhlm", q, k).reshape(B * H, L, L)
+        assert_close(out, expect, rtol=1e-3)
+
+    def test_selfatt_full_attention_equivalence(self):
+        """qk -> softmax -> valatt == straightforward attention."""
+        L, B, H, C = 6, 2, 2, 4
+        qkv = np.random.rand(L, B, H * 3 * C).astype(np.float32)
+        scores = nd.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+        att = nd.softmax(nd.div_sqrt_dim(scores), axis=-1)
+        out = nd.interleaved_matmul_selfatt_valatt(nd.array(qkv), att, heads=H)
+        x = qkv.reshape(L, B, H, 3, C)
+        q, k, v = x[..., 0, :], x[..., 1, :], x[..., 2, :]
+        s = np.einsum("lbhc,mbhc->bhlm", q, k) / np.sqrt(L)  # div_sqrt_dim on last dim L
+        e = np.exp(s - s.max(-1, keepdims=True))
+        a = e / e.sum(-1, keepdims=True)
+        expect = np.einsum("bhlm,mbhc->lbhc", a, v).reshape(L, B, H * C)
+        assert_close(out, expect, rtol=1e-3, atol=1e-4)
+
+    def test_encdec_qk(self):
+        Lq, Lk, B, H, C = 3, 5, 2, 2, 4
+        q = np.random.rand(Lq, B, H * C).astype(np.float32)
+        kv = np.random.rand(Lk, B, H * 2 * C).astype(np.float32)
+        out = nd.interleaved_matmul_encdec_qk(nd.array(q), nd.array(kv), heads=H)
+        assert out.shape == (B * H, Lq, Lk)
+
+
+class TestContribBoxOps:
+    def test_box_iou_identity(self):
+        boxes = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        iou = nd.box_iou(nd.array(boxes), nd.array(boxes))
+        assert_close(np.diag(iou.asnumpy()), np.ones(2), rtol=1e-5)
+        assert abs(iou.asnumpy()[0, 1] - 1.0 / 7.0) < 1e-5
+
+    def test_box_nms_suppresses(self):
+        # [cls_id, score, x1, y1, x2, y2]
+        dets = np.array([
+            [0, 0.9, 0, 0, 2, 2],
+            [0, 0.8, 0.1, 0.1, 2.1, 2.1],  # heavy overlap with first -> suppressed
+            [0, 0.7, 5, 5, 7, 7],
+        ], np.float32)
+        out = nd.box_nms(nd.array(dets), overlap_thresh=0.5, coord_start=2,
+                         score_index=1, id_index=0).asnumpy()
+        assert out[0, 1] == pytest.approx(0.9)
+        assert out[1, 1] == -1.0
+        assert out[2, 1] == pytest.approx(0.7)
+
+    def test_box_decode_roundtrip(self):
+        anchors = np.array([[[0.0, 0.0, 2.0, 2.0]]], np.float32)
+        zero = np.zeros((1, 1, 4), np.float32)
+        out = nd.box_decode(nd.array(zero), nd.array(anchors))
+        assert_close(out, anchors, rtol=1e-5)
+
+
+class TestRegressions:
+    """Cases from code review."""
+
+    def test_topk_mask_per_row(self):
+        x = nd.array([[1.0, 3.0, 2.0], [9.0, 7.0, 8.0]])
+        mask = nd.topk(x, k=1, ret_typ="mask").asnumpy()
+        np.testing.assert_array_equal(mask, [[0, 1, 0], [1, 0, 0]])
+
+    def test_reshape_reverse(self):
+        x = nd.zeros((10, 5, 4))
+        out = nd.Reshape(x, shape=(-1, 0), reverse=True)
+        assert out.shape == (50, 4)
+
+    def test_adaptive_avg_pool(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=2)
+        assert_close(out, np.array([[[[2.5, 4.5], [10.5, 12.5]]]], np.float32))
+        out1 = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=1)
+        assert_close(out1, x.mean().reshape(1, 1, 1, 1))
+
+    def test_roi_pooling_is_max(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+        out = nd.ROIPooling(nd.array(x), nd.array(rois), pooled_size=(1, 1))
+        assert float(out.asscalar()) == 15.0  # exact max over the window
+
+    def test_out_kwarg_keeps_tape(self):
+        a = nd.array([1.0, -2.0])
+        a.attach_grad()
+        buf = nd.zeros((2,))
+        with autograd.record():
+            r = nd.abs(a, out=buf)
+            loss = (r * 2).sum()
+        loss.backward()
+        assert_close(a.grad, np.array([2.0, -2.0]))
+
+    def test_inplace_under_record_raises(self):
+        x = nd.array([1.0])
+        x.attach_grad()
+        with autograd.record():
+            y = x * x
+            with pytest.raises(mx.MXNetError):
+                y += 1.0
+        # leaf mutation outside record is fine
+        x += 1.0
+
+    def test_roi_align_average(self):
+        x = np.ones((1, 1, 4, 4), np.float32)
+        rois = np.array([[0, 0, 0, 3, 3]], np.float32)
+        out = nd.ROIAlign(nd.array(x), nd.array(rois), pooled_size=(2, 2),
+                          spatial_scale=1.0)
+        assert out.shape == (1, 1, 2, 2)
+        assert_close(out, np.ones((1, 1, 2, 2)), rtol=1e-4)
