@@ -25,6 +25,7 @@ from typing import Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as _np
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
@@ -213,7 +214,14 @@ def backward(
                 for extra in cts[1:]:
                     ct = ct + extra
             else:
-                ct = jnp.zeros(shape, dtype)
+                # jax.vjp requires float0 cotangents for non-float outputs
+                # (e.g. argmax/aux int outputs of a staged CachedOp call)
+                if jnp.issubdtype(dtype, jnp.floating) or jnp.issubdtype(
+                    dtype, jnp.complexfloating
+                ):
+                    ct = jnp.zeros(shape, dtype)
+                else:
+                    ct = _np.zeros(shape, jax.dtypes.float0)
             outs.append(ct)
         if not any_ct:
             continue

@@ -1,0 +1,23 @@
+"""Gluon: imperative/hybrid high-level API (reference:
+``python/mxnet/gluon/`` [unverified])."""
+
+from . import parameter
+from .parameter import Parameter, Constant, ParameterDict
+from . import block
+from .block import Block, HybridBlock, SymbolBlock, CachedOp
+from . import nn
+from . import loss
+from . import trainer
+from .trainer import Trainer
+from . import utils
+from . import data
+from . import rnn
+from . import model_zoo
+from . import contrib
+
+__all__ = [
+    "parameter", "Parameter", "Constant", "ParameterDict",
+    "block", "Block", "HybridBlock", "SymbolBlock", "CachedOp",
+    "nn", "loss", "trainer", "Trainer", "utils", "data", "rnn",
+    "model_zoo", "contrib",
+]

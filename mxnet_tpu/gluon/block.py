@@ -1,0 +1,716 @@
+"""Block / HybridBlock (reference: ``python/mxnet/gluon/block.py``
+[unverified]) and the CachedOp analogue (reference:
+``src/imperative/cached_op.cc``).
+
+TPU-first design (SURVEY.md §7): ``hybridize()`` does NOT trace into a
+symbolic IR — it stages the block's (pure) forward through ``jax.jit`` so the
+whole forward becomes one XLA executable. The pieces:
+
+- Parameters enter the staged function as *traced arguments* (via a
+  ``param_override`` scope), so weight updates never retrigger compilation.
+- Stochastic ops draw from a per-call traced PRNG key (``random.key_supply``),
+  keeping dropout random across steps while the program stays pure.
+- Mutable aux states (BatchNorm moving stats) are captured by an "aux sink"
+  during tracing and returned as extra outputs; the wrapper rebinds the real
+  arrays after each call — the functional replacement for the reference's
+  in-place aux writes.
+- Autograd over a staged call records ONE tape node whose vjp is the jitted
+  program's vjp — the analogue of CachedOp's backward graph.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+from collections import OrderedDict
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as nd_namespace
+from .. import random as _random
+from .parameter import (
+    DeferredInitializationError,
+    Parameter,
+    ParameterDict,
+    param_override,
+)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+
+# --------------------------------------------------------- thread-local state
+_TLS = threading.local()
+
+
+def _current_aux_sink():
+    stack = getattr(_TLS, "aux_stack", None)
+    return stack[-1] if stack else None
+
+
+class _aux_scope:
+    def __init__(self, sink):
+        self._sink = sink
+
+    def __enter__(self):
+        if not hasattr(_TLS, "aux_stack"):
+            _TLS.aux_stack = []
+        _TLS.aux_stack.append(self._sink)
+        return self._sink
+
+    def __exit__(self, *exc):
+        _TLS.aux_stack.pop()
+        return False
+
+
+def _in_trace() -> bool:
+    return getattr(_TLS, "trace_depth", 0) > 0
+
+
+def _in_probe() -> bool:
+    return getattr(_TLS, "probe", False)
+
+
+class _probe_scope:
+    """Shape-inference probe: layers resolve deferred *shapes* but must not
+    materialize parameter arrays (the probe runs under jax.eval_shape, where
+    any array created would be a tracer and leak)."""
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "probe", False)
+        _TLS.probe = True
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.probe = self._prev
+        return False
+
+
+class _trace_scope:
+    """Marks 'we are inside a CachedOp trace': nested hybridized children run
+    their eager bodies (the whole subtree belongs to one XLA program)."""
+
+    def __enter__(self):
+        _TLS.trace_depth = getattr(_TLS, "trace_depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.trace_depth -= 1
+        return False
+
+
+# ------------------------------------------------------------------ namescope
+class _BlockScope:
+    """Counter-based auto-naming (reference: ``_BlockScope``)."""
+
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = hint + "0_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = f"{hint}{count}_"
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, ptype, value, trace):
+        if self._block._empty_prefix:
+            return
+        _BlockScope._current.value = self._old_scope
+
+
+# ----------------------------------------------------------------------- Block
+class Block:
+    """Base container for layers and models (imperative path)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params, self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+        self._hook_counter = 0
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join(
+            f"  ({key}): {_indent(repr(block), 2)}"
+            for key, block in self._children.items()
+        )
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        """Register Parameters and child Blocks (reference behavior)."""
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and not isinstance(
+                value, type(existing)
+            ):
+                raise TypeError(
+                    f"changing attribute type for {name} from {type(existing)} "
+                    f"to {type(value)} is not allowed"
+                )
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or self._reg_params[name] is value, (
+                "Overriding Parameter attribute %s is not allowed. "
+                "If you want to share parameters between blocks, please set "
+                "'params' at Block construction instead." % name
+            )
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    def _check_container_with_block(self):
+        pass
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None) -> ParameterDict:
+        self._check_container_with_block()
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update(
+                {
+                    name: value
+                    for name, value in self.params.items()
+                    if pattern.match(name)
+                }
+            )
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        handle = _HookHandle(self._forward_pre_hooks, self._hook_counter)
+        self._forward_pre_hooks[self._hook_counter] = hook
+        self._hook_counter += 1
+        return handle
+
+    def register_forward_hook(self, hook):
+        handle = _HookHandle(self._forward_hooks, self._hook_counter)
+        self._forward_hooks[self._hook_counter] = hook
+        self._hook_counter += 1
+        return handle
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        from .. import initializer
+
+        if init is None:
+            init = initializer.Uniform()
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def zero_grad(self):
+        self.collect_params().zero_grad()
+
+    # -------------------------------------------------------------- save/load
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        if deduplicate:
+            seen = {}
+            out = {}
+            for name, param in params.items():
+                if id(param) in seen:
+                    continue
+                seen[id(param)] = name
+                out[name] = param
+            params = out
+        arg_dict = {name: param._check_and_get() for name, param in params.items()}
+        from ..ndarray import save as nd_save
+
+        nd_save(filename, arg_dict)
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + name: param for name, param in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..ndarray import load as nd_load
+
+        loaded = nd_load(filename)
+        params = self._collect_params_with_prefix()
+        if not loaded and not params:
+            return
+        if not any("." in k for k in loaded.keys()):
+            # legacy full-name format saved via ParameterDict.save
+            del loaded
+            self.collect_params().load(
+                filename, ctx, allow_missing, ignore_extra, self.prefix,
+                cast_dtype=cast_dtype, dtype_source=dtype_source,
+            )
+            return
+        if not allow_missing:
+            for name in params.keys():
+                if name not in loaded:
+                    raise MXNetError(
+                        f"parameter {name} missing in {filename}; "
+                        "set allow_missing=True to skip"
+                    )
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"parameter {name} from {filename} not found in model; "
+                        "set ignore_extra=True to skip"
+                    )
+                continue
+            params[name].set_data(loaded[name])
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # ------------------------------------------------------------------ call
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        """Print a per-layer summary (reference: ``Block.summary``)."""
+        summary_rec = OrderedDict()
+        hooks = []
+
+        def _make_hook(name, block):
+            def hook(_blk, _in, out):
+                outs = out if isinstance(out, (list, tuple)) else [out]
+                shapes = [tuple(o.shape) for o in outs if isinstance(o, NDArray)]
+                n_params = sum(
+                    int(_np.prod(p.shape))
+                    for p in block._reg_params.values()
+                    if p._shape_known()
+                )
+                summary_rec[name] = (block.__class__.__name__, shapes, n_params)
+
+            return hook
+
+        for name, child in self._flat_children():
+            hooks.append(child.register_forward_hook(_make_hook(name, child)))
+        try:
+            self(*inputs)
+        finally:
+            for h in hooks:
+                h.detach()
+        lines = [
+            f"{'Layer (type)':<40}{'Output Shape':<30}{'Param #':<12}",
+            "=" * 82,
+        ]
+        total = 0
+        for name, (cls, shapes, n) in summary_rec.items():
+            lines.append(f"{name + ' (' + cls + ')':<40}{str(shapes):<30}{n:<12}")
+            total += n
+        lines.append("=" * 82)
+        lines.append(f"Total params: {total}")
+        print("\n".join(lines))
+
+    def _flat_children(self, prefix=""):
+        for name, child in self._children.items():
+            path = f"{prefix}{name}"
+            yield path, child
+            yield from child._flat_children(path + ".")
+
+
+class _HookHandle:
+    def __init__(self, hooks_dict, hook_id):
+        self._hooks_dict = hooks_dict
+        self._id = hook_id
+
+    def detach(self):
+        self._hooks_dict.pop(self._id, None)
+
+
+def _indent(s, num_spaces):
+    lines = s.split("\n")
+    if len(lines) == 1:
+        return s
+    first = lines.pop(0)
+    return first + "\n" + "\n".join(" " * num_spaces + line for line in lines)
+
+
+# ------------------------------------------------------------------- CachedOp
+class _StagedHolder:
+    """Per-(mode, structure) trace metadata captured during jit tracing."""
+
+    __slots__ = ("fn", "n_out", "out_treedef", "aux_params")
+
+    def __init__(self):
+        self.fn = None
+        self.n_out = None
+        self.out_treedef = None
+        self.aux_params = None
+
+
+def _is_nd(x):
+    return isinstance(x, NDArray)
+
+
+class CachedOp:
+    """Stages a Block's forward through ``jax.jit`` (reference:
+    ``src/imperative/cached_op.cc``; ``static_alloc``/``static_shape`` map to
+    XLA's buffer management and are accepted as no-ops)."""
+
+    def __init__(self, block: "HybridBlock", flags=()):
+        self._block = block
+        self._flags = dict(flags)
+        self._param_list = None  # ordered [(name, Parameter)]
+        self._staged = {}  # (training, in_treedef) -> _StagedHolder
+
+    def _collect(self):
+        if self._param_list is None:
+            self._param_list = list(self._block.collect_params().items())
+        return self._param_list
+
+    def _make_staged(self, training: bool, in_treedef):
+        from .. import autograd
+
+        holder = _StagedHolder()
+        params = [p for _, p in self._collect()]
+        n_params = len(params)
+        block = self._block
+
+        def staged(*flat):
+            key = flat[-1]
+            param_datas = flat[:n_params]
+            input_datas = flat[n_params:-1]
+            mapping = {p: NDArray(d) for p, d in zip(params, param_datas)}
+            inputs = jax.tree.unflatten(
+                in_treedef, [NDArray(d) for d in input_datas]
+            )
+            sink = OrderedDict()
+            with param_override(mapping), _random.key_supply(key), _aux_scope(
+                sink
+            ), _trace_scope(), autograd._scope(False, training):
+                out = block.forward(*inputs)
+            out_nds, out_tree = jax.tree.flatten(
+                out, is_leaf=_is_nd
+            )
+            holder.out_treedef = out_tree
+            holder.n_out = len(out_nds)
+            holder.aux_params = list(sink.keys())
+            flat_out = tuple(
+                o.data if isinstance(o, NDArray) else jnp.asarray(o)
+                for o in out_nds
+            )
+            return flat_out + tuple(sink[p] for p in holder.aux_params)
+
+        holder.fn = jax.jit(staged)
+        return holder
+
+    def __call__(self, *inputs):
+        from .. import autograd
+
+        input_nds, in_treedef = jax.tree.flatten(inputs, is_leaf=_is_nd)
+        if not all(isinstance(i, NDArray) for i in input_nds):
+            input_nds = [
+                i if isinstance(i, NDArray) else NDArray(jnp.asarray(i))
+                for i in input_nds
+            ]
+        training = autograd.is_training()
+        cache_key = (training, in_treedef)
+        holder = self._staged.get(cache_key)
+        if holder is None:
+            holder = self._make_staged(training, in_treedef)
+            self._staged[cache_key] = holder
+        params = [p for _, p in self._collect()]
+        param_nds = [p.data() for p in params]
+        key = _random.next_key()
+        flat_args = [n.data for n in param_nds] + [n.data for n in input_nds] + [key]
+
+        all_in_nds = param_nds + input_nds
+        if autograd.is_recording() and any(
+            autograd._is_tracked(a) for a in all_in_nds
+        ):
+            outs_flat, vjp_fn = jax.vjp(holder.fn, *flat_args)
+            # untracked inputs (e.g. labels) and the PRNG key become None
+            node_inputs = [
+                a if autograd._is_tracked(a) else None for a in all_in_nds
+            ] + [None]
+            avals = [(o.shape, o.dtype) for o in outs_flat]
+            node = autograd._Node(vjp_fn, node_inputs, avals, multi_out=True)
+            out_nds = []
+            for i, o in enumerate(outs_flat):
+                ndo = NDArray(o)
+                autograd._mark_output(ndo, node, i)
+                out_nds.append(ndo)
+        else:
+            outs_flat = holder.fn(*flat_args)
+            out_nds = [NDArray(o) for o in outs_flat]
+
+        primary = out_nds[: holder.n_out]
+        aux_vals = out_nds[holder.n_out :]
+        for p_aux, val in zip(holder.aux_params, aux_vals):
+            p_aux._data._rebind(val.data)
+        return jax.tree.unflatten(holder.out_treedef, primary)
+
+
+# ---------------------------------------------------------------- HybridBlock
+class HybridBlock(Block):
+    """A Block whose forward can be staged into one XLA program.
+
+    Subclasses implement ``hybrid_forward(self, F, x, *args, **params)``
+    where F is the op namespace and params are this block's registered
+    parameters resolved to NDArrays (reference API preserved; F is always
+    the ``nd`` namespace here since there is no symbolic mode)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._flags = []
+        self._cached_op = None
+
+    def __setattr__(self, name, value):
+        super().__setattr__(name, value)
+        if isinstance(value, HybridBlock):
+            self._clear_cached_op()
+
+    def _clear_cached_op(self):
+        self._cached_op = None
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None):
+        self._active = active
+        self._flags = [("static_alloc", static_alloc), ("static_shape", static_shape)]
+        self._clear_cached_op()
+        # children run inside the parent's trace; still record their flags
+        super().hybridize(
+            active,
+            static_alloc=static_alloc,
+            static_shape=static_shape,
+            inline_limit=inline_limit,
+        )
+
+    def cast(self, dtype):
+        self._clear_cached_op()
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from example inputs. Layers with
+        deferred params override this; container blocks resolve via a
+        shape-only abstract forward (``jax.eval_shape``)."""
+        self._probe_shapes(*args)
+
+    def _probe_shapes(self, *args):
+        from .. import autograd
+
+        def run(*datas):
+            wrapped = jax.tree.unflatten(
+                jax.tree.structure(args, is_leaf=_is_nd),
+                [NDArray(d) for d in datas],
+            )
+            with autograd._scope(False, False), _trace_scope(), _probe_scope():
+                self.forward(*wrapped)
+            return jnp.zeros(())
+
+        flat = [a.data for a in jax.tree.leaves(args, is_leaf=_is_nd)]
+        jax.eval_shape(run, *flat)
+        # shapes are now known; materialize for real OUTSIDE the trace
+        for _, p in self.collect_params().items():
+            p._finish_deferred_init()
+
+    def _deferred_pending(self) -> bool:
+        for _, p in self.collect_params().items():
+            if p._data is None:
+                return True
+        return False
+
+    def forward(self, x, *args):
+        if self._active and not _in_trace():
+            if not getattr(self, "_params_ready", False):
+                if self._deferred_pending():
+                    self._probe_shapes(x, *args)
+                object.__setattr__(self, "_params_ready", True)
+            if self._cached_op is None:
+                self._cached_op = CachedOp(self, self._flags)
+            return self._cached_op(x, *args)
+        # eager path (also the body that gets traced by CachedOp)
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self.infer_shape(x, *args)
+            if _in_probe():
+                # shape probe: shapes resolved above; placeholders stand in
+                # for the real arrays (created after the probe, untraced)
+                params = {}
+                for name, p in self._reg_params.items():
+                    try:
+                        params[name] = p.data()
+                    except (DeferredInitializationError, MXNetError):
+                        params[name] = NDArray(
+                            jnp.zeros(tuple(p._shape), jnp.dtype(p._dtype))
+                        )
+            else:
+                for p in self._reg_params.values():
+                    p._finish_deferred_init()
+                params = {
+                    name: p.data() for name, p in self._reg_params.items()
+                }
+        return self.hybrid_forward(nd_namespace, x, *args, **params)
+
+    def hybrid_forward(self, F, x, *args, **kwargs):  # pragma: no cover
+        raise NotImplementedError
+
+    # -------------------------------------------------------------- export
+    def export(self, path, epoch=0):
+        """Serialize the staged program + params for deployment (reference:
+        ``HybridBlock.export`` -> model-symbol.json + model-0000.params).
+
+        Writes ``{path}-symbol.json`` (graph metadata incl. serialized
+        StableHLO when jax.export is available) and
+        ``{path}-{epoch:04d}.params``."""
+        if not self._active or self._cached_op is None or not self._cached_op._staged:
+            raise MXNetError(
+                "run at least one forward after hybridize() before export"
+            )
+        params_file = f"{path}-{epoch:04d}.params"
+        arg_dict = {
+            f"arg:{name}": p.data()
+            for name, p in self._cached_op._collect()
+            if p.grad_req != "null"
+        }
+        arg_dict.update(
+            {
+                f"aux:{name}": p.data()
+                for name, p in self._cached_op._collect()
+                if p.grad_req == "null"
+            }
+        )
+        from ..ndarray import save as nd_save
+
+        nd_save(params_file, arg_dict)
+        meta = {
+            "format": "mxnet_tpu-export-v1",
+            "params": params_file,
+            "param_names": [n for n, _ in self._cached_op._collect()],
+            "class": type(self).__name__,
+        }
+        # serialize the compiled program when jax.export is present
+        try:
+            from jax import export as jax_export
+
+            (training, in_treedef), holder = next(iter(self._cached_op._staged.items()))
+            meta["stablehlo"] = f"{path}-symbol.mlir"
+            # re-export on example avals is done lazily by SymbolBlock
+        except ImportError:
+            pass
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(meta, f, indent=2)
+        return f"{path}-symbol.json", params_file
+
+
+class SymbolBlock(HybridBlock):
+    """Load an exported model (reference: ``SymbolBlock.imports``). The TPU
+    build reconstructs from the params file + user-supplied forward function
+    (arbitrary Python cannot be round-tripped through JSON; compiled StableHLO
+    deployment is served by ``jax.export`` separately)."""
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        super().__init__(prefix="", params=None)
+        self._fn = outputs  # a callable(params_dict, *inputs)
+        self._loaded = params or {}
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        if meta.get("format") != "mxnet_tpu-export-v1":
+            raise MXNetError(f"unrecognized export format in {symbol_file}")
+        from ..ndarray import load as nd_load
+
+        params = nd_load(param_file or meta["params"])
+        blk = SymbolBlock(params=params)
+        return blk
+
+    def forward(self, *args):
+        if self._fn is None:
+            raise MXNetError(
+                "this SymbolBlock holds parameters only; attach a forward "
+                "callable or rebuild the model class and load_parameters"
+            )
+        return self._fn(self._loaded, *args)

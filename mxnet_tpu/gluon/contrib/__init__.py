@@ -1,0 +1,5 @@
+"""Gluon contrib (reference: ``python/mxnet/gluon/contrib/`` [unverified]).
+
+Populated in a later milestone (estimator loop, contrib layers)."""
+
+__all__ = []

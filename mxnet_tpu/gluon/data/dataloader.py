@@ -1,0 +1,105 @@
+"""DataLoader (reference: ``python/mxnet/gluon/data/dataloader.py``
+[unverified]).
+
+The reference forked worker *processes* that rebuilt NDArrays in shared
+memory. Here batches are host-side numpy until the device feed (a jax
+device_put at the end), so worker *threads* suffice: decode/augment/batchify
+release the GIL inside numpy, and the thread pool + bounded prefetch queue
+reproduces the reference's ``ThreadedIter`` pipeline without fork-unsafe
+interaction with the TPU runtime (the reference itself had engine-fork
+handlers for exactly that hazard)."""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as _np
+
+from ...ndarray.ndarray import NDArray
+from ...ndarray import array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (reference: ``default_batchify_fn``)."""
+    if isinstance(data[0], NDArray):
+        return nd_array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], (tuple, list)):
+        return [default_batchify_fn(list(i)) for i in zip(*data)]
+    data = _np.asarray(data)
+    return nd_array(data)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, pin_device_id=0,
+                 prefetch=None, thread_pool=False, timeout=120):
+        self._dataset = dataset
+        self._timeout = timeout
+        if batch_sampler is None:
+            if batch_size is None:
+                raise ValueError(
+                    "batch_size must be specified unless batch_sampler is"
+                )
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise ValueError("shuffle must not be specified if sampler is")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        elif (batch_size is not None or shuffle or sampler is not None
+              or last_batch is not None):
+            raise ValueError(
+                "batch_size, shuffle, sampler and last_batch must not be "
+                "specified if batch_sampler is"
+            )
+        self._batch_sampler = batch_sampler
+        self._num_workers = max(0, num_workers)
+        self._prefetch = max(
+            0, int(prefetch) if prefetch is not None else 2 * self._num_workers
+        )
+        self._batchify_fn = batchify_fn or default_batchify_fn
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _load(self, indices):
+        return self._batchify_fn([self._dataset[i] for i in indices])
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._load(indices)
+            return
+        # threaded pipeline: submit up to `prefetch` batches ahead
+        with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
+            # bounded queue: feeder blocks when `prefetch` batches are pending
+            futures = queue.Queue(maxsize=self._prefetch + 1)
+            it = iter(self._batch_sampler)
+            stop = threading.Event()
+
+            def feeder():
+                try:
+                    for indices in it:
+                        if stop.is_set():
+                            return
+                        futures.put(pool.submit(self._load, indices))
+                finally:
+                    futures.put(None)
+
+            t = threading.Thread(target=feeder, daemon=True)
+            t.start()
+            try:
+                while True:
+                    fut = futures.get()
+                    if fut is None:
+                        break
+                    yield fut.result(timeout=self._timeout)
+            finally:
+                stop.set()

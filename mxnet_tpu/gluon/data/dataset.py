@@ -1,0 +1,136 @@
+"""Datasets (reference: ``python/mxnet/gluon/data/dataset.py`` [unverified])."""
+
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["Dataset", "SimpleDataset", "ArrayDataset", "RecordFileDataset"]
+
+
+class Dataset:
+    def __getitem__(self, idx):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def __len__(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def filter(self, fn):
+        return SimpleDataset([i for i in self if fn(i)])
+
+    def shard(self, num_shards, index):
+        assert 0 <= index < num_shards
+        length = len(self)
+        shard_len = length // num_shards
+        rest = length % num_shards
+        start = shard_len * index + min(index, rest)
+        end = start + shard_len + (index < rest)
+        return SimpleDataset([self[i] for i in range(start, end)])
+
+    def take(self, count):
+        if count is None or count > len(self):
+            count = len(self)
+        return SimpleDataset([self[i] for i in range(count)])
+
+    def transform(self, fn, lazy=True):
+        trans = _LazyTransformDataset(self, fn)
+        if lazy:
+            return trans
+        return SimpleDataset([trans[i] for i in range(len(trans))])
+
+    def transform_first(self, fn, lazy=True):
+        return self.transform(_TransformFirstClosure(fn), lazy)
+
+
+class SimpleDataset(Dataset):
+    def __init__(self, data):
+        self._data = data
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+
+class _LazyTransformDataset(Dataset):
+    def __init__(self, data, fn):
+        self._data = data
+        self._fn = fn
+
+    def __len__(self):
+        return len(self._data)
+
+    def __getitem__(self, idx):
+        item = self._data[idx]
+        if isinstance(item, tuple):
+            return self._fn(*item)
+        return self._fn(item)
+
+
+class _TransformFirstClosure:
+    def __init__(self, fn):
+        self._fn = fn
+
+    def __call__(self, x, *args):
+        if args:
+            return (self._fn(x),) + args
+        return self._fn(x)
+
+
+class ArrayDataset(Dataset):
+    """Zip of array-likes (reference: ``ArrayDataset``)."""
+
+    def __init__(self, *args):
+        assert len(args) > 0
+        self._length = len(args[0])
+        self._data = []
+        for i, data in enumerate(args):
+            assert len(data) == self._length, (
+                f"All arrays must have the same length; {len(data)} != "
+                f"{self._length} at position {i}"
+            )
+            if isinstance(data, Dataset):
+                self._data.append(data)
+            else:
+                self._data.append(_ArrayWrapper(data))
+
+    def __len__(self):
+        return self._length
+
+    def __getitem__(self, idx):
+        if len(self._data) == 1:
+            return self._data[0][idx]
+        return tuple(d[idx] for d in self._data)
+
+
+class _ArrayWrapper:
+    def __init__(self, data):
+        self._data = data
+
+    def __getitem__(self, idx):
+        return self._data[idx]
+
+    def __len__(self):
+        return len(self._data)
+
+
+class RecordFileDataset(Dataset):
+    """Dataset over a RecordIO file (reference: ``RecordFileDataset`` over
+    ``dmlc::RecordIOReader``). Uses the native recordio module."""
+
+    def __init__(self, filename):
+        self._filename = filename
+        idx_file = os.path.splitext(filename)[0] + ".idx"
+        if not os.path.exists(filename):
+            raise MXNetError(f"record file {filename} not found")
+        from ...recordio import IndexedRecordIO
+
+        self._record = IndexedRecordIO(idx_file, filename, "r")
+
+    def __getitem__(self, idx):
+        return self._record.read_idx(self._record.keys[idx])
+
+    def __len__(self):
+        return len(self._record.keys)

@@ -1,0 +1,8 @@
+"""Vision datasets + transforms (reference:
+``python/mxnet/gluon/data/vision/`` [unverified])."""
+
+from .datasets import *  # noqa: F401,F403
+from . import transforms  # noqa: F401
+from . import datasets
+
+__all__ = datasets.__all__ + ["transforms"]

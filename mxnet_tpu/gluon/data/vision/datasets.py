@@ -1,0 +1,192 @@
+"""Vision datasets (reference: ``gluon/data/vision/datasets.py``
+[unverified]).
+
+Zero-egress environment: datasets read standard files from ``root`` (the
+reference's download cache layout); ``download`` raises with instructions if
+files are absent. MNIST/FashionMNIST parse the IDX format; CIFAR10/100 parse
+the python pickle batches.
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import pickle
+import struct
+
+import numpy as _np
+
+from ....base import MXNetError
+from ....ndarray import array as nd_array
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100", "ImageFolderDataset"]
+
+
+def _base_mnist_dir():
+    return os.path.join(
+        os.environ.get("MXNET_HOME", os.path.expanduser("~/.mxnet")), "datasets"
+    )
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        root = os.path.expanduser(root)
+        self._root = root
+        if not os.path.isdir(root):
+            os.makedirs(root, exist_ok=True)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST handwritten digits. Expects IDX files (optionally .gz) in root."""
+
+    _files = {
+        True: ("train-images-idx3-ubyte", "train-labels-idx1-ubyte"),
+        False: ("t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"),
+    }
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(_base_mnist_dir(), "mnist")
+        super().__init__(root, transform)
+
+    def _open(self, path):
+        if os.path.exists(path):
+            return open(path, "rb")
+        if os.path.exists(path + ".gz"):
+            return gzip.open(path + ".gz", "rb")
+        raise MXNetError(
+            f"MNIST file {path}[.gz] not found; this environment has no "
+            f"network egress — place the IDX files under {self._root}"
+        )
+
+    def _get_data(self):
+        image_file, label_file = self._files[self._train]
+        with self._open(os.path.join(self._root, label_file)) as fin:
+            magic, num = struct.unpack(">II", fin.read(8))
+            label = _np.frombuffer(fin.read(), dtype=_np.uint8).astype(_np.int32)
+        with self._open(os.path.join(self._root, image_file)) as fin:
+            magic, num, rows, cols = struct.unpack(">IIII", fin.read(16))
+            data = _np.frombuffer(fin.read(), dtype=_np.uint8)
+            data = data.reshape(num, rows, cols, 1)
+        self._data = data
+        self._label = label
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=None, train=True, transform=None):
+        root = root or os.path.join(_base_mnist_dir(), "fashion-mnist")
+        MNIST.__init__(self, root=root, train=train, transform=transform)
+
+
+class CIFAR10(_DownloadedDataset):
+    """CIFAR10 from the python pickle batches in root/cifar-10-batches-py."""
+
+    def __init__(self, root=None, train=True, transform=None):
+        self._train = train
+        root = root or os.path.join(_base_mnist_dir(), "cifar10")
+        super().__init__(root, transform)
+
+    def _batches(self):
+        if self._train:
+            return [f"data_batch_{i}" for i in range(1, 6)]
+        return ["test_batch"]
+
+    def _get_data(self):
+        folder = os.path.join(self._root, "cifar-10-batches-py")
+        if not os.path.isdir(folder):
+            folder = self._root
+        data, labels = [], []
+        for name in self._batches():
+            path = os.path.join(folder, name)
+            if not os.path.exists(path):
+                raise MXNetError(
+                    f"CIFAR batch {path} not found; no network egress — "
+                    f"extract cifar-10-python.tar.gz under {self._root}"
+                )
+            with open(path, "rb") as f:
+                batch = pickle.load(f, encoding="latin1")
+            data.append(
+                batch["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+            )
+            labels.extend(batch["labels"])
+        self._data = _np.concatenate(data).astype(_np.uint8)
+        self._label = _np.asarray(labels, dtype=_np.int32)
+
+
+class CIFAR100(_DownloadedDataset):
+    def __init__(self, root=None, fine_label=False, train=True, transform=None):
+        self._train = train
+        self._fine_label = fine_label
+        root = root or os.path.join(_base_mnist_dir(), "cifar100")
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        folder = os.path.join(self._root, "cifar-100-python")
+        if not os.path.isdir(folder):
+            folder = self._root
+        name = "train" if self._train else "test"
+        path = os.path.join(folder, name)
+        if not os.path.exists(path):
+            raise MXNetError(
+                f"CIFAR100 batch {path} not found; no network egress — "
+                f"extract cifar-100-python.tar.gz under {self._root}"
+            )
+        with open(path, "rb") as f:
+            batch = pickle.load(f, encoding="latin1")
+        self._data = (
+            batch["data"].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        ).astype(_np.uint8)
+        key = "fine_labels" if self._fine_label else "coarse_labels"
+        self._label = _np.asarray(batch[key], dtype=_np.int32)
+
+
+class ImageFolderDataset(Dataset):
+    """root/category/image.jpg layout (reference: ``ImageFolderDataset``)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image as img_mod
+
+        img = img_mod.imread(self.items[idx][0], self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,263 @@
+"""Vision transforms (reference: ``gluon/data/vision/transforms.py``
+[unverified]). Transforms run host-side on numpy/NDArray samples before the
+device feed; shapes are HWC uint8 in, like the reference."""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray
+from ....ndarray import array as nd_array
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = [
+    "Compose", "Cast", "ToTensor", "Normalize", "RandomResizedCrop",
+    "CenterCrop", "Resize", "RandomFlipLeftRight", "RandomFlipTopBottom",
+    "RandomBrightness", "RandomContrast", "RandomSaturation", "RandomLighting",
+    "RandomColorJitter", "RandomCrop",
+]
+
+
+def _to_numpy(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class Compose(Sequential):
+    """Chain transforms (reference: ``transforms.Compose``)."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(Block):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def forward(self, x):
+        return nd_array(_to_numpy(x).astype(self._dtype))
+
+
+class ToTensor(Block):
+    """HWC uint8 [0,255] -> CHW float32 [0,1]."""
+
+    def forward(self, x):
+        x = _to_numpy(x).astype(_np.float32) / 255.0
+        if x.ndim == 3:
+            x = x.transpose(2, 0, 1)
+        elif x.ndim == 4:
+            x = x.transpose(0, 3, 1, 2)
+        return nd_array(x)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype=_np.float32)
+        self._std = _np.asarray(std, dtype=_np.float32)
+
+    def forward(self, x):
+        x = _to_numpy(x)
+        mean = self._mean.reshape((-1, 1, 1)) if self._mean.ndim else self._mean
+        std = self._std.reshape((-1, 1, 1)) if self._std.ndim else self._std
+        return nd_array((x - mean) / std)
+
+
+def _resize(img, size, interp=1):
+    """Nearest/bilinear resize on HWC numpy (no cv2 dependency)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        ow, oh = size, size
+    else:
+        ow, oh = size
+    if (oh, ow) == (h, w):
+        return img
+    y = _np.linspace(0, h - 1, oh)
+    x = _np.linspace(0, w - 1, ow)
+    if interp == 0:  # nearest
+        yi = _np.round(y).astype(int)
+        xi = _np.round(x).astype(int)
+        return img[yi][:, xi]
+    y0 = _np.floor(y).astype(int)
+    x0 = _np.floor(x).astype(int)
+    y1 = _np.minimum(y0 + 1, h - 1)
+    x1 = _np.minimum(x0 + 1, w - 1)
+    wy = (y - y0)[:, None, None]
+    wx = (x - x0)[None, :, None]
+    img_f = img.astype(_np.float32)
+    top = img_f[y0][:, x0] * (1 - wx) + img_f[y0][:, x1] * wx
+    bot = img_f[y1][:, x0] * (1 - wx) + img_f[y1][:, x1] * wx
+    out = top * (1 - wy) + bot * wy
+    return out.astype(img.dtype)
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _to_numpy(x)
+        size = self._size
+        if self._keep and isinstance(size, int):
+            h, w = img.shape[:2]
+            if h < w:
+                size = (int(w * size / h), size)
+            else:
+                size = (size, int(h * size / w))
+        return nd_array(_resize(img, size, self._interpolation))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _to_numpy(x)
+        h, w = img.shape[:2]
+        cw, ch = self._size
+        if h < ch or w < cw:
+            img = _resize(img, (max(cw, w), max(ch, h)), self._interpolation)
+            h, w = img.shape[:2]
+        y0 = (h - ch) // 2
+        x0 = (w - cw) // 2
+        return nd_array(img[y0 : y0 + ch, x0 : x0 + cw])
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _to_numpy(x)
+        if self._pad:
+            p = self._pad
+            img = _np.pad(img, ((p, p), (p, p), (0, 0)), mode="constant")
+        h, w = img.shape[:2]
+        cw, ch = self._size
+        if h < ch or w < cw:
+            img = _resize(img, (max(cw, w), max(ch, h)), self._interpolation)
+            h, w = img.shape[:2]
+        y0 = _np.random.randint(0, h - ch + 1)
+        x0 = _np.random.randint(0, w - cw + 1)
+        return nd_array(img[y0 : y0 + ch, x0 : x0 + cw])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+        self._interpolation = interpolation
+
+    def forward(self, x):
+        img = _to_numpy(x)
+        h, w = img.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            log_ratio = (_np.log(self._ratio[0]), _np.log(self._ratio[1]))
+            aspect = _np.exp(_np.random.uniform(*log_ratio))
+            cw = int(round(_np.sqrt(target_area * aspect)))
+            ch = int(round(_np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                y0 = _np.random.randint(0, h - ch + 1)
+                x0 = _np.random.randint(0, w - cw + 1)
+                crop = img[y0 : y0 + ch, x0 : x0 + cw]
+                return nd_array(_resize(crop, self._size, self._interpolation))
+        # fallback: center crop
+        return CenterCrop(self._size, self._interpolation).forward(nd_array(img))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        img = _to_numpy(x)
+        if _np.random.rand() < 0.5:
+            img = img[:, ::-1]
+        return nd_array(_np.ascontiguousarray(img))
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        img = _to_numpy(x)
+        if _np.random.rand() < 0.5:
+            img = img[::-1]
+        return nd_array(_np.ascontiguousarray(img))
+
+
+class _RandomJitter(Block):
+    def __init__(self, brightness=0, contrast=0, saturation=0):
+        super().__init__()
+        self._b = brightness
+        self._c = contrast
+        self._s = saturation
+
+    def forward(self, x):
+        img = _to_numpy(x).astype(_np.float32)
+        if self._b:
+            alpha = 1.0 + _np.random.uniform(-self._b, self._b)
+            img = img * alpha
+        if self._c:
+            alpha = 1.0 + _np.random.uniform(-self._c, self._c)
+            gray_mean = img.mean()
+            img = img * alpha + gray_mean * (1 - alpha)
+        if self._s:
+            alpha = 1.0 + _np.random.uniform(-self._s, self._s)
+            gray = img @ _np.array([0.299, 0.587, 0.114], _np.float32)
+            img = img * alpha + gray[..., None] * (1 - alpha)
+        return nd_array(_np.clip(img, 0, 255))
+
+
+class RandomBrightness(_RandomJitter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+
+
+class RandomContrast(_RandomJitter):
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+
+
+class RandomSaturation(_RandomJitter):
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+
+
+class RandomColorJitter(_RandomJitter):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__(brightness, contrast, saturation)
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA noise."""
+
+    _eigval = _np.array([55.46, 4.794, 1.148], _np.float32)
+    _eigvec = _np.array(
+        [[-0.5675, 0.7192, 0.4009],
+         [-0.5808, -0.0045, -0.8140],
+         [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        img = _to_numpy(x).astype(_np.float32)
+        alpha = _np.random.normal(0, self._alpha, size=(3,)).astype(_np.float32)
+        rgb = (self._eigvec * alpha * self._eigval).sum(axis=1)
+        return nd_array(_np.clip(img + rgb, 0, 255))

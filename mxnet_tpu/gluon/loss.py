@@ -1,0 +1,370 @@
+"""Loss blocks (reference: ``python/mxnet/gluon/loss.py`` [unverified])."""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .block import HybridBlock
+
+__all__ = [
+    "Loss",
+    "L2Loss",
+    "L1Loss",
+    "SigmoidBinaryCrossEntropyLoss",
+    "SigmoidBCELoss",
+    "SoftmaxCrossEntropyLoss",
+    "SoftmaxCELoss",
+    "KLDivLoss",
+    "CTCLoss",
+    "HuberLoss",
+    "HingeLoss",
+    "SquaredHingeLoss",
+    "LogisticLoss",
+    "TripletLoss",
+    "PoissonNLLLoss",
+    "CosineEmbeddingLoss",
+]
+
+
+def _apply_weighting(F, loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        assert isinstance(weight, (int, float)), "weight must be a number"
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(F, x, y):
+    return x.reshape(y.shape)
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{self.__class__.__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+    def hybrid_forward(self, F, x, *args, **kwargs):  # pragma: no cover
+        raise NotImplementedError
+
+
+def _batch_mean(F, loss, batch_axis):
+    axes = tuple(i for i in range(loss.ndim) if i != batch_axis)
+    return loss.mean(axis=axes) if axes else loss
+
+
+class L2Loss(Loss):
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(label - pred)
+        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None, pos_weight=None):
+        label = _reshape_like(F, label, pred)
+        if not self._from_sigmoid:
+            if pos_weight is None:
+                loss = F.relu(pred) - pred * label + F.Activation(
+                    -F.abs(pred), act_type="softrelu"
+                )
+            else:
+                log_weight = 1 + (pos_weight - 1) * label
+                loss = (
+                    pred
+                    - pred * label
+                    + log_weight
+                    * (
+                        F.Activation(-F.abs(pred), act_type="softrelu")
+                        + F.relu(-pred)
+                    )
+                )
+        else:
+            eps = 1e-12
+            if pos_weight is None:
+                loss = -(
+                    F.log(pred + eps) * label
+                    + F.log(1.0 - pred + eps) * (1.0 - label)
+                )
+            else:
+                loss = -(
+                    F.log(pred + eps) * label * pos_weight
+                    + F.log(1.0 - pred + eps) * (1.0 - label)
+                )
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Softmax + CE fused (reference: gluon ``SoftmaxCrossEntropyLoss``;
+    kernel ``src/operator/nn/softmax.cc``)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        if self._sparse_label:
+            loss = -F.pick(pred, label, axis=self._axis, keepdims=True)
+        else:
+            label = _reshape_like(F, label, pred)
+            loss = -(pred * label).sum(axis=self._axis, keepdims=True)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        if not self._from_logits:
+            pred = F.log_softmax(pred, axis=self._axis)
+        loss = label * (F.log(label + 1e-12) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification (reference:
+    ``src/operator/nn/ctc_loss.cc`` [unverified]).
+
+    Layouts: 'NTC' (default) or 'TNC'. Implemented as the standard
+    log-alpha recursion with a ``lax.scan`` over time — compiles to one
+    fused XLA loop (no dynamic shapes)."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        assert layout in ("NTC", "TNC")
+        assert label_layout in ("NT", "TN")
+        self._layout = layout
+        self._label_layout = label_layout
+        batch_axis = label_layout.find("N")
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        import jax
+        import jax.numpy as jnp
+        from ..ndarray.ndarray import NDArray
+        from ..imperative import invoke_fn
+
+        if self._layout == "TNC":
+            pred = pred.swapaxes(0, 1)
+        if self._label_layout == "TN":
+            label = label.swapaxes(0, 1)
+
+        T = pred.shape[1]
+        L = label.shape[1]
+
+        def ctc(logits, labels, pl, ll):
+            # logits (N,T,C); labels (N,L) int; blank index = 0 (mxnet default)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            N = logits.shape[0]
+            S = 2 * L + 1
+            lab = labels.astype(jnp.int32)
+            # extended label sequence: blank, l1, blank, l2, ... blank
+            ext = jnp.zeros((N, S), jnp.int32)
+            ext = ext.at[:, 1::2].set(lab)
+            neg_inf = -1e30
+            # allowed skip transitions: ext[s] != ext[s-2] and ext[s] != blank
+            skip_ok = jnp.concatenate(
+                [
+                    jnp.zeros((N, 2), bool),
+                    (ext[:, 2:] != ext[:, :-2]) & (ext[:, 2:] != 0),
+                ],
+                axis=1,
+            )
+            alpha0 = jnp.full((N, S), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[:, 0, 0])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.take_along_axis(logp[:, 0, :], ext[:, 1:2], axis=1)[:, 0]
+            )
+
+            def step(alpha, logp_t):
+                shift1 = jnp.concatenate(
+                    [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1
+                )
+                shift2 = jnp.concatenate(
+                    [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1
+                )
+                shift2 = jnp.where(skip_ok, shift2, neg_inf)
+                merged = jnp.logaddexp(jnp.logaddexp(alpha, shift1), shift2)
+                emit = jnp.take_along_axis(logp_t, ext, axis=1)
+                new_alpha = merged + emit
+                return new_alpha, new_alpha
+
+            _, alphas = jax.lax.scan(step, alpha0, jnp.moveaxis(logp, 1, 0)[1:])
+            alphas = jnp.concatenate([alpha0[None], alphas], axis=0)  # (T,N,S)
+            t_idx = (pl - 1).astype(jnp.int32)
+            last = alphas[t_idx, jnp.arange(N)]  # (N,S)
+            s_last = 2 * ll.astype(jnp.int32)
+            p_blank = jnp.take_along_axis(last, s_last[:, None], axis=1)[:, 0]
+            p_label = jnp.take_along_axis(
+                last, jnp.maximum(s_last - 1, 0)[:, None], axis=1
+            )[:, 0]
+            return -jnp.logaddexp(p_blank, p_label)
+
+        if pred_lengths is None:
+            pl = jnp.full((pred.shape[0],), T, jnp.int32)
+        else:
+            pl = pred_lengths.data.astype(jnp.int32)
+        if label_lengths is None:
+            ll = jnp.full((pred.shape[0],), L, jnp.int32)
+        else:
+            ll = label_lengths.data.astype(jnp.int32)
+        loss = invoke_fn(lambda lg, lb: ctc(lg, lb, pl, ll), pred, label)
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1.0, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.abs(label - pred)
+        loss = F.where(
+            loss > self._rho,
+            loss - 0.5 * self._rho,
+            (0.5 / self._rho) * F.square(loss),
+        )
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.relu(self._margin - pred * label)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        loss = F.square(F.relu(self._margin - pred * label))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed", **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._label_format = label_format
+        if self._label_format not in ["signed", "binary"]:
+            raise MXNetError(f"label_format must be signed or binary, got {label_format}")
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        label = _reshape_like(F, label, pred)
+        if self._label_format == "signed":
+            label = (label + 1.0) / 2.0
+        loss = F.relu(pred) - pred * label + F.Activation(
+            -F.abs(pred), act_type="softrelu"
+        )
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return _batch_mean(F, loss, self._batch_axis)
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
+        positive = _reshape_like(F, positive, pred)
+        negative = _reshape_like(F, negative, pred)
+        loss = (
+            F.square(pred - positive).sum(axis=tuple(range(1, pred.ndim)))
+            - F.square(pred - negative).sum(axis=tuple(range(1, pred.ndim)))
+        )
+        loss = F.relu(loss + self._margin)
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-08):
+        target = _reshape_like(F, target, pred)
+        if self._from_logits:
+            loss = F.exp(pred) - target * pred
+        else:
+            loss = pred - target * F.log(pred + epsilon)
+        if self._compute_full:
+            stirling = (
+                target * F.log(target + 1e-12) - target
+                + 0.5 * F.log(2 * target * _np.pi + 1e-12)
+            )
+            stirling = stirling * (target > 1)
+            loss = loss + stirling
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean()
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        input1 = _reshape_like(F, input1, input2)
+        cos = (input1 * input2).sum(axis=-1) / (
+            input1.norm(axis=-1) * input2.norm(axis=-1) + 1e-12
+        )
+        label = label.reshape((-1,))
+        loss = F.where(
+            label == 1, 1.0 - cos, F.relu(cos - self._margin)
+        )
+        return _apply_weighting(F, loss, self._weight, sample_weight)

@@ -1,0 +1,5 @@
+"""Model zoo (reference: ``python/mxnet/gluon/model_zoo/`` [unverified])."""
+
+from . import vision  # noqa: F401
+
+__all__ = ["vision"]

@@ -1,0 +1,24 @@
+"""Vision model zoo (reference: ``gluon/model_zoo/vision/`` [unverified]).
+
+Populated incrementally; ``get_model(name)`` is the factory entry point."""
+
+from ....base import MXNetError
+
+_models = {}
+
+
+def register_model(fn):
+    _models[fn.__name__] = fn
+    return fn
+
+
+def get_model(name, **kwargs):
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(
+            f"model {name!r} is not in the zoo; available: {sorted(_models)}"
+        )
+    return _models[name](**kwargs)
+
+
+__all__ = ["get_model", "register_model"]

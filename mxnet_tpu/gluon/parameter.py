@@ -1,0 +1,449 @@
+"""Parameter / ParameterDict (reference: ``python/mxnet/gluon/parameter.py``
+[unverified]).
+
+Structural difference from the reference: there are no per-device replica
+copies (``_check_and_get`` ctx lists). A Parameter owns ONE NDArray; on TPU,
+multi-device placement is a *sharding* of that one array over the mesh
+(GSPMD), applied by ``mxnet_tpu.parallel`` — so ``list_data()`` returns a
+single element and ``ctx`` arguments are accepted for compatibility.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..ndarray.ndarray import NDArray
+from .. import initializer
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant", "ParameterDict"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Parameter shape is not yet known; init is deferred to first forward."""
+
+
+_PARAM_OVERRIDE = threading.local()
+
+
+def _override_map():
+    if not hasattr(_PARAM_OVERRIDE, "stack"):
+        _PARAM_OVERRIDE.stack = []
+    return _PARAM_OVERRIDE.stack
+
+
+class param_override:
+    """Scope mapping Parameter -> substitute NDArray (used by CachedOp tracing
+    so staged forwards see traced parameter values, and by AMP for casts)."""
+
+    def __init__(self, mapping):
+        self._mapping = mapping
+
+    def __enter__(self):
+        _override_map().append(self._mapping)
+        return self
+
+    def __exit__(self, *exc):
+        _override_map().pop()
+        return False
+
+
+class Parameter:
+    """A weight/bias/aux tensor with lazy (possibly deferred) initialization.
+
+    Parameters
+    ----------
+    name : str
+    grad_req : {'write', 'add', 'null'}
+    shape : tuple of int, 0 entries mean "infer at first forward"
+    dtype : numpy dtype or str
+    lr_mult / wd_mult : per-param hyper multipliers
+    init : Initializer or str
+    allow_deferred_init : allow shape to stay unknown until first forward
+    differentiable : False for aux states (BatchNorm running stats)
+    """
+
+    def __init__(self, name, grad_req="write", shape=None, dtype=_np.float32,
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data: Optional[NDArray] = None
+        self._deferred_init = ()
+        self._differentiable = differentiable
+        if not differentiable:
+            grad_req = "null"
+        self._allow_deferred_init = allow_deferred_init
+        self._grad_req = None
+        self._shape = tuple(int(s) for s in shape) if shape is not None else None
+        self.name = name
+        self._dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.grad_req = grad_req
+        self.init = init
+        if stype != "default" or grad_stype != "default":
+            raise MXNetError(
+                "sparse parameter storage is not supported by the TPU build; "
+                "use default stype"
+            )
+
+    def __repr__(self):
+        return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"
+
+    # ------------------------------------------------------------ properties
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if req not in ("write", "add", "null"):
+            raise MXNetError(f"grad_req must be write/add/null, got {req!r}")
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if self._data is not None:
+            if req == "null":
+                self._data._grad = None
+                self._data._grad_req = "null"
+            else:
+                self._init_grad()
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @dtype.setter
+    def dtype(self, dtype):
+        self.cast(dtype)
+
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        if len(self._shape) != len(new_shape) or any(
+            s != 0 and s != n for s, n in zip(self._shape, new_shape)
+        ):
+            raise MXNetError(
+                f"cannot update shape of {self.name} from {self._shape} to {new_shape}"
+            )
+        self._shape = tuple(int(s) for s in new_shape)
+
+    # ---------------------------------------------------------------- init
+    def _shape_known(self):
+        return self._shape is not None and all(s > 0 for s in self._shape)
+
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        if self._data is not None and not force_reinit:
+            return
+        if default_init is None:
+            default_init = initializer.Uniform()
+        init = initializer.create(init) if init is not None else None
+        if not self._shape_known():
+            if not self._allow_deferred_init:
+                raise MXNetError(
+                    f"cannot initialize {self.name}: shape {self._shape} unknown "
+                    "and allow_deferred_init=False"
+                )
+            self._deferred_init = (init, ctx, default_init)
+            return
+        self._finish_init(init, default_init)
+
+    def _finish_init(self, init, default_init):
+        data = NDArray(jnp.zeros(self._shape, jnp.dtype(self._dtype)))
+        explicit = init if init is not None else (
+            initializer.create(self.init) if self.init is not None else None
+        )
+        if explicit is not None:
+            # an init chosen FOR this parameter bypasses the global init's
+            # name-suffix dispatch (else bias_initializer='ones' would zero);
+            # initializers with a custom __call__ (Mixed, Load, plain
+            # callables) keep their own dispatch
+            std_call = (
+                isinstance(explicit, initializer.Initializer)
+                and type(explicit).__call__ is initializer.Initializer.__call__
+            )
+            if std_call:
+                explicit._init_default(initializer.InitDesc(self.name), data)
+            else:
+                explicit(initializer.InitDesc(self.name), data)
+        else:
+            default_init(initializer.InitDesc(self.name), data)
+        self._data = data
+        if self._grad_req != "null":
+            self._init_grad()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        if not self._shape_known():
+            raise DeferredInitializationError(
+                f"parameter {self.name} has unknown shape {self._shape}"
+            )
+        init, _ctx, default_init = self._deferred_init
+        self._deferred_init = ()
+        self._finish_init(init, default_init)
+
+    def _init_grad(self):
+        from .. import autograd
+
+        autograd._attach_grad(self._data, self._grad_req)
+
+    # --------------------------------------------------------------- access
+    def _check_and_get(self):
+        for mapping in reversed(_override_map()):
+            if self in mapping:
+                return mapping[self]
+        if self._data is not None:
+            return self._data
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                f"parameter {self.name} has not been initialized yet: deferred "
+                "init pending first forward"
+            )
+        raise MXNetError(
+            f"parameter {self.name} has not been initialized; call "
+            ".initialize() on the Block first"
+        )
+
+    def data(self, ctx=None) -> NDArray:
+        return self._check_and_get()
+
+    def list_data(self):
+        return [self._check_and_get()]
+
+    def grad(self, ctx=None) -> NDArray:
+        d = self._check_and_get()
+        if d._grad is None:
+            raise MXNetError(
+                f"cannot get gradient of {self.name}: grad_req='{self._grad_req}'"
+            )
+        return d._grad
+
+    def list_grad(self):
+        return [self.grad()]
+
+    def list_ctx(self):
+        return [self._check_and_get().ctx] if self._data is not None else [current_context()]
+
+    def set_data(self, data):
+        if isinstance(data, NDArray):
+            data = data.data
+        else:
+            data = jnp.asarray(data)
+        self.shape = data.shape
+        if self._data is None:
+            if self._deferred_init:
+                self._finish_deferred_init()
+            else:
+                self._data = NDArray(jnp.zeros(self.shape, jnp.dtype(self._dtype)))
+                if self._grad_req != "null":
+                    self._init_grad()
+        self._data._rebind(data.astype(self._data.data.dtype))
+
+    def _aux_update(self, new_value):
+        """Update a non-differentiable state (BatchNorm moving stats). Under
+        CachedOp tracing the update is captured by the aux sink and applied
+        after the jitted call; eagerly it rebinds in place."""
+        from .block import _current_aux_sink
+
+        sink = _current_aux_sink()
+        if sink is not None:
+            sink[self] = new_value if not isinstance(new_value, NDArray) else new_value.data
+        else:
+            self._data._rebind(
+                new_value.data if isinstance(new_value, NDArray) else new_value
+            )
+
+    def zero_grad(self):
+        if self._data is not None and self._data._grad is not None:
+            self._data.zero_grad()
+
+    def cast(self, dtype):
+        self._dtype = dtype
+        if self._data is not None:
+            had_grad = self._data._grad is not None
+            self._data._rebind(self._data.data.astype(jnp.dtype(dtype)))
+            if had_grad:
+                self._init_grad()
+
+    def reset_ctx(self, ctx=None):
+        pass  # single logical array; placement is a sharding concern
+
+    def var(self):
+        raise MXNetError("symbolic var() has no TPU-native equivalent; "
+                         "hybridize() stages through jax.jit instead")
+
+    def __reduce__(self):
+        raise MXNetError("Parameter objects are not picklable; save/load "
+                         "parameters through Block.save_parameters")
+
+
+class Constant(Parameter):
+    """Non-trainable constant (reference: ``gluon.Constant``)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = NDArray(jnp.asarray(value))
+        self.value = value
+
+        class _CInit(initializer.Initializer):
+            def _init_weight(self, _, arr):
+                arr._rebind(value.data)
+
+            _init_default = _init_weight
+
+        super().__init__(
+            name, grad_req="null", shape=value.shape,
+            dtype=str(value.data.dtype), init=_CInit(), differentiable=False
+        )
+
+
+class ParameterDict:
+    """Ordered name->Parameter mapping with prefix and sharing (reference:
+    ``gluon.ParameterDict``)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = "\n".join(f"  {v}" for v in self.values())
+        return f"ParameterDict '{self._prefix}' (\n{s}\n)"
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs) -> Parameter:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if k == "shape" and v is not None:
+                    param.shape = v
+                elif k == "init" and v is not None and param.init is None:
+                    param.init = v
+                elif getattr(param, k, None) is None and v is not None:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None) -> Constant:
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError(f"no constant named {name}; value required")
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"cannot update self with other: duplicate key {k}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False, force_reinit=False):
+        if init is None:
+            init = initializer.Uniform()
+        for _, v in self.items():
+            v.initialize(None, ctx, init, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        pass
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import save as nd_save
+
+        arg_dict = {}
+        for param in self.values():
+            weight = param.data()
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError(
+                    f"prefix {strip_prefix} does not match parameter {param.name}"
+                )
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        nd_save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False, ignore_extra=False,
+             restore_prefix="", cast_dtype=False, dtype_source="current"):
+        from ..ndarray import load as nd_load
+
+        loaded = nd_load(filename)
+        arg_dict = {
+            restore_prefix + k.replace("arg:", "").replace("aux:", ""): v
+            for k, v in loaded.items()
+        }
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError(
+                        f"parameter {name} missing in {filename}; set "
+                        "allow_missing=True to skip"
+                    )
+        for name, val in arg_dict.items():
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError(
+                        f"parameter {name} in file not in this dict; set "
+                        "ignore_extra=True to skip"
+                    )
+                continue
+            param = self._params[name]
+            if cast_dtype and dtype_source == "current" and param._data is not None:
+                val = val.astype(param.dtype)
+            param.set_data(val)

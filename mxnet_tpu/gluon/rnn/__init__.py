@@ -1,0 +1,6 @@
+"""Recurrent layers (reference: ``python/mxnet/gluon/rnn/`` [unverified]).
+
+Placeholder module populated in a later milestone (fused RNN over lax.scan
+plus cell-level API); importing it early keeps `gluon.rnn` importable."""
+
+__all__ = []

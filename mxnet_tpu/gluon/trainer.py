@@ -1,0 +1,188 @@
+"""Trainer: binds Parameters to an optimizer + KVStore (reference:
+``python/mxnet/gluon/trainer.py`` [unverified]).
+
+Reference flow (SURVEY.md §3.3): ``step()`` → allreduce grads via KVStore
+push/pull → fused optimizer update per param. Here the single-process path
+updates each param through a jitted fused-update op; multi-host grads are
+psum'd through the dist KVStore facade; GSPMD data-parallel inside a jitted
+step needs no Trainer-level sync at all (the collective is compiled in).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt
+from ..kvstore import KVStore as _KV
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "first argument must be a list or dict of Parameters, "
+                f"got {type(params)}"
+            )
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    "first argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}"
+                )
+            if param.grad_req != "null":
+                self._param2idx[param.name] = i
+                self._params.append(param)
+        self._compression_params = compression_params
+        self._contains_sparse_weight = False
+        optimizer_params = optimizer_params if optimizer_params else {}
+        self._init_optimizer(optimizer, optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._kvstore_params = {
+            "kvstore": kvstore,
+            "update_on_kvstore": update_on_kvstore,
+        }
+        self._kv_initialized = False
+        self._kvstore = None
+        self._update_on_kvstore = None
+        self._states_to_load = None
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            assert not optimizer_params, (
+                "optimizer_params must be None if optimizer is an Optimizer "
+                "instance"
+            )
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(
+                optimizer, param_dict=param_dict, **optimizer_params
+            )
+        self._updaters = [opt.get_updater(self._optimizer)]
+
+    def _init_kvstore(self):
+        config = self._kvstore_params
+        kvstore = config["kvstore"]
+        update_on_kvstore = config["update_on_kvstore"]
+        if kvstore:
+            kv = kvstore if isinstance(kvstore, _KV) else None
+            if kv is None:
+                from .. import kvstore as kvstore_mod
+
+                kv = kvstore_mod.create(kvstore)
+            self._kvstore = kv
+            if update_on_kvstore is None:
+                update_on_kvstore = kv.num_workers > 1
+            if update_on_kvstore:
+                kv.set_optimizer(self._optimizer)
+            for i, param in enumerate(self._params):
+                kv.init(i, param.data())
+        else:
+            self._kvstore = None
+            self._update_on_kvstore = False
+        self._update_on_kvstore = bool(update_on_kvstore) if kvstore else False
+        self._kv_initialized = True
+        if self._states_to_load is not None:
+            self.load_states(self._states_to_load)
+            self._states_to_load = None
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.lr_scheduler(self._optimizer.num_update) \
+            if self._optimizer.lr_scheduler is not None else self._optimizer.lr
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    # ---------------------------------------------------------------- steps
+    def step(self, batch_size, ignore_stale_grad=False):
+        """Rescale by 1/batch_size, sync grads, apply optimizer update."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._allreduce_grads()
+        self._update(ignore_stale_grad)
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads() when parameters are updated on kvstore "
+                "is not supported"
+            )
+        self._allreduce_grads()
+
+    def _allreduce_grads(self):
+        if self._kvstore is None or self._kvstore.num_workers == 1:
+            return  # grads already global: single replica or in-program psum
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null":
+                grad = param.grad()
+                self._kvstore.init(f"g{i}", grad)
+                self._kvstore.push(f"g{i}", grad)
+                self._kvstore.pull(f"g{i}", grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "update() when parameters are updated on kvstore is not "
+                "supported; call step() instead"
+            )
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        updater = self._updaters[0]
+        if self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                self._kvstore.push(i, param.grad())
+                self._kvstore.pull(i, param.data())
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            updater(i, param.grad(), param.data())
+
+    # ---------------------------------------------------------------- state
+    def save_states(self, fname):
+        assert self._optimizer is not None
+        if not self._kv_initialized:
+            self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+        else:
+            with open(fname, "wb") as fout:
+                fout.write(self._updaters[0].get_states(dump_optimizer=True))
+
+    def load_states(self, fname):
+        if not self._kv_initialized:
+            self._states_to_load = fname
+            return
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            self._optimizer = self._kvstore.updater.optimizer
+        else:
+            with open(fname, "rb") as f:
+                states = f.read()
+            self._updaters[0].set_states(states)
+            self._optimizer = self._updaters[0].optimizer
+        self._optimizer.param_dict = {
+            i: param for i, param in enumerate(self._params)
+        }

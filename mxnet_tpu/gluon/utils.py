@@ -1,0 +1,102 @@
+"""Gluon utilities (reference: ``python/mxnet/gluon/utils.py`` [unverified])."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray import array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    """Split along batch axis into ``num_slice`` pieces (reference API)."""
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}"
+        )
+    step = size // num_slice
+    if not even_split and size < num_slice:
+        step = 1
+        num_slice = size
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Split a batch across contexts.
+
+    TPU note: with a single logical device list (the common case — GSPMD
+    shards one array over the mesh instead of making per-device copies),
+    this returns one piece per ctx exactly like the reference so existing
+    training loops port unchanged."""
+    if not isinstance(data, NDArray):
+        data = nd_array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the joint L2 norm <= max_norm (reference API)."""
+    assert len(arrays) > 0
+    total = jnp.sqrt(
+        sum(jnp.sum(jnp.square(a.data.astype(jnp.float32))) for a in arrays)
+    )
+    total_f = float(total)
+    if check_isfinite and not _np.isfinite(total_f):
+        import warnings
+
+        warnings.warn(
+            "nan or inf is detected. Clipping results will be undefined.",
+            stacklevel=2,
+        )
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._rebind(a.data * scale)
+    return total_f if check_isfinite else NDArray(total)
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Reference API. This build runs with zero egress: the file must already
+    exist locally (path or MXNET_HOME cache); otherwise an error explains."""
+    fname = url.split("/")[-1]
+    if path is None:
+        path = fname
+    elif os.path.isdir(path):
+        path = os.path.join(path, fname)
+    if os.path.exists(path) and not overwrite and (
+        sha1_hash is None or check_sha1(path, sha1_hash)
+    ):
+        return path
+    raise MXNetError(
+        f"cannot download {url}: this environment has no network egress. "
+        f"Place the file at {path} manually."
+    )

@@ -1,0 +1,364 @@
+"""Weight initializers (reference: ``python/mxnet/initializer.py`` [unverified]).
+
+Same registry-by-name design as the reference (``@register`` + ``create``),
+but sampling goes through jax's counter-based RNG (``mxnet_tpu.random``)
+instead of a stateful per-device generator: each ``InitDesc`` draw folds a
+fresh subkey so initialization is reproducible under ``mx.random.seed``.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import re
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from . import random as _random
+from .ndarray.ndarray import NDArray
+
+__all__ = [
+    "InitDesc",
+    "Initializer",
+    "register",
+    "create",
+    "Zero",
+    "One",
+    "Constant",
+    "Uniform",
+    "Normal",
+    "Orthogonal",
+    "Xavier",
+    "MSRAPrelu",
+    "Bilinear",
+    "LSTMBias",
+    "Mixed",
+    "Load",
+]
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(klass):
+    """Register an initializer class under its lower-cased name."""
+    _REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs) -> "Initializer":
+    if isinstance(name, Initializer):
+        return name
+    if callable(name):
+        return name
+    if name is None:
+        return Uniform()
+    key = str(name).lower()
+    if key not in _REGISTRY:
+        raise MXNetError(f"unknown initializer {name!r}")
+    return _REGISTRY[key](**kwargs)
+
+
+class InitDesc(str):
+    """Parameter-name string carrying init attrs (reference: ``InitDesc``)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        ret = super().__new__(cls, name)
+        ret.attrs = attrs or {}
+        ret.global_init = global_init
+        return ret
+
+
+class Initializer:
+    """Base initializer. Subclasses implement ``_init_weight(name, arr)``."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+        self._verbose = False
+        self._print_func = None
+
+    def set_verbosity(self, verbose=False, print_func=None):
+        self._verbose = verbose
+        self._print_func = print_func or (lambda x: None)
+        return self
+
+    def dumps(self) -> str:
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __eq__(self, other):
+        return isinstance(other, self.__class__) and self._kwargs == getattr(
+            other, "_kwargs", None
+        )
+
+    def __hash__(self):
+        return hash(self.__class__.__name__)
+
+    def __call__(self, desc, arr: NDArray):
+        if not isinstance(desc, InitDesc):
+            desc = InitDesc(desc)
+        init_name = desc.attrs.get("__init__", "")
+        if init_name:
+            create(json.loads(init_name)[0], **json.loads(init_name)[1])._init_weight(
+                desc, arr
+            )
+            return
+        name = desc.lower()
+        if name.endswith("weight"):
+            self._init_weight(desc, arr)
+        elif name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_gamma(desc, arr)
+        elif name.endswith("beta"):
+            self._init_beta(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_default(desc, arr)
+        if self._verbose and self._print_func:
+            self._print_func(f"initialized {desc}")
+
+    # ---- per-suffix defaults (match reference behavior)
+    def _init_zero(self, _, arr):
+        arr._rebind(jnp.zeros(arr.shape, arr.data.dtype))
+
+    def _init_one(self, _, arr):
+        arr._rebind(jnp.ones(arr.shape, arr.data.dtype))
+
+    def _init_bias(self, _, arr):
+        self._init_zero(_, arr)
+
+    def _init_gamma(self, _, arr):
+        self._init_one(_, arr)
+
+    def _init_beta(self, _, arr):
+        self._init_zero(_, arr)
+
+    def _init_weight(self, name, arr):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _init_default(self, name, arr):
+        self._init_weight(name, arr)
+
+
+def _key():
+    return _random.next_key()
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, _, arr):
+        arr._rebind(jnp.zeros(arr.shape, arr.data.dtype))
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, _, arr):
+        arr._rebind(jnp.ones(arr.shape, arr.data.dtype))
+
+
+# reference registers these under both names
+_REGISTRY["zeros"] = Zero
+_REGISTRY["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, _, arr):
+        val = self.value
+        if isinstance(val, NDArray):
+            val = val.data
+        arr._rebind(jnp.broadcast_to(jnp.asarray(val, arr.data.dtype), arr.shape))
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, _, arr):
+        arr._rebind(
+            jax.random.uniform(
+                _key(), arr.shape, arr.data.dtype, -self.scale, self.scale
+            )
+        )
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, _, arr):
+        arr._rebind(
+            self.sigma * jax.random.normal(_key(), arr.shape, arr.data.dtype)
+        )
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, _, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = jax.random.uniform(_key(), (nout, nin), minval=-1.0, maxval=1.0)
+        else:
+            tmp = jax.random.normal(_key(), (nout, nin))
+        u, _s, v = jnp.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._rebind(self.scale * q.reshape(arr.shape).astype(arr.data.dtype))
+
+
+@register
+class Xavier(Initializer):
+    """Xavier/Glorot (reference default for Gluon weight init via string)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(
+            rnd_type=rnd_type, factor_type=factor_type, magnitude=magnitude
+        )
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        shape = arr.shape
+        if len(shape) < 2:
+            raise MXNetError(
+                f"Xavier initializer needs >=2D weight, got {shape} for {name}"
+            )
+        hw_scale = float(_np.prod(shape[2:])) if len(shape) > 2 else 1.0
+        fan_in, fan_out = shape[1] * hw_scale, shape[0] * hw_scale
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"invalid factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / factor)
+        if self.rnd_type == "uniform":
+            out = jax.random.uniform(
+                _key(), shape, arr.data.dtype, -scale, scale
+            )
+        elif self.rnd_type == "gaussian":
+            out = scale * jax.random.normal(_key(), shape, arr.data.dtype)
+        else:
+            raise MXNetError(f"invalid rnd_type {self.rnd_type}")
+        arr._rebind(out)
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel for Deconvolution."""
+
+    def _init_weight(self, _, arr):
+        shape = arr.shape
+        weight = _np.zeros(int(_np.prod(shape)), dtype="float32")
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._rebind(jnp.asarray(weight.reshape(shape), arr.data.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = 1 trick (reference: ``LSTMBias``)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, _, arr):
+        b = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden : 2 * num_hidden] = self.forget_bias
+        arr._rebind(jnp.asarray(b, arr.data.dtype))
+
+    _init_default = _init_weight
+    _init_bias = _init_weight
+
+
+@register
+class Mixed(Initializer):
+    """Dispatch by regex over parameter names (reference: ``Mixed``)."""
+
+    def __init__(self, patterns, initializers):
+        super().__init__()
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers length mismatch")
+        self.map = [(re.compile(p), create(i)) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, desc, arr):
+        for prog, init in self.map:
+            if prog.match(str(desc)):
+                # the pattern IS the dispatch — bypass suffix heuristics
+                init._init_default(desc, arr)
+                return
+        raise MXNetError(
+            f"parameter {desc} did not match any pattern; add '.*' as a catchall"
+        )
+
+
+@register
+class Load:
+    """Init from a dict of arrays, falling back to ``default_init``."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        self.param = {
+            k.replace("arg:", "").replace("aux:", ""): v for k, v in param.items()
+        }
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"shape mismatch loading {name}: saved {src.shape} vs {arr.shape}"
+                )
+            arr._rebind(jnp.asarray(src.data if isinstance(src, NDArray) else src))
+        else:
+            if self.default_init is None:
+                raise MXNetError(f"cannot init {name}: not found and no default")
+            self.default_init(name, arr)
+
+
+class init:  # namespace alias so `mx.init.Xavier()` works like the reference
+    pass
+
+
+for _n, _k in list(_REGISTRY.items()):
+    setattr(init, _k.__name__, _k)
+init.InitDesc = InitDesc
+init.create = create
+init.register = register

@@ -1,0 +1,21 @@
+"""KVStore facade (reference: ``python/mxnet/kvstore/`` over
+``src/kvstore/`` + ps-lite [unverified]).
+
+TPU-native design (SURVEY.md §2.3/§5): none of the reference's transports
+(device p2p copies, NCCL, ZMQ parameter server) is rebuilt. Gradient
+synchronization is an XLA collective compiled into the step program
+(``psum`` over the mesh ``data`` axis, riding ICI). The KVStore classes
+survive as the same Python API so Trainer-level code ports unchanged:
+
+- 'local' / 'device' / 'nccl': in-process store; push accumulates the
+  device-replica list (a no-op sum when GSPMD already all-reduced), pull
+  broadcasts.
+- 'dist_sync' / 'dist_async' / 'horovod' / 'byteps': multi-host data
+  parallelism over the jax distributed runtime (one process per host); push
+  triggers a cross-host psum via ``mxnet_tpu.parallel``.
+"""
+
+from .kvstore import KVStore, KVStoreBase, create
+from . import kvstore_server  # noqa: F401
+
+__all__ = ["KVStore", "KVStoreBase", "create"]

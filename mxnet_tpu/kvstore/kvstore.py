@@ -1,0 +1,192 @@
+"""In-process KVStore implementations (reference: ``src/kvstore/kvstore_local.cc``,
+``comm.h``/``comm_tree.h``/``kvstore_nccl.h`` [unverified]).
+
+The reference's three intra-node reduce strategies (CPU reduce, device tree
+reduce, NCCL ring) all collapse to one thing on TPU: XLA emits the optimal
+ICI collective for a mesh-sharded array, so ``push`` here is a plain sum over
+the replica list (length 1 when GSPMD already holds the globally-reduced
+gradient). Multi-host ('dist_*') layers a cross-process psum from
+``mxnet_tpu.parallel`` on top.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+
+__all__ = ["KVStoreBase", "KVStore", "create"]
+
+
+class KVStoreBase:
+    """Pluggable backend registry (reference: 2.0-era ``KVStoreBase``)."""
+
+    kv_registry: Dict[str, type] = {}
+
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        KVStoreBase.kv_registry[name] = klass
+        return klass
+
+    # capability names
+    OPTIMIZER = "optimizer"
+
+    @staticmethod
+    def is_capable(capability):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+@KVStoreBase.register
+class KVStore(KVStoreBase):
+    """Single-process store ('local' / 'device' / 'nccl' types)."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._data: Dict = {}
+        self._updater: Optional[opt.Updater] = None
+        self._update_on_kvstore = False
+        self._compression = None
+
+    # --------------------------------------------------------------- info
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    @staticmethod
+    def is_capable(capability):
+        return capability == KVStoreBase.OPTIMIZER
+
+    # ---------------------------------------------------------------- API
+    def init(self, key, value):
+        keys, values = _as_list(key), _as_list(value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k in self._data:
+                continue
+            self._data[k] = NDArray(jnp.array(v.data))
+
+    def push(self, key, value, priority=0):
+        keys = _as_list(key)
+        for k, vals in zip(keys, self._grouped(keys, value)):
+            k = str(k)
+            if k not in self._data:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            # reduce over device replicas (reference: Comm::Reduce / NCCL)
+            agg = vals[0].data
+            for v in vals[1:]:
+                agg = agg + v.data
+            if self._updater is not None:
+                self._updater(int(k) if k.isdigit() else k, NDArray(agg),
+                              self._data[k])
+            else:
+                self._data[k]._rebind(agg)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys = _as_list(key)
+        outs = self._grouped(keys, out)
+        for k, dsts in zip(keys, outs):
+            k = str(k)
+            if k not in self._data:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            src = self._data[k]
+            for d in dsts:
+                d._rebind(src.data)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out if out is not None else value, priority)
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out, priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError(
+            "row_sparse storage is not supported by the TPU build; dense "
+            "embedding gradients are XLA-scatter aggregated instead"
+        )
+
+    def set_gradient_compression(self, compression_params):
+        self._compression = dict(compression_params)
+
+    # ----------------------------------------------------- server optimizer
+    def set_optimizer(self, optimizer):
+        # reference pickles the optimizer to PS servers; here the "server"
+        # is in-process
+        self._updater = opt.get_updater(optimizer)
+        self._update_on_kvstore = True
+
+    @property
+    def updater(self):
+        return self._updater
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    # ------------------------------------------------------------- helpers
+    def _grouped(self, keys, values) -> List[List[NDArray]]:
+        values = _as_list(values)
+        if len(keys) == 1:
+            if values and isinstance(values[0], (list, tuple)):
+                return [list(values[0])]
+            return [list(values)]
+        out = []
+        for v in values:
+            out.append(list(v) if isinstance(v, (list, tuple)) else [v])
+        return out
+
+    def barrier(self):
+        from ..engine import wait_for_all
+
+        wait_for_all()
+
+    def _send_command_to_servers(self, head, body):
+        pass
+
+
+def create(name="local") -> KVStore:
+    """Create a KVStore (reference: ``mx.kv.create``).
+
+    'local'/'device'/'nccl' → in-process store (GSPMD handles intra-host
+    reduction). 'dist_sync'/'dist_async' → distributed store over the jax
+    coordinator (requires `mxnet_tpu.parallel.init_process_group`).
+    """
+    if not isinstance(name, str):
+        raise MXNetError("name must be a string")
+    kind = name.lower()
+    if kind in ("local", "device", "nccl", "local_allreduce_cpu",
+                "local_allreduce_device"):
+        return KVStore(kind)
+    if kind in ("dist_sync", "dist_async", "dist_device_sync", "dist",
+                "horovod", "byteps"):
+        from .kvstore_dist import KVStoreDist
+
+        return KVStoreDist(kind)
+    raise MXNetError(f"unknown KVStore type {name!r}")

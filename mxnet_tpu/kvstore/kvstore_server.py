@@ -1,0 +1,21 @@
+"""KVStore 'server' role (reference: ``src/kvstore/kvstore_dist_server.h``
+[unverified]: servers applied the optimizer to pushed grads).
+
+On TPU there are no server processes — every host runs the same SPMD program
+and the optimizer runs data-parallel on all of them. This module keeps the
+reference's entry point so launch scripts with a server role degrade
+gracefully: a 'server' process simply joins the coordinator and idles (the
+launcher should allocate 0 servers)."""
+
+from __future__ import annotations
+
+import os
+
+
+def run_server():  # pragma: no cover - exercised via tools/launch.py
+    role = os.environ.get("MXNET_TPU_ROLE", "worker")
+    if role == "server":
+        raise SystemExit(
+            "mxnet_tpu has no parameter-server role: gradient sync is an XLA "
+            "collective inside the step program. Launch with 0 servers."
+        )

@@ -12,3 +12,4 @@ from .registry import Operator, register, get, list_ops, alias
 from . import tensor  # noqa: F401 - registers tensor ops
 from . import nn  # noqa: F401 - registers nn ops
 from . import contrib  # noqa: F401 - registers contrib ops
+from . import optimizer_op  # noqa: F401 - registers fused optimizer updates

@@ -170,14 +170,26 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
     stride = _tuplify(stride, nd)
     dilate = _tuplify(dilate, nd)
     pad = _tuplify(pad if pad is not None else 0, nd)
+    adj = _tuplify(adj if adj is not None else 0, nd)
+    if num_group != 1:
+        raise NotImplementedError("grouped Deconvolution not supported yet")
     spatial = "DHW"[-nd:]
+    kernel = _tuplify(kernel if kernel is not None else weight.shape[2:], nd)
+    # gradient-of-conv semantics (out = (i-1)*s + k' - 2p + adj, k' = dilated
+    # kernel extent): pad the stride-dilated input by k'-1-p per side, adj on
+    # the high side; weight layout is (in, out, *k) like the reference, read
+    # as OI + transpose_kernel so XLA flips/swaps into the grad kernel.
+    pads = []
+    for k, d, p, a in zip(kernel, dilate, pad, adj):
+        eff_k = (k - 1) * d + 1
+        pads.append((eff_k - 1 - p, eff_k - 1 - p + a))
     out = jax.lax.conv_transpose(
         data,
         weight,
         strides=stride,
-        padding=[(p, p) for p in pad],
+        padding=pads,
         rhs_dilation=dilate,
-        dimension_numbers=("NC" + spatial, "IO" + spatial, "NC" + spatial),
+        dimension_numbers=("NC" + spatial, "OI" + spatial, "NC" + spatial),
         transpose_kernel=True,
     )
     if bias is not None and not no_bias:

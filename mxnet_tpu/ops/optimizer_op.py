@@ -1,0 +1,192 @@
+"""Fused optimizer update ops (reference: ``src/operator/optimizer_op.cc``,
+``src/operator/contrib/adamw.cc``, multi-tensor ``multi_sgd_update``
+[unverified]).
+
+Each op is a pure function ``(weight, grad, *states, **hyper) ->
+(new_weight, *new_states)``. The imperative layer rebinds the input NDArrays
+(MXNet semantics: optimizer ops mutate weight/state in place); the Trainer's
+fused path stacks many parameters into ONE jitted call so the whole optimizer
+step is a single XLA executable with donated buffers — the TPU equivalent of
+the reference's multi-tensor CUDA kernels.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _apply_wd_rescale(weight, grad, wd, rescale_grad, clip_gradient):
+    grad = grad * rescale_grad
+    if clip_gradient is not None and clip_gradient >= 0:
+        grad = jnp.clip(grad, -clip_gradient, clip_gradient)
+    return grad + wd * weight
+
+
+@register("sgd_update", mutates_input=0, differentiable=False)
+def sgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+               clip_gradient=-1.0, lazy_update=True, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    return weight - lr * g
+
+
+@register("sgd_mom_update", mutates_input=0, differentiable=False)
+def sgd_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_mom = momentum * mom - lr * g
+    return weight + new_mom, new_mom
+
+
+@register("nag_mom_update", mutates_input=0, differentiable=False)
+def nag_mom_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_mom = momentum * mom + g
+    return weight - lr * (g + momentum * new_mom), new_mom
+
+
+@register("adam_update", mutates_input=0, differentiable=False)
+def adam_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    return (weight - lr * new_mean / (jnp.sqrt(new_var) + epsilon),
+            new_mean, new_var)
+
+
+@register("adamw_update", aliases=["_adamw_update"], mutates_input=0,
+          differentiable=False)
+def adamw_update(weight, grad, mean, var, lr=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, wd=0.0, eta=1.0, rescale_grad=1.0,
+                 clip_gradient=-1.0, **kw):
+    # decoupled weight decay (Loshchilov & Hutter) — wd is NOT in the moments
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    update = new_mean / (jnp.sqrt(new_var) + epsilon) + wd * weight
+    return weight - eta * lr * update, new_mean, new_var
+
+
+@register("lamb_update_phase1", mutates_input=None, differentiable=False)
+def lamb_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_mean = beta1 * mean + (1 - beta1) * g
+    new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+    if bias_correction:
+        mean_hat = new_mean / (1.0 - beta1 ** t)
+        var_hat = new_var / (1.0 - beta2 ** t)
+    else:
+        mean_hat, var_hat = new_mean, new_var
+    update = mean_hat / (jnp.sqrt(var_hat) + epsilon) + wd * weight
+    return update, new_mean, new_var
+
+
+@register("lamb_update_phase2", mutates_input=0, differentiable=False)
+def lamb_update_phase2(weight, g, r1, r2, lr=0.001, lower_bound=-1.0,
+                       upper_bound=-1.0, **kw):
+    if lower_bound >= 0:
+        r1 = jnp.maximum(r1, lower_bound)
+    if upper_bound >= 0:
+        r1 = jnp.minimum(r1, upper_bound)
+    ratio = jnp.where(jnp.logical_and(r1 > 0, r2 > 0), r1 / r2, 1.0)
+    return weight - lr * ratio * g
+
+
+@register("rmsprop_update", mutates_input=0, differentiable=False)
+def rmsprop_update(weight, grad, n, lr=0.001, gamma1=0.95, epsilon=1e-8,
+                   wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                   clip_weights=-1.0, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_w = weight - lr * g / jnp.sqrt(new_n + epsilon)
+    if clip_weights >= 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n
+
+
+@register("rmspropalex_update", mutates_input=0, differentiable=False)
+def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
+    new_g = gamma1 * g_state + (1 - gamma1) * g
+    new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)
+    new_w = weight + new_delta
+    if clip_weights >= 0:
+        new_w = jnp.clip(new_w, -clip_weights, clip_weights)
+    return new_w, new_n, new_g, new_delta
+
+
+@register("ftrl_update", mutates_input=0, differentiable=False)
+def ftrl_update(weight, grad, z, n, lr=0.1, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    new_n = n + jnp.square(g)
+    sigma = (jnp.sqrt(new_n) - jnp.sqrt(n)) / lr
+    new_z = z + g - sigma * weight
+    new_w = jnp.where(
+        jnp.abs(new_z) > lamda1,
+        -(new_z - jnp.sign(new_z) * lamda1)
+        / ((beta + jnp.sqrt(new_n)) / lr + wd),
+        0.0,
+    )
+    return new_w.astype(weight.dtype), new_z, new_n
+
+
+@register("signsgd_update", mutates_input=0, differentiable=False)
+def signsgd_update(weight, grad, lr=0.01, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    return weight - lr * jnp.sign(g)
+
+
+@register("signum_update", mutates_input=0, differentiable=False)
+def signum_update(weight, grad, mom, lr=0.01, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, **kw):
+    g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_mom = momentum * mom - (1 - momentum) * g
+    new_w = (1 - lr * wd_lh) * weight + lr * jnp.sign(new_mom)
+    return new_w, new_mom
+
+
+@register("mp_sgd_update", mutates_input=0, differentiable=False)
+def mp_sgd_update(weight, grad, weight32, lr=0.01, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, **kw):
+    # multi-precision: master fp32 copy updated, low-precision weight recast
+    g = _apply_wd_rescale(weight32, grad.astype(jnp.float32), wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_w32 = weight32 - lr * g
+    return new_w32.astype(weight.dtype), new_w32
+
+
+@register("mp_sgd_mom_update", mutates_input=0, differentiable=False)
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
+                      wd=0.0, rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = _apply_wd_rescale(weight32, grad.astype(jnp.float32), wd, rescale_grad,
+                          clip_gradient if clip_gradient >= 0 else None)
+    new_mom = momentum * mom - lr * g
+    new_w32 = weight32 + new_mom
+    return new_w32.astype(weight.dtype), new_mom, new_w32

@@ -84,14 +84,28 @@ register("cast", aliases=["Cast"])(
     lambda data, dtype="float32", **kw: data.astype(jnp.dtype(dtype))
 )
 register("clip")(lambda data, a_min=None, a_max=None, **kw: jnp.clip(data, a_min, a_max))
-register("LeakyReLU")(
-    lambda data, act_type="leaky", slope=0.25, **kw: {
-        "leaky": lambda d: jnp.where(d >= 0, d, slope * d),
-        "elu": lambda d: jnp.where(d >= 0, d, slope * jnp.expm1(d)),
-        "selu": lambda d: jax.nn.selu(d),
-        "gelu": lambda d: jax.nn.gelu(d, approximate=False),
-    }[act_type](data)
-)
+def _leaky_relu(data, gamma=None, act_type="leaky", slope=0.25, **kw):
+    """Reference: ``src/operator/leaky_relu.cc`` [unverified]; 'prelu' takes a
+    learned per-channel slope tensor as second input."""
+    if act_type == "leaky":
+        return jnp.where(data >= 0, data, slope * data)
+    if act_type == "elu":
+        return jnp.where(data >= 0, data, slope * jnp.expm1(data))
+    if act_type == "selu":
+        return jax.nn.selu(data)
+    if act_type == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act_type == "prelu":
+        g = gamma
+        if g.ndim == 1 and data.ndim > 1 and g.shape[0] > 1:
+            shape = [1] * data.ndim
+            shape[1] = g.shape[0]
+            g = g.reshape(shape)
+        return jnp.where(data >= 0, data, g * data)
+    raise ValueError(f"unknown LeakyReLU act_type {act_type!r}")
+
+
+register("LeakyReLU")(_leaky_relu)
 register("hard_sigmoid")(
     lambda data, alpha=0.2, beta=0.5, **kw: jnp.clip(alpha * data + beta, 0.0, 1.0)
 )

@@ -1,0 +1,8 @@
+"""Optimizers (reference: ``python/mxnet/optimizer/`` [unverified])."""
+
+from . import optimizer
+from .optimizer import *  # noqa: F401,F403
+from . import lr_scheduler
+from .lr_scheduler import LRScheduler  # noqa: F401
+
+__all__ = optimizer.__all__ + ["lr_scheduler", "LRScheduler"]

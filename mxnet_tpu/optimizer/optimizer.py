@@ -1,0 +1,760 @@
+"""Optimizer classes (reference: ``python/mxnet/optimizer/optimizer.py``
+[unverified]; fused update kernels ``src/operator/optimizer_op.cc``).
+
+Design: every optimizer's math lives in a pure fused-update op
+(``ops/optimizer_op.py``). The per-param ``update()`` path runs that op
+through a cached ``jax.jit`` wrapper in which the *varying* hypers (lr, wd,
+bias-correction-adjusted lr) are dynamic scalar operands — so changing the
+learning rate never retraces — while structural hypers (momentum, betas) are
+compile-time constants. ``Trainer`` additionally offers a fully fused
+whole-model step (one XLA executable for all params, donated buffers): the
+TPU analogue of the reference's multi-tensor ``multi_sgd_update`` kernels.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import math
+import pickle
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ops import optimizer_op as _fused
+from .lr_scheduler import LRScheduler
+
+__all__ = [
+    "Optimizer",
+    "SGD",
+    "Signum",
+    "NAG",
+    "Adam",
+    "AdamW",
+    "Adamax",
+    "Nadam",
+    "LAMB",
+    "LARS",
+    "RMSProp",
+    "AdaGrad",
+    "AdaDelta",
+    "FTRL",
+    "SGLD",
+    "DCASGD",
+    "Test",
+    "Updater",
+    "get_updater",
+    "create",
+    "register",
+]
+
+
+@functools.lru_cache(maxsize=None)
+def _jit_update(fn, static_hypers):
+    """Jitted wrapper: dynamic (weight, grad, states, lr, wd), static rest."""
+    hypers = dict(static_hypers)
+
+    # donate weight + states (rebound after the call); grad is NOT donated —
+    # grad_req='add' accumulators are read again by the next backward
+    @functools.partial(jax.jit, donate_argnums=(0, 2))
+    def step(weight, grad, states, lr, wd):
+        out = fn(weight, grad, *states, lr=lr, wd=wd, **hypers)
+        return out if isinstance(out, tuple) else (out,)
+
+    return step
+
+
+class Optimizer:
+    """Base optimizer. Reference API: create_state/update(+_multi_precision)."""
+
+    opt_registry: Dict[str, type] = {}
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=None, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, aggregate_num=0):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate if learning_rate is not None else 0.01
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None and learning_rate is not None:
+            lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.aggregate_num = aggregate_num
+        self.begin_num_update = 0
+        self.num_update = 0
+        self._all_index_update_counts = {0: {}}
+        self._index_update_count = self._all_index_update_counts[0]
+        if param_idx2name is None:
+            param_idx2name = {}
+        if not isinstance(param_idx2name, dict):
+            raise MXNetError("param_idx2name must be a dict of param indexes to names")
+        self.idx2name = param_idx2name.copy()
+        self.param_dict = param_dict if param_dict else {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    # ------------------------------------------------------------- registry
+    @staticmethod
+    def register(klass):
+        name = klass.__name__.lower()
+        Optimizer.opt_registry[name] = klass
+        return klass
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        key = name.lower()
+        if key not in Optimizer.opt_registry:
+            raise MXNetError(f"cannot find optimizer {name!r}")
+        return Optimizer.opt_registry[key](**kwargs)
+
+    # ------------------------------------------------------------ state API
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = NDArray(weight.data.astype(jnp.float32))
+            return (self.create_state(index, weight_master_copy), weight_master_copy)
+        if weight.dtype == _np.float16 and not self.multi_precision:
+            logging.warning(
+                "Accumulating with float16 in optimizer can lead to poor accuracy "
+                "or slow convergence. Consider using multi_precision=True."
+            )
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            inner_state, weight32 = state
+            grad32 = NDArray(grad.data.astype(jnp.float32))
+            self.update(index, weight32, grad32, inner_state)
+            weight._rebind(weight32.data.astype(weight.data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # ------------------------------------------------------------ lr/wd mult
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been defined")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = args_lr_mult.copy()
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            is_weight = n.endswith("_weight")
+            if not is_weight:
+                self.wd_mult[n] = 0.0
+        self.wd_mult.update(args_wd_mult)
+
+    def _set_current_context(self, device_id):
+        if device_id not in self._all_index_update_counts:
+            self._all_index_update_counts[device_id] = {}
+        self._index_update_count = self._all_index_update_counts[device_id]
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lrs(self, indices):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        lrs = [lr] * len(indices)
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                lrs[i] *= self.param_dict[index].lr_mult
+            elif index in self.lr_mult:
+                lrs[i] *= self.lr_mult[index]
+            elif index in self.idx2name:
+                lrs[i] *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lrs
+
+    def _get_lr(self, index):
+        return self._get_lrs([index])[0]
+
+    def _get_wds(self, indices):
+        wds = [self.wd] * len(indices)
+        for i, index in enumerate(indices):
+            if index in self.param_dict:
+                wds[i] *= self.param_dict[index].wd_mult
+            elif index in self.wd_mult:
+                wds[i] *= self.wd_mult[index]
+            elif index in self.idx2name:
+                wds[i] *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wds
+
+    def _get_wd(self, index):
+        return self._get_wds([index])[0]
+
+    # ------------------------------------------------------- fused dispatch
+    def _apply(self, fn, weight, grad, states, lr, wd, **static_hypers):
+        """Run a pure fused-update op and rebind weight/states in place."""
+        hypers = dict(static_hypers)
+        hypers.setdefault("rescale_grad", float(self.rescale_grad))
+        hypers.setdefault(
+            "clip_gradient",
+            float(self.clip_gradient) if self.clip_gradient is not None else -1.0,
+        )
+        step = _jit_update(fn, tuple(sorted(hypers.items())))
+        state_list = [s for s in states if s is not None]
+        outs = step(
+            weight.data,
+            grad.data,
+            tuple(s.data for s in state_list),
+            jnp.float32(lr),
+            jnp.float32(wd),
+        )
+        weight._rebind(outs[0])
+        for s, new in zip(state_list, outs[1:]):
+            s._rebind(new)
+
+    def __getstate__(self):
+        # param_dict holds live (unpicklable) Parameter objects; the loader
+        # reattaches it (Trainer.load_states does) — reference behavior
+        ret = self.__dict__.copy()
+        ret["param_dict"] = {}
+        return ret
+
+
+register = Optimizer.register
+create = Optimizer.create_optimizer
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and optional multi-precision (reference
+    ``sgd_update``/``sgd_mom_update``/``mp_sgd_*``)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            self._apply(_fused.sgd_update, weight, grad, (), lr, wd)
+        else:
+            self._apply(_fused.sgd_mom_update, weight, grad, (state,), lr, wd,
+                        momentum=self.momentum)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            self._apply(_fused.signsgd_update, weight, grad, (), lr, wd)
+        else:
+            self._apply(_fused.signum_update, weight, grad, (state,), lr, wd,
+                        momentum=self.momentum, wd_lh=self.wd_lh)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if state is None:
+            self._apply(_fused.sgd_update, weight, grad, (), lr, wd)
+        else:
+            self._apply(_fused.nag_mom_update, weight, grad, (state,), lr, wd,
+                        momentum=self.momentum)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # mean
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # var
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        # bias correction folded into lr (reference does the same in Python)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        self._apply(_fused.adam_update, weight, grad, (mean, var), lr, wd,
+                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference contrib ``adamw_update``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, correct_bias=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.correct_bias = correct_bias
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        if self.correct_bias:
+            t = self._index_update_count[index]
+            lr = lr * math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        mean, var = state
+        self._apply(_fused.adamw_update, weight, grad, (mean, var), lr, wd,
+                    beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr /= 1.0 - self.beta1 ** t
+        m, u = state
+        g = grad.data * self.rescale_grad + wd * weight.data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        new_m = self.beta1 * m.data + (1.0 - self.beta1) * g
+        new_u = jnp.maximum(self.beta2 * u.data, jnp.abs(g))
+        m._rebind(new_m)
+        u._rebind(new_u)
+        weight._rebind(weight.data - lr * new_m / new_u)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        g = grad.data * self.rescale_grad + wd * weight.data
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        grad_prime = g / (1.0 - self.m_schedule)
+        new_m = self.beta1 * m.data + (1.0 - self.beta1) * g
+        new_v = self.beta2 * v.data + (1.0 - self.beta2) * jnp.square(g)
+        m_t_prime = new_m / (1.0 - m_schedule_next)
+        v_t_prime = new_v / (1.0 - self.beta2 ** t)
+        m_t_bar = (1.0 - momentum_t) * grad_prime + momentum_t_1 * m_t_prime
+        m._rebind(new_m)
+        v._rebind(new_v)
+        weight._rebind(
+            weight.data - lr * m_t_bar / (jnp.sqrt(v_t_prime) + self.epsilon)
+        )
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive large-batch optimizer (reference
+    ``lamb_update_phase1/2`` in ``src/operator/optimizer_op.cc``)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g, new_mean, new_var = _fused.lamb_update_phase1(
+            weight.data, grad.data, mean.data, var.data,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon, t=t,
+            bias_correction=self.bias_correction, wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_gradient=self.clip_gradient if self.clip_gradient is not None else -1.0,
+        )
+        mean._rebind(new_mean)
+        var._rebind(new_var)
+        r1 = jnp.linalg.norm(weight.data)
+        r2 = jnp.linalg.norm(g)
+        new_w = _fused.lamb_update_phase2(
+            weight.data, g, r1, r2, lr=lr,
+            lower_bound=self.lower_bound if self.lower_bound is not None else -1.0,
+            upper_bound=self.upper_bound if self.upper_bound is not None else -1.0,
+        )
+        weight._rebind(new_w)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise adaptive rate scaling (reference ``lars_*`` ops)."""
+
+    def __init__(self, learning_rate=0.1, momentum=0.0, eta=0.001,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        w_norm = jnp.linalg.norm(weight.data)
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where(
+            jnp.logical_and(w_norm > 0, g_norm > 0),
+            self.eta * w_norm / (g_norm + wd * w_norm + self.epsilon),
+            1.0,
+        )
+        g = g + wd * weight.data
+        if state is not None:
+            new_mom = self.momentum * state.data + lr * trust * g
+            state._rebind(new_mom)
+            weight._rebind(weight.data - new_mom)
+        else:
+            weight._rebind(weight.data - lr * trust * g)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (
+                NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # n
+                NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # g
+                NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # delta
+            )
+        return (NDArray(jnp.zeros(weight.shape, weight.data.dtype)),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        cw = self.clip_weights if self.clip_weights is not None else -1.0
+        if self.centered:
+            n, g, delta = state
+            self._apply(_fused.rmspropalex_update, weight, grad, (n, g, delta),
+                        lr, wd, gamma1=self.gamma1, gamma2=self.gamma2,
+                        epsilon=self.epsilon, clip_weights=cw)
+        else:
+            (n,) = state
+            self._apply(_fused.rmsprop_update, weight, grad, (n,), lr, wd,
+                        gamma1=self.gamma1, epsilon=self.epsilon, clip_weights=cw)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, learning_rate=None, eps=1e-7, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight.data
+        new_h = state.data + jnp.square(g)
+        state._rebind(new_h)
+        weight._rebind(
+            weight.data - lr * g / (jnp.sqrt(new_h) + self.float_stable_eps)
+        )
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # E[g^2]
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # E[dx^2]
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight.data
+        acc_g, acc_delta = state
+        new_acc_g = self.rho * acc_g.data + (1.0 - self.rho) * jnp.square(g)
+        delta = (
+            jnp.sqrt(acc_delta.data + self.epsilon)
+            / jnp.sqrt(new_acc_g + self.epsilon)
+        ) * g
+        new_acc_delta = self.rho * acc_delta.data + (1.0 - self.rho) * jnp.square(delta)
+        acc_g._rebind(new_acc_g)
+        acc_delta._rebind(new_acc_delta)
+        weight._rebind(weight.data - delta)
+
+
+@register
+class FTRL(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # z
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # n
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        z, n = state
+        self._apply(_fused.ftrl_update, weight, grad, (z, n), lr, wd,
+                    lamda1=self.lamda1, beta=self.beta)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics."""
+
+    def __init__(self, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _random
+
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight.data
+        noise = jax.random.normal(_random.next_key(), weight.shape,
+                                  weight.data.dtype) * math.sqrt(lr)
+        weight._rebind(weight.data - lr / 2 * g + noise)
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference ``dcasgd``)."""
+
+    def __init__(self, momentum=0.0, lamda=0.04, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return (None, NDArray(jnp.array(weight.data)))
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),
+            NDArray(jnp.array(weight.data)),
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        mon, previous_weight = state
+        comp = g + wd * weight.data + self.lamda * g * g * (
+            weight.data - previous_weight.data
+        )
+        if mon is not None:
+            new_mon = self.momentum * mon.data - lr * comp
+            mon._rebind(new_mon)
+            delta = new_mon
+        else:
+            delta = -lr * comp
+        previous_weight._rebind(weight.data)
+        weight._rebind(weight.data + delta)
+
+
+@register
+class Test(Optimizer):
+    """Reference test optimizer: w -= lr * grad (no wd)."""
+
+    def __init__(self, learning_rate=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype))
+
+    def update(self, index, weight, grad, state):
+        weight._rebind(weight.data - self.lr * grad.data * self.rescale_grad)
+
+
+ccSGD = SGD  # reference back-compat alias
+
+
+class Updater:
+    """Stateful update closure used by KVStore servers (reference
+    ``get_updater`` / ``Updater`` in optimizer.py)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+        self.aggregate_updates = optimizer.aggregate_num > 0
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = self.optimizer.create_state_multi_precision(
+                index, weight
+            )
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        states = (self.states, self.optimizer) if dump_optimizer else self.states
+
+        def _to_np(x):
+            if isinstance(x, NDArray):
+                return x.asnumpy()
+            if isinstance(x, (tuple, list)):
+                return tuple(_to_np(i) for i in x)
+            return x
+
+        serialized = {k: _to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((serialized, self.optimizer))
+        return pickle.dumps(serialized)
+
+    def set_states(self, states):
+        loaded = pickle.loads(states)
+        if isinstance(loaded, tuple) and len(loaded) == 2 and isinstance(
+            loaded[1], Optimizer
+        ):
+            loaded, self.optimizer = loaded
+
+        def _to_nd(x):
+            if isinstance(x, _np.ndarray):
+                return NDArray(jnp.asarray(x))
+            if isinstance(x, (tuple, list)):
+                return tuple(_to_nd(i) for i in x)
+            return x
+
+        self.states = {k: _to_nd(v) for k, v in loaded.items()}
+        self.states_synced = dict.fromkeys(self.states.keys(), False)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

@@ -25,15 +25,20 @@ import jax.numpy as jnp
 __all__ = ["seed", "next_key", "key_supply", "KeySupply", "current_key_supply"]
 
 _LOCK = threading.Lock()
-_GLOBAL_KEY = jax.random.PRNGKey(0)
+# host-side (seed, counter) state: next_key derives key = fold_in(PRNGKey(seed),
+# counter). Never stores a computed key array back — a computed key could be a
+# tracer when drawn inside a jit/eval_shape trace and would leak out.
+_GLOBAL_SEED = 0
+_GLOBAL_COUNTER = 0
 _SUPPLY = threading.local()
 
 
 def seed(seed_state: int, ctx=None):
     """Reference: ``mx.random.seed``; ctx accepted for compatibility."""
-    global _GLOBAL_KEY
+    global _GLOBAL_SEED, _GLOBAL_COUNTER
     with _LOCK:
-        _GLOBAL_KEY = jax.random.PRNGKey(int(seed_state))
+        _GLOBAL_SEED = int(seed_state)
+        _GLOBAL_COUNTER = 0
 
 
 class KeySupply:
@@ -74,7 +79,8 @@ def next_key():
     supply = current_key_supply()
     if supply is not None:
         return supply.next()
-    global _GLOBAL_KEY
+    global _GLOBAL_COUNTER
     with _LOCK:
-        _GLOBAL_KEY, sub = jax.random.split(_GLOBAL_KEY)
-    return sub
+        _GLOBAL_COUNTER += 1
+        count = _GLOBAL_COUNTER
+    return jax.random.fold_in(jax.random.PRNGKey(_GLOBAL_SEED), count)

@@ -1,0 +1,276 @@
+"""RecordIO (reference: ``python/mxnet/recordio.py`` over
+``dmlc-core/recordio`` [unverified]).
+
+Same wire format as the reference (magic ``0xced7230a``, 4-byte-aligned
+records, lrecord continuation codes) so ``.rec``/``.idx`` shards pack/unpack
+interchangeably. Hot-path batch decode is done by the native C++ pipeline
+(``src/io`` milestone); this module is the format + single-record API.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from collections import namedtuple
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = [
+    "MXRecordIO",
+    "MXIndexedRecordIO",
+    "IndexedRecordIO",
+    "IRHeader",
+    "pack",
+    "unpack",
+    "pack_img",
+    "unpack_img",
+]
+
+_MAGIC = 0xCED7230A
+# continuation codes (dmlc recordio splits records > kMaxRecSize)
+_K_MAX = (1 << 29) - 1
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(header):
+    return header >> 29, header & _K_MAX
+
+
+class MXRecordIO:
+    """Sequential .rec reader/writer (reference: ``MXRecordIO``)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.record = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.record = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.record = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"invalid flag {self.flag}")
+        self.pid = os.getpid()
+
+    def __del__(self):
+        self.close()
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["record"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def _check_pid(self):
+        # reference re-opened after fork (DataLoader workers)
+        if self.pid != os.getpid():
+            self.close()
+            self.open()
+
+    def close(self):
+        if self.record is not None and not self.record.closed:
+            self.record.close()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def write(self, buf: bytes):
+        assert self.writable
+        self._check_pid()
+        length = len(buf)
+        # single-part record (cflag 0); large records chunked like dmlc
+        pos = 0
+        nparts = (length + _K_MAX - 1) // _K_MAX if length else 1
+        for i in range(nparts):
+            part = buf[pos : pos + _K_MAX]
+            pos += len(part)
+            if nparts == 1:
+                cflag = 0
+            elif i == 0:
+                cflag = 1
+            elif i == nparts - 1:
+                cflag = 3
+            else:
+                cflag = 2
+            self.record.write(struct.pack("<II", _MAGIC,
+                                          _encode_lrec(cflag, len(part))))
+            self.record.write(part)
+            pad = (4 - (len(part) % 4)) % 4
+            if pad:
+                self.record.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        self._check_pid()
+        out = b""
+        while True:
+            head = self.record.read(8)
+            if len(head) < 8:
+                return out if out else None
+            magic, lrec = struct.unpack("<II", head)
+            if magic != _MAGIC:
+                raise MXNetError("invalid record magic; corrupt .rec file")
+            cflag, length = _decode_lrec(lrec)
+            data = self.record.read(length)
+            pad = (4 - (length % 4)) % 4
+            if pad:
+                self.record.read(pad)
+            out += data
+            if cflag in (0, 3):
+                return out
+
+    def tell(self):
+        return self.record.tell()
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """.rec + .idx random access (reference: ``MXIndexedRecordIO``)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        self.fidx = None
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if self.flag == "r" and os.path.exists(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin:
+                    parts = line.strip().split("\t")
+                    if len(parts) < 2:
+                        continue
+                    key = self.key_type(parts[0])
+                    self.idx[key] = int(parts[1])
+                    self.keys.append(key)
+        elif self.flag == "w":
+            self.fidx = open(self.idx_path, "w")
+
+    def close(self):
+        super().close()
+        if self.fidx is not None and not self.fidx.closed:
+            self.fidx.close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self._check_pid()
+        self.record.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.fidx.write(f"{key}\t{pos}\n")
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+IndexedRecordIO = MXIndexedRecordIO  # convenience alias
+
+IRHeader = namedtuple("IRHeader", ["flag", "label", "id", "id2"])
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header: IRHeader, s: bytes) -> bytes:
+    """Pack a label header + payload (reference: ``recordio.pack``)."""
+    header = IRHeader(*header)
+    if isinstance(header.label, (int, float)):
+        out = struct.pack(_IR_FORMAT, header.flag, header.label, header.id,
+                          header.id2)
+    else:
+        label = _np.asarray(header.label, dtype=_np.float32)
+        out = struct.pack(_IR_FORMAT, len(label), 0.0, header.id, header.id2)
+        out += label.tobytes()
+    return out + s
+
+
+def unpack(s: bytes):
+    header = IRHeader(*struct.unpack(_IR_FORMAT, s[:_IR_SIZE]))
+    s = s[_IR_SIZE:]
+    if header.flag > 0:
+        label = _np.frombuffer(s[: header.flag * 4], dtype=_np.float32)
+        s = s[header.flag * 4 :]
+        header = header._replace(label=label, flag=0)
+    return header, s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    """Encode an image array and pack (requires cv2 or PIL for encode)."""
+    encoded = _encode_image(img, quality, img_fmt)
+    return pack(header, encoded)
+
+
+def unpack_img(s, iscolor=-1):
+    header, img_bytes = unpack(s)
+    return header, _decode_image(img_bytes, iscolor)
+
+
+def _encode_image(img, quality, img_fmt):
+    try:
+        import cv2
+
+        ext = img_fmt if img_fmt.startswith(".") else "." + img_fmt
+        params = [cv2.IMWRITE_JPEG_QUALITY, quality] if "jp" in ext else []
+        ok, buf = cv2.imencode(ext, img, params)
+        if not ok:
+            raise MXNetError("cv2.imencode failed")
+        return buf.tobytes()
+    except ImportError:
+        pass
+    try:
+        import io as _io
+
+        from PIL import Image
+
+        b = _io.BytesIO()
+        Image.fromarray(_np.asarray(img)[..., ::-1]).save(
+            b, format="JPEG", quality=quality
+        )
+        return b.getvalue()
+    except ImportError as e:
+        raise MXNetError(
+            "image encoding needs cv2 or PIL, neither available"
+        ) from e
+
+
+def _decode_image(img_bytes, iscolor=-1):
+    try:
+        import cv2
+
+        return cv2.imdecode(_np.frombuffer(img_bytes, _np.uint8), iscolor)
+    except ImportError:
+        pass
+    try:
+        import io as _io
+
+        from PIL import Image
+
+        img = _np.asarray(Image.open(_io.BytesIO(img_bytes)))
+        return img[..., ::-1] if img.ndim == 3 else img  # RGB->BGR like cv2
+    except ImportError as e:
+        raise MXNetError(
+            "image decoding needs cv2 or PIL, neither available"
+        ) from e

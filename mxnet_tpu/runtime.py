@@ -1,0 +1,79 @@
+"""Runtime feature introspection (reference: ``python/mxnet/runtime.py`` over
+``src/libinfo.cc`` [unverified]: ``mx.runtime.feature_list()``)."""
+
+from __future__ import annotations
+
+from collections import namedtuple
+
+import jax
+
+__all__ = ["Feature", "feature_list", "Features"]
+
+Feature = namedtuple("Feature", ["name", "enabled"])
+
+
+def _detect():
+    feats = {
+        # compute backends
+        "TPU": any(d.platform == "tpu" for d in jax.devices())
+        if _safe_devices()
+        else False,
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "TENSORRT": False,
+        "MKLDNN": False,
+        # our backends
+        "XLA": True,
+        "PALLAS": True,
+        "BF16": True,
+        "F16C": True,
+        "INT64_TENSOR_SIZE": True,
+        # capabilities
+        "OPENCV": _has("cv2"),
+        "BLAS_OPEN": True,
+        "SSE": False,
+        "DIST_KVSTORE": True,
+        "PROFILER": True,
+        "SIGNAL_HANDLER": True,
+        "DEBUG": False,
+    }
+    return feats
+
+
+def _safe_devices():
+    try:
+        jax.devices()
+        return True
+    except Exception:
+        return False
+
+
+def _has(mod):
+    try:
+        __import__(mod)
+        return True
+    except ImportError:
+        return False
+
+
+class Features(dict):
+    """dict of name -> Feature with ``is_enabled`` (reference API)."""
+
+    def __init__(self):
+        super().__init__(
+            (k, Feature(k, v)) for k, v in _detect().items()
+        )
+
+    def __repr__(self):
+        return f"[{', '.join(f.name + (' ✔' if f.enabled else ' ✖') for f in self.values())}]"
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError(f"feature '{feature_name}' is unknown")
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

@@ -1,0 +1,113 @@
+"""gluon.data tests (reference: tests/python/unittest/test_gluon_data.py
+[unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data
+from mxnet_tpu.gluon.data import vision
+
+
+def test_array_dataset():
+    x = np.arange(20).reshape(10, 2)
+    y = np.arange(10)
+    ds = data.ArrayDataset(x, y)
+    assert len(ds) == 10
+    sample_x, sample_y = ds[3]
+    np.testing.assert_allclose(sample_x, [6, 7])
+    assert sample_y == 3
+
+
+def test_dataset_transform():
+    ds = data.SimpleDataset(list(range(5))).transform(lambda x: x * 2)
+    assert ds[2] == 4
+    ds2 = data.ArrayDataset(np.arange(4), np.arange(4)).transform_first(
+        lambda x: x + 10
+    )
+    assert ds2[1] == (11, 1)
+
+
+def test_dataset_shard_take_filter():
+    ds = data.SimpleDataset(list(range(10)))
+    assert len(ds.shard(3, 0)) == 4
+    assert len(ds.shard(3, 2)) == 3
+    assert len(ds.take(4)) == 4
+    assert len(ds.filter(lambda x: x % 2 == 0)) == 5
+
+
+def test_samplers():
+    seq = list(data.SequentialSampler(5))
+    assert seq == [0, 1, 2, 3, 4]
+    rnd = list(data.RandomSampler(100))
+    assert sorted(rnd) == list(range(100)) and rnd != list(range(100))
+    batches = list(data.BatchSampler(data.SequentialSampler(7), 3, "keep"))
+    assert batches == [[0, 1, 2], [3, 4, 5], [6]]
+    batches = list(data.BatchSampler(data.SequentialSampler(7), 3, "discard"))
+    assert len(batches) == 2
+
+
+def test_dataloader_basic():
+    x = np.random.randn(17, 3).astype("float32")
+    y = np.arange(17).astype("float32")
+    loader = data.DataLoader(data.ArrayDataset(x, y), batch_size=5)
+    batches = list(loader)
+    assert len(batches) == 4
+    assert batches[0][0].shape == (5, 3)
+    assert batches[-1][0].shape == (2, 3)
+    np.testing.assert_allclose(batches[0][1].asnumpy(), y[:5])
+
+
+def test_dataloader_shuffle_covers_all():
+    y = np.arange(30)
+    loader = data.DataLoader(
+        data.ArrayDataset(y), batch_size=10, shuffle=True
+    )
+    seen = np.concatenate([b.asnumpy() for b in loader])
+    assert sorted(seen.tolist()) == y.tolist()
+
+
+def test_dataloader_workers():
+    x = np.random.randn(23, 4).astype("float32")
+    loader = data.DataLoader(
+        data.ArrayDataset(x), batch_size=4, num_workers=2
+    )
+    batches = list(loader)
+    assert sum(b.shape[0] for b in batches) == 23
+    got = np.concatenate([b.asnumpy() for b in batches])
+    np.testing.assert_allclose(got, x)
+
+
+def test_dataloader_last_batch_modes():
+    ds = data.ArrayDataset(np.arange(10))
+    assert len(list(data.DataLoader(ds, 3, last_batch="keep"))) == 4
+    assert len(list(data.DataLoader(ds, 3, last_batch="discard"))) == 3
+
+
+def test_transforms_totensor_normalize():
+    t = vision.transforms.Compose(
+        [
+            vision.transforms.ToTensor(),
+            vision.transforms.Normalize(0.5, 0.25),
+        ]
+    )
+    img = (np.ones((4, 4, 3)) * 255).astype("uint8")
+    out = t(mx.nd.array(img))
+    assert out.shape == (3, 4, 4)
+    np.testing.assert_allclose(out.asnumpy(), 2.0, rtol=1e-5)
+
+
+def test_transforms_resize_crop():
+    img = np.random.randint(0, 255, (10, 8, 3)).astype("uint8")
+    out = vision.transforms.Resize(4)(mx.nd.array(img))
+    assert out.shape == (4, 4, 3)
+    out = vision.transforms.CenterCrop(6)(mx.nd.array(img))
+    assert out.shape == (6, 6, 3)
+    out = vision.transforms.RandomResizedCrop(5)(mx.nd.array(img))
+    assert out.shape == (5, 5, 3)
+
+
+def test_transforms_flip_deterministic_shape():
+    img = np.random.randint(0, 255, (6, 6, 3)).astype("uint8")
+    out = vision.transforms.RandomFlipLeftRight()(mx.nd.array(img))
+    assert out.shape == (6, 6, 3)

@@ -1,0 +1,350 @@
+"""Gluon Block/HybridBlock/Parameter/Trainer tests (modeled on the
+reference's tests/python/unittest/test_gluon.py [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(10, 10))
+    p.initialize(init="xavier")
+    assert p.data().shape == (10, 10)
+    assert p.grad().shape == (10, 10)
+    assert len(p.list_data()) == 1
+
+
+def test_parameter_invalid_grad_req():
+    with pytest.raises(mx.MXNetError):
+        gluon.Parameter("w", shape=(1,), grad_req="bogus")
+
+
+def test_parameter_dict_sharing():
+    shared = gluon.ParameterDict("net_")
+    shared.get("dense0_weight", shape=(4, 4))
+    child = gluon.ParameterDict("net_", shared=shared)
+    p = child.get("dense0_weight")
+    assert p is shared["net_dense0_weight"]
+
+
+def test_constant_parameter():
+    c = gluon.Constant("c", mx.nd.array([[1.0, 2.0]]))
+    c.initialize()
+    np.testing.assert_allclose(c.data().asnumpy(), [[1.0, 2.0]])
+    assert c.grad_req == "null"
+
+
+def test_dense_forward_shape():
+    layer = nn.Dense(8, in_units=4)
+    layer.initialize()
+    out = layer(mx.nd.ones((2, 4)))
+    assert out.shape == (2, 8)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(8)
+    layer.initialize()
+    out = layer(mx.nd.ones((2, 5)))
+    assert out.shape == (2, 8)
+    assert layer.weight.shape == (8, 5)
+
+
+def test_dense_no_flatten():
+    layer = nn.Dense(7, flatten=False)
+    layer.initialize()
+    out = layer(mx.nd.ones((2, 3, 5)))
+    assert out.shape == (2, 3, 7)
+
+
+def test_block_name_scope():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        d = nn.Dense(4)
+    assert d.prefix.startswith("model_")
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4), nn.Dense(2, in_units=4))
+    net.initialize()
+    weights = net.collect_params(".*weight")
+    assert all(k.endswith("weight") for k in weights.keys())
+    assert len(weights) == 2
+
+
+def _make_mlp():
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    return net
+
+
+def test_hybridize_matches_eager():
+    x = mx.nd.array(np.random.randn(3, 8))
+    net = _make_mlp()
+    net.initialize()
+    eager = net(x).asnumpy()
+    net.hybridize()
+    staged = net(x).asnumpy()
+    np.testing.assert_allclose(eager, staged, rtol=1e-5, atol=1e-6)
+
+
+def test_hybridize_grads_match_eager():
+    x = mx.nd.array(np.random.randn(4, 8))
+    net = _make_mlp()
+    net.initialize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    eager_grads = {
+        k: v.grad().asnumpy() for k, v in net.collect_params().items()
+    }
+    net.hybridize()
+    with autograd.record():
+        loss = (net(x) ** 2).sum()
+    loss.backward()
+    for k, v in net.collect_params().items():
+        np.testing.assert_allclose(
+            eager_grads[k], v.grad().asnumpy(), rtol=1e-4, atol=1e-5
+        )
+
+
+def test_hybridize_retrace_on_shape_change():
+    net = _make_mlp()
+    net.initialize()
+    net.hybridize()
+    assert net(mx.nd.ones((2, 8))).shape == (2, 4)
+    assert net(mx.nd.ones((5, 8))).shape == (5, 4)
+
+
+def test_batchnorm_moving_stats_update_eager_and_hybrid():
+    for hybridize in (False, True):
+        bn = nn.BatchNorm(in_channels=3)
+        bn.initialize()
+        if hybridize:
+            bn.hybridize()
+        x = mx.nd.array(np.random.randn(8, 3, 4, 4) * 2 + 5)
+        with autograd.record():
+            bn(x)
+        rm = bn.running_mean.data().asnumpy()
+        assert not np.allclose(rm, 0), f"hybridize={hybridize}"
+        # eval mode: uses running stats, no update
+        rm_before = bn.running_mean.data().asnumpy()
+        bn(x)
+        np.testing.assert_allclose(
+            rm_before, bn.running_mean.data().asnumpy()
+        )
+
+
+def test_batchnorm_normalizes():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = mx.nd.array(np.random.randn(16, 4, 3, 3) * 3 + 7)
+    with autograd.record():
+        y = bn(x)
+    yn = y.asnumpy()
+    assert abs(yn.mean()) < 1e-2
+    assert abs(yn.std() - 1) < 1e-1
+
+
+def test_dropout_train_vs_eval():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    x = mx.nd.ones((100, 100))
+    with autograd.record():
+        y_train = do(x)
+    y_eval = do(x)
+    np.testing.assert_allclose(y_eval.asnumpy(), 1.0)
+    zeros = (y_train.asnumpy() == 0).mean()
+    assert 0.3 < zeros < 0.7
+
+
+def test_dropout_hybrid_varies_across_calls():
+    do = nn.Dropout(0.5)
+    do.initialize()
+    do.hybridize()
+    x = mx.nd.ones((40, 40))
+    with autograd.record():
+        m1 = do(x).asnumpy()
+        m2 = do(x).asnumpy()
+    assert not np.allclose(m1, m2), "dropout mask must differ per call"
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(12, kernel_size=3, padding=1, strides=2)
+    conv.initialize()
+    out = conv(mx.nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 12, 4, 4)
+    assert conv.weight.shape == (12, 3, 3, 3)
+
+
+def test_conv_groups():
+    conv = nn.Conv2D(8, kernel_size=1, groups=4, in_channels=8)
+    conv.initialize()
+    assert conv.weight.shape == (8, 2, 1, 1)
+    out = conv(mx.nd.ones((1, 8, 4, 4)))
+    assert out.shape == (1, 8, 4, 4)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    deconv.initialize()
+    out = deconv(mx.nd.ones((1, 3, 5, 5)))
+    assert out.shape == (1, 4, 10, 10)
+
+
+def test_pooling_layers():
+    x = mx.nd.array(np.random.randn(2, 3, 8, 8))
+    assert nn.MaxPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.AvgPool2D(2, 2)(x).shape == (2, 3, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (2, 3, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (2, 3, 1, 1)
+
+
+def test_embedding():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    idx = mx.nd.array(np.array([[1, 2], [3, 4]]))
+    out = emb(idx)
+    assert out.shape == (2, 2, 4)
+    with autograd.record():
+        loss = emb(idx).sum()
+    loss.backward()
+    g = emb.weight.grad().asnumpy()
+    assert g[1].sum() != 0 and g[0].sum() == 0
+
+
+def test_layernorm_layer():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = mx.nd.array(np.random.randn(4, 6) * 3 + 2)
+    y = ln(x).asnumpy()
+    np.testing.assert_allclose(y.mean(axis=-1), 0, atol=1e-5)
+
+
+def test_activations():
+    x = mx.nd.array(np.array([-2.0, -0.5, 0.0, 1.0]))
+    np.testing.assert_allclose(
+        nn.LeakyReLU(0.1)(x).asnumpy(), [-0.2, -0.05, 0.0, 1.0], rtol=1e-6
+    )
+    prelu = nn.PReLU()
+    prelu.initialize()
+    np.testing.assert_allclose(
+        prelu(x).asnumpy(), [-0.5, -0.125, 0.0, 1.0], rtol=1e-6
+    )
+    gelu = nn.GELU()
+    assert gelu(x).asnumpy()[3] == pytest.approx(0.8413, rel=1e-3)
+
+
+def test_sequential_indexing():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(3), nn.Dense(2))
+    assert len(net) == 3
+    assert isinstance(net[1], nn.Dense)
+
+
+def test_save_load_parameters(tmp_path):
+    net = _make_mlp()
+    net.initialize()
+    x = mx.nd.ones((2, 8))
+    expected = net(x).asnumpy()
+    fname = str(tmp_path / "mlp.params")
+    net.save_parameters(fname)
+    net2 = _make_mlp()
+    net2.load_parameters(fname)
+    np.testing.assert_allclose(net2(x).asnumpy(), expected, rtol=1e-6)
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = mx.nd.array([[1.0, 2.0]])
+    w_before = net.weight.data().asnumpy().copy()
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    expected = w_before - 0.1 * np.array([[1.0, 2.0]])
+    np.testing.assert_allclose(net.weight.data().asnumpy(), expected, rtol=1e-5)
+
+
+def test_trainer_learning_rate_set():
+    net = nn.Dense(1, in_units=1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.5})
+    assert trainer.learning_rate == 0.5
+    trainer.set_learning_rate(0.2)
+    assert trainer.learning_rate == 0.2
+
+
+def test_trainer_save_load_states(tmp_path):
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    x = mx.nd.ones((1, 2))
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    trainer.step(1)
+    fname = str(tmp_path / "trainer.states")
+    trainer.save_states(fname)
+    trainer2 = gluon.Trainer(net.collect_params(), "sgd",
+                             {"learning_rate": 0.1, "momentum": 0.9})
+    trainer2._init_kvstore()  # load is deferred until kvstore init
+    trainer2.load_states(fname)
+    s1 = trainer._updaters[0].states
+    s2 = trainer2._updaters[0].states
+    assert set(s1.keys()) == set(s2.keys())
+
+
+def test_forward_hooks():
+    net = nn.Dense(2, in_units=2)
+    net.initialize()
+    calls = []
+    h1 = net.register_forward_pre_hook(lambda blk, ins: calls.append("pre"))
+    h2 = net.register_forward_hook(lambda blk, ins, out: calls.append("post"))
+    net(mx.nd.ones((1, 2)))
+    assert calls == ["pre", "post"]
+    h1.detach()
+    h2.detach()
+    net(mx.nd.ones((1, 2)))
+    assert calls == ["pre", "post"]
+
+
+def test_lambda_blocks():
+    lam = nn.Lambda("relu")
+    out = lam(mx.nd.array([-1.0, 1.0]))
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 1.0])
+    hlam = nn.HybridLambda(lambda F, x: F.relu(x) + 1)
+    out = hlam(mx.nd.array([-1.0, 1.0]))
+    np.testing.assert_allclose(out.asnumpy(), [1.0, 2.0])
+
+
+def test_mlp_training_converges():
+    np.random.seed(0)
+    x = np.random.randn(64, 4).astype("float32")
+    w_true = np.random.randn(4, 1).astype("float32")
+    y = x @ w_true
+    net = nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.05})
+    loss_fn = gluon.loss.L2Loss()
+    xs, ys = mx.nd.array(x), mx.nd.array(y)
+    first = None
+    for i in range(60):
+        with autograd.record():
+            L = loss_fn(net(xs), ys)
+        L.backward()
+        trainer.step(64)
+        if first is None:
+            first = float(L.mean().asscalar())
+    last = float(L.mean().asscalar())
+    assert last < first * 0.1, (first, last)

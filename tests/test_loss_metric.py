@@ -1,0 +1,204 @@
+"""Loss + metric tests vs NumPy references (reference strategy:
+tests/python/unittest/test_loss.py, test_metric.py [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, metric
+from mxnet_tpu.ndarray import array as nd
+
+
+# ------------------------------------------------------------------- losses
+def test_l2_loss():
+    pred = nd(np.array([[1.0, 2.0], [3.0, 4.0]]))
+    label = nd(np.array([[1.5, 2.0], [3.0, 3.0]]))
+    loss = gluon.loss.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(loss, [0.0625, 0.25], rtol=1e-6)
+
+
+def test_l1_loss():
+    pred = nd(np.array([[1.0, 2.0]]))
+    label = nd(np.array([[2.0, 4.0]]))
+    loss = gluon.loss.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(loss, [1.5], rtol=1e-6)
+
+
+def test_softmax_ce_loss_sparse():
+    logits = np.random.randn(4, 5).astype("float32")
+    labels = np.array([0, 2, 1, 4])
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()(nd(logits), nd(labels)).asnumpy()
+    p = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+    expected = -np.log(p[np.arange(4), labels])
+    np.testing.assert_allclose(loss, expected, rtol=1e-4)
+
+
+def test_softmax_ce_loss_dense_label():
+    logits = np.random.randn(3, 4).astype("float32")
+    onehot = np.eye(4, dtype="float32")[[1, 2, 0]]
+    l_sparse = gluon.loss.SoftmaxCrossEntropyLoss()(
+        nd(logits), nd(np.array([1, 2, 0]))
+    ).asnumpy()
+    l_dense = gluon.loss.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        nd(logits), nd(onehot)
+    ).asnumpy()
+    np.testing.assert_allclose(l_sparse, l_dense, rtol=1e-5)
+
+
+def test_sigmoid_bce_loss():
+    pred = np.random.randn(4, 3).astype("float32")
+    label = (np.random.rand(4, 3) > 0.5).astype("float32")
+    loss = gluon.loss.SigmoidBCELoss()(nd(pred), nd(label)).asnumpy()
+    x, z = pred, label
+    expected = (np.maximum(x, 0) - x * z + np.log1p(np.exp(-np.abs(x)))).mean(-1)
+    np.testing.assert_allclose(loss, expected, rtol=1e-4)
+
+
+def test_kl_div_loss():
+    logp = np.log(np.array([[0.25, 0.25, 0.5]], dtype="float32"))
+    label = np.array([[0.25, 0.25, 0.5]], dtype="float32")
+    loss = gluon.loss.KLDivLoss()(nd(logp), nd(label)).asnumpy()
+    np.testing.assert_allclose(loss, [0.0], atol=1e-6)
+
+
+def test_huber_loss():
+    pred = nd(np.array([[0.0]]))
+    label = nd(np.array([[2.0]]))
+    loss = gluon.loss.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    np.testing.assert_allclose(loss, [1.5], rtol=1e-6)  # 2 - 0.5*1
+
+
+def test_hinge_loss():
+    pred = nd(np.array([[0.5], [2.0]]))
+    label = nd(np.array([[1.0], [1.0]]))
+    loss = gluon.loss.HingeLoss()(pred, label).asnumpy()
+    np.testing.assert_allclose(loss, [0.5, 0.0], rtol=1e-6)
+
+
+def test_triplet_loss():
+    a = nd(np.zeros((2, 3), dtype="float32"))
+    p = nd(np.zeros((2, 3), dtype="float32"))
+    n = nd(np.ones((2, 3), dtype="float32"))
+    loss = gluon.loss.TripletLoss(margin=1.0)(a, p, n).asnumpy()
+    np.testing.assert_allclose(loss, [0.0, 0.0])  # dist to neg=3 > margin
+
+
+def test_ctc_loss_simple():
+    # single frame, single label: loss = -log P(label)
+    T, N, C, L = 4, 2, 5, 2
+    logits = np.random.randn(N, T, C).astype("float32")
+    labels = np.array([[1, 2], [3, 4]], dtype="float32")
+    loss = gluon.loss.CTCLoss()(nd(logits), nd(labels)).asnumpy()
+    assert loss.shape == (N,)
+    assert (loss > 0).all()
+
+
+def test_loss_gradients_flow():
+    net_pred = nd(np.random.randn(4, 3).astype("float32"))
+    net_pred.attach_grad()
+    label = nd(np.array([0, 1, 2, 0]))
+    with autograd.record():
+        L = gluon.loss.SoftmaxCrossEntropyLoss()(net_pred, label)
+    L.backward()
+    assert not np.allclose(net_pred.grad.asnumpy(), 0)
+
+
+# ------------------------------------------------------------------ metrics
+def test_accuracy():
+    acc = metric.Accuracy()
+    pred = nd(np.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]]))
+    label = nd(np.array([1, 0, 0]))
+    acc.update([label], [pred])
+    assert acc.get() == ("accuracy", pytest.approx(2.0 / 3))
+
+
+def test_topk_accuracy():
+    topk = metric.TopKAccuracy(top_k=2)
+    pred = nd(np.array([[0.1, 0.2, 0.7], [0.6, 0.3, 0.1]]))
+    label = nd(np.array([1, 2]))
+    topk.update([label], [pred])
+    name, val = topk.get()
+    assert val == pytest.approx(0.5)
+
+
+def test_mse_rmse_mae():
+    label = nd(np.array([1.0, 2.0]))
+    pred = nd(np.array([1.5, 2.5]))
+    for m, expected in [(metric.MSE(), 0.25), (metric.RMSE(), 0.5),
+                        (metric.MAE(), 0.5)]:
+        m.update([label], [pred])
+        assert m.get()[1] == pytest.approx(expected)
+
+
+def test_f1():
+    f1 = metric.F1()
+    pred = nd(np.array([[0.2, 0.8], [0.8, 0.2], [0.3, 0.7]]))
+    label = nd(np.array([1, 0, 0]))
+    f1.update([label], [pred])
+    # tp=1 fp=1 fn=0 -> p=0.5 r=1 -> f1=2/3
+    assert f1.get()[1] == pytest.approx(2.0 / 3)
+
+
+def test_perplexity():
+    ppl = metric.Perplexity()
+    pred = nd(np.array([[0.5, 0.5], [0.9, 0.1]]))
+    label = nd(np.array([0, 0]))
+    ppl.update([label], [pred])
+    expected = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    assert ppl.get()[1] == pytest.approx(expected, rel=1e-5)
+
+
+def test_composite_and_create():
+    comp = metric.create(["acc", "mse"])
+    assert isinstance(comp, metric.CompositeEvalMetric)
+    pred = nd(np.array([[0.0, 1.0]]))
+    label = nd(np.array([1]))
+    comp.update([label], [pred])
+    names, values = comp.get()
+    assert "accuracy" in names
+
+
+def test_custom_metric():
+    m = metric.np(lambda label, pred: float((label == pred).mean()))
+    m.update(nd(np.array([1.0, 0.0])), nd(np.array([1.0, 1.0])))
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_loss_metric():
+    m = metric.Loss()
+    m.update(None, nd(np.array([2.0, 4.0])))
+    assert m.get()[1] == pytest.approx(3.0)
+
+
+# -------------------------------------------------------------- initializer
+def test_initializers():
+    from mxnet_tpu import initializer as init
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    import jax.numpy as jnp
+
+    arr = NDArray(jnp.zeros((50, 20)))
+    init.Xavier()(init.InitDesc("fc_weight"), arr)
+    a = arr.asnumpy()
+    bound = np.sqrt(3.0 / ((50 + 20) / 2))
+    assert abs(a).max() <= bound + 1e-6
+    assert a.std() > 0.1 * bound
+
+    init.Constant(3.0)("w_weight", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 3.0)
+
+    # suffix dispatch
+    init.Xavier()("fc_bias", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 0.0)
+
+    mixed = init.Mixed([".*bias", ".*"], [init.One(), init.Zero()])
+    mixed("fc_bias", arr)
+    np.testing.assert_allclose(arr.asnumpy(), 1.0)
+
+
+def test_initializer_create_by_name():
+    from mxnet_tpu import initializer as init
+
+    assert isinstance(init.create("xavier"), init.Xavier)
+    assert isinstance(init.create("normal", sigma=0.5), init.Normal)
+    with pytest.raises(mx.MXNetError):
+        init.create("bogus_init")

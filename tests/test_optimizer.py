@@ -1,0 +1,211 @@
+"""Optimizer tests: each update rule checked against a NumPy reference
+implementation (the strategy the reference used in
+tests/python/unittest/test_optimizer.py [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.ndarray import array as nd
+
+
+def _setup(shape=(5, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w = rng.randn(*shape).astype("float32")
+    g = rng.randn(*shape).astype("float32")
+    return w, g
+
+
+def test_sgd_no_momentum():
+    w, g = _setup()
+    sgd = opt.SGD(learning_rate=0.1, wd=0.0)
+    weight, grad = nd(w), nd(g)
+    state = sgd.create_state(0, weight)
+    sgd.update(0, weight, grad, state)
+    np.testing.assert_allclose(weight.asnumpy(), w - 0.1 * g, rtol=1e-6)
+
+
+def test_sgd_momentum_wd():
+    w, g = _setup()
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, wd=0.01)
+    weight, grad = nd(w), nd(g)
+    state = sgd.create_state(0, weight)
+    mom = np.zeros_like(w)
+    for _ in range(3):
+        sgd.update(0, weight, grad, state)
+        gw = g + 0.01 * w
+        mom = 0.9 * mom - 0.1 * gw
+        w = w + mom
+    np.testing.assert_allclose(weight.asnumpy(), w, rtol=1e-5)
+
+
+def test_sgd_rescale_and_clip():
+    w, g = _setup()
+    sgd = opt.SGD(learning_rate=1.0, rescale_grad=0.5, clip_gradient=0.1)
+    weight, grad = nd(w), nd(g)
+    sgd.update(0, weight, grad, None)
+    expected = w - np.clip(g * 0.5, -0.1, 0.1)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-6)
+
+
+def test_adam():
+    w, g = _setup()
+    adam = opt.Adam(learning_rate=0.01)
+    weight, grad = nd(w), nd(g)
+    state = adam.create_state(0, weight)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    for t in range(1, 4):
+        adam.update(0, weight, grad, state)
+        lr = 0.01 * np.sqrt(1 - 0.999 ** t) / (1 - 0.9 ** t)
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        w = w - lr * m / (np.sqrt(v) + 1e-8)
+    np.testing.assert_allclose(weight.asnumpy(), w, rtol=1e-5)
+
+
+def test_adamw_decoupled_wd():
+    w, g = _setup()
+    aw = opt.AdamW(learning_rate=0.01, wd=0.1)
+    weight, grad = nd(w), nd(g)
+    state = aw.create_state(0, weight)
+    aw.update(0, weight, grad, state)
+    # wd must NOT enter the moment estimates
+    m = 0.1 * g
+    v = 0.001 * g * g
+    lr = 0.01 * np.sqrt(1 - 0.999) / (1 - 0.9)
+    expected = w - lr * (m / (np.sqrt(v) + 1e-8) + 0.1 * w)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-5)
+
+
+def test_nag():
+    w, g = _setup()
+    nag = opt.NAG(learning_rate=0.1, momentum=0.9)
+    weight, grad = nd(w), nd(g)
+    state = nag.create_state(0, weight)
+    nag.update(0, weight, grad, state)
+    mom = g  # first step: momentum*0 + grad
+    expected = w - 0.1 * (g + 0.9 * mom)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-5)
+
+
+def test_rmsprop():
+    w, g = _setup()
+    rms = opt.RMSProp(learning_rate=0.01, gamma1=0.9)
+    weight, grad = nd(w), nd(g)
+    state = rms.create_state(0, weight)
+    rms.update(0, weight, grad, state)
+    n = 0.1 * g * g
+    expected = w - 0.01 * g / np.sqrt(n + 1e-8)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-4)
+
+
+def test_adagrad():
+    w, g = _setup()
+    ada = opt.AdaGrad(learning_rate=0.1)
+    weight, grad = nd(w), nd(g)
+    state = ada.create_state(0, weight)
+    ada.update(0, weight, grad, state)
+    expected = w - 0.1 * g / (np.sqrt(g * g) + 1e-7)
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-5)
+
+
+def test_lamb_runs_and_trust_ratio():
+    w, g = _setup()
+    lamb = opt.LAMB(learning_rate=0.01)
+    weight, grad = nd(w), nd(g)
+    state = lamb.create_state(0, weight)
+    w_before = weight.asnumpy().copy()
+    lamb.update(0, weight, grad, state)
+    assert not np.allclose(weight.asnumpy(), w_before)
+
+
+def test_ftrl_sparse_zeroing():
+    w, g = _setup()
+    ftrl = opt.FTRL(learning_rate=0.1, lamda1=100.0)
+    weight, grad = nd(w), nd(g)
+    state = ftrl.create_state(0, weight)
+    ftrl.update(0, weight, grad, state)
+    # enormous l1 forces all coords to zero
+    np.testing.assert_allclose(weight.asnumpy(), 0.0)
+
+
+def test_signum():
+    w, g = _setup()
+    s = opt.Signum(learning_rate=0.1, momentum=0.0)
+    weight, grad = nd(w), nd(g)
+    s.update(0, weight, grad, None)
+    np.testing.assert_allclose(weight.asnumpy(), w - 0.1 * np.sign(g), rtol=1e-6)
+
+
+def test_lr_scheduler_factor():
+    from mxnet_tpu.optimizer import lr_scheduler
+
+    sched = lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert sched(1) == 1.0
+    assert sched(11) == pytest.approx(0.5)
+    assert sched(21) == pytest.approx(0.25)
+
+
+def test_lr_scheduler_warmup():
+    from mxnet_tpu.optimizer import lr_scheduler
+
+    sched = lr_scheduler.PolyScheduler(
+        max_update=100, base_lr=1.0, pwr=1, warmup_steps=10
+    )
+    assert sched(0) == 0.0
+    assert sched(5) == pytest.approx(0.5)
+    assert sched(10) == pytest.approx(1.0)
+    assert sched(100) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_lr_scheduler_cosine():
+    from mxnet_tpu.optimizer import lr_scheduler
+
+    sched = lr_scheduler.CosineScheduler(max_update=100, base_lr=2.0)
+    assert sched(0) == pytest.approx(2.0)
+    assert sched(50) == pytest.approx(1.0)
+    assert sched(100) == pytest.approx(0.0, abs=1e-9)
+
+
+def test_optimizer_registry_create():
+    o = opt.create("adam", learning_rate=0.003)
+    assert isinstance(o, opt.Adam)
+    assert o.lr == 0.003
+    with pytest.raises(mx.MXNetError):
+        opt.create("nonexistent_opt")
+
+
+def test_lr_wd_mult():
+    w, g = _setup()
+    sgd = opt.SGD(learning_rate=0.1, param_idx2name={0: "fc_weight"})
+    sgd.set_lr_mult({"fc_weight": 0.0})
+    weight, grad = nd(w), nd(g)
+    sgd.update(0, weight, grad, None)
+    np.testing.assert_allclose(weight.asnumpy(), w)  # lr_mult 0 freezes
+
+
+def test_updater_serialization():
+    w, g = _setup()
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9)
+    upd = opt.get_updater(sgd)
+    weight, grad = nd(w), nd(g)
+    upd(0, grad, weight)
+    blob = upd.get_states()
+    upd2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    upd2.set_states(blob)
+    np.testing.assert_allclose(
+        upd.states[0].asnumpy(), upd2.states[0].asnumpy()
+    )
+
+
+def test_multi_precision_fp16():
+    w = np.random.randn(4, 4).astype("float16")
+    g = np.random.randn(4, 4).astype("float16")
+    sgd = opt.SGD(learning_rate=0.1, momentum=0.9, multi_precision=True)
+    weight, grad = nd(w, dtype="float16"), nd(g, dtype="float16")
+    state = sgd.create_state_multi_precision(0, weight)
+    assert state[1].dtype == np.float32  # master copy
+    sgd.update_multi_precision(0, weight, grad, state)
+    assert weight.dtype == np.float16
