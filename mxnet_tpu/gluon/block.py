@@ -107,9 +107,11 @@ class _trace_scope:
 
 # ------------------------------------------------------------------ namescope
 class _BlockScope:
-    """Counter-based auto-naming (reference: ``_BlockScope``)."""
+    """Counter-based auto-naming (reference: ``_BlockScope`` +
+    ``name.NameManager`` for top-level blocks)."""
 
     _current = threading.local()
+    _global_counter = {}  # hint -> count, for blocks created outside a scope
 
     def __init__(self, block):
         self._block = block
@@ -122,7 +124,9 @@ class _BlockScope:
         current = getattr(_BlockScope._current, "value", None)
         if current is None:
             if prefix is None:
-                prefix = hint + "0_"
+                count = _BlockScope._global_counter.get(hint, 0)
+                _BlockScope._global_counter[hint] = count + 1
+                prefix = f"{hint}{count}_"
             if params is None:
                 params = ParameterDict(prefix)
             else:

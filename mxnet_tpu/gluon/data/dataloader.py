@@ -84,14 +84,27 @@ class DataLoader:
             it = iter(self._batch_sampler)
             stop = threading.Event()
 
+            def put_checked(item):
+                # bounded put that keeps observing `stop` so an abandoned
+                # iterator (break/exception in the consumer) never leaves
+                # the feeder blocked forever on a full queue
+                while not stop.is_set():
+                    try:
+                        futures.put(item, timeout=0.1)
+                        return True
+                    except queue.Full:
+                        continue
+                return False
+
             def feeder():
                 try:
                     for indices in it:
                         if stop.is_set():
                             return
-                        futures.put(pool.submit(self._load, indices))
+                        if not put_checked(pool.submit(self._load, indices)):
+                            return
                 finally:
-                    futures.put(None)
+                    put_checked(None)
 
             t = threading.Thread(target=feeder, daemon=True)
             t.start()

@@ -1,5 +1,8 @@
-"""Model zoo (reference: ``python/mxnet/gluon/model_zoo/`` [unverified])."""
+"""Model zoo (reference: ``python/mxnet/gluon/model_zoo/`` [unverified];
+language models mirror the GluonNLP-era workloads in BASELINE.md)."""
 
 from . import vision  # noqa: F401
+from . import bert  # noqa: F401
+from . import transformer  # noqa: F401
 
-__all__ = ["vision"]
+__all__ = ["vision", "bert", "transformer"]

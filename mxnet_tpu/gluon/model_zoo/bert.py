@@ -1,0 +1,161 @@
+"""BERT (reference workload: GluonNLP BERT-base over the contrib interleaved
+attention ops + ``src/operator/nn/layer_norm.cc`` [unverified]; BASELINE.md
+config 3 = BERT-base pretrain).
+
+TPU-first: attention is the Pallas flash kernel (O(S) memory), the whole
+encoder stages into one XLA program under ``hybridize()``, and embeddings +
+MLM head share weights like the original."""
+
+from __future__ import annotations
+
+import math
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..nn import (
+    Dense, Dropout, Embedding, GELU, HybridSequential, LayerNorm,
+    MultiHeadAttention,
+)
+
+__all__ = [
+    "BERTEncoderLayer", "BERTEncoder", "BERTModel",
+    "BERTForPretraining", "bert_base", "bert_large", "get_bert",
+]
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = Dense(hidden_size, flatten=False, prefix="ffn1_")
+            self.act = GELU()
+            self.ffn_2 = Dense(units, flatten=False, prefix="ffn2_")
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        return self.drop(self.ffn_2(self.act(self.ffn_1(x))))
+
+
+class BERTEncoderLayer(HybridBlock):
+    """Post-LN transformer encoder layer (original BERT arrangement)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(
+                units, num_heads, dropout=dropout, self_attention=True,
+                prefix="attn_",
+            )
+            self.ln_attn = LayerNorm(in_channels=units, prefix="ln_attn_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       prefix="ffn_")
+            self.ln_ffn = LayerNorm(in_channels=units, prefix="ln_ffn_")
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        attn = self.drop(self.attention(x))
+        x = self.ln_attn(x + attn)
+        ffn = self.ffn(x)
+        return self.ln_ffn(x + ffn)
+
+
+class BERTEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads,
+                 dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.layers = HybridSequential(prefix="layers_")
+            for _ in range(num_layers):
+                self.layers.add(
+                    BERTEncoderLayer(units, hidden_size, num_heads, dropout)
+                )
+
+    def hybrid_forward(self, F, x):
+        return self.layers(x)
+
+
+class BERTModel(HybridBlock):
+    """Embeddings + encoder + pooler.
+
+    forward(token_ids, token_types) -> (sequence_output, pooled_output)
+    token_ids/token_types: (B, S) int32.
+    """
+
+    def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
+                 num_layers=12, num_heads=12, max_length=512,
+                 type_vocab_size=2, dropout=0.1, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._vocab_size = vocab_size
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units, prefix="word_")
+            self.token_type_embed = Embedding(type_vocab_size, units,
+                                              prefix="type_")
+            self.position_embed = Embedding(max_length, units, prefix="pos_")
+            self.embed_ln = LayerNorm(in_channels=units, prefix="embed_ln_")
+            self.embed_drop = Dropout(dropout)
+            self.encoder = BERTEncoder(
+                num_layers, units, hidden_size, num_heads, dropout,
+                prefix="enc_",
+            )
+            self.pooler = Dense(units, activation="tanh", flatten=False,
+                                prefix="pooler_")
+
+    def hybrid_forward(self, F, token_ids, token_types=None):
+        B, S = token_ids.shape[0], token_ids.shape[1]
+        positions = F.arange(0, S).reshape(1, S).broadcast_to((B, S))
+        emb = self.word_embed(token_ids) + self.position_embed(positions)
+        if token_types is not None:
+            emb = emb + self.token_type_embed(token_types)
+        emb = self.embed_drop(self.embed_ln(emb))
+        seq = self.encoder(emb)
+        pooled = self.pooler(seq[:, 0, :])
+        return seq, pooled
+
+
+class BERTForPretraining(HybridBlock):
+    """MLM + NSP heads (decoder weight tied to word embedding)."""
+
+    def __init__(self, bert: BERTModel = None, **bert_kwargs):
+        super().__init__(prefix=bert_kwargs.pop("prefix", None),
+                         params=bert_kwargs.pop("params", None))
+        with self.name_scope():
+            self.bert = bert if bert is not None else BERTModel(**bert_kwargs)
+            units = self.bert._units
+            self.mlm_transform = Dense(units, flatten=False, prefix="mlmt_")
+            self.mlm_act = GELU()
+            self.mlm_ln = LayerNorm(in_channels=units, prefix="mlm_ln_")
+            self.nsp = Dense(2, flatten=False, prefix="nsp_")
+
+    def hybrid_forward(self, F, token_ids, token_types=None):
+        seq, pooled = self.bert(token_ids, token_types)
+        h = self.mlm_ln(self.mlm_act(self.mlm_transform(seq)))
+        # tied decoder: logits = h @ word_embedding^T
+        embed_w = self.bert.word_embed.weight.data()
+        mlm_logits = F.dot(h, embed_w.T)
+        nsp_logits = self.nsp(pooled)
+        return mlm_logits, nsp_logits
+
+
+_BERT_SPECS = {
+    "bert_base": dict(units=768, hidden_size=3072, num_layers=12,
+                      num_heads=12),
+    "bert_large": dict(units=1024, hidden_size=4096, num_layers=24,
+                       num_heads=16),
+}
+
+
+def get_bert(name="bert_base", **kwargs):
+    if name not in _BERT_SPECS:
+        raise MXNetError(f"unknown bert spec {name}")
+    spec = dict(_BERT_SPECS[name])
+    spec.update(kwargs)
+    return BERTModel(**spec)
+
+
+def bert_base(**kwargs):
+    return get_bert("bert_base", **kwargs)
+
+
+def bert_large(**kwargs):
+    return get_bert("bert_large", **kwargs)

@@ -1,0 +1,152 @@
+"""Transformer encoder-decoder for seq2seq (reference workload: GluonNLP
+Transformer WMT En-De over contrib interleaved encdec attention ops
+[unverified]; BASELINE.md config 4).
+
+Pre-LN arrangement (more stable; graph fusion identical), flash attention
+everywhere: causal self-attention in the decoder, cross-attention over
+encoder memory."""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import (
+    Dense, Dropout, Embedding, HybridSequential, LayerNorm,
+    MultiHeadAttention,
+)
+
+__all__ = ["TransformerEncoder", "TransformerDecoder", "TransformerModel",
+           "transformer_base", "transformer_big"]
+
+
+class _FFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = Dense(hidden_size, activation="relu", flatten=False)
+            self.ffn_2 = Dense(units, flatten=False)
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        return self.drop(self.ffn_2(self.ffn_1(x)))
+
+
+class TransformerEncoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = LayerNorm(in_channels=units)
+            self.attn = MultiHeadAttention(units, num_heads, dropout=dropout)
+            self.ln2 = LayerNorm(in_channels=units)
+            self.ffn = _FFN(units, hidden_size, dropout)
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x):
+        x = x + self.drop(self.attn(self.ln1(x)))
+        return x + self.ffn(self.ln2(x))
+
+
+class TransformerDecoderLayer(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ln1 = LayerNorm(in_channels=units)
+            self.self_attn = MultiHeadAttention(
+                units, num_heads, dropout=dropout, causal=True
+            )
+            self.ln2 = LayerNorm(in_channels=units)
+            self.cross_attn = MultiHeadAttention(
+                units, num_heads, dropout=dropout, self_attention=False
+            )
+            self.ln3 = LayerNorm(in_channels=units)
+            self.ffn = _FFN(units, hidden_size, dropout)
+            self.drop = Dropout(dropout)
+
+    def hybrid_forward(self, F, x, memory):
+        x = x + self.drop(self.self_attn(self.ln1(x)))
+        x = x + self.drop(self.cross_attn(self.ln2(x), memory, memory))
+        return x + self.ffn(self.ln3(x))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout,
+                 **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.layers = HybridSequential()
+            for _ in range(num_layers):
+                self.layers.add(
+                    TransformerEncoderLayer(units, hidden_size, num_heads,
+                                            dropout)
+                )
+            self.ln = LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x):
+        return self.ln(self.layers(x))
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers, units, hidden_size, num_heads, dropout,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self._n = num_layers
+        with self.name_scope():
+            for i in range(num_layers):
+                setattr(self, f"layer{i}",
+                        TransformerDecoderLayer(units, hidden_size, num_heads,
+                                                dropout))
+            self.ln = LayerNorm(in_channels=units)
+
+    def hybrid_forward(self, F, x, memory):
+        for i in range(self._n):
+            x = getattr(self, f"layer{i}")(x, memory)
+        return self.ln(x)
+
+
+class TransformerModel(HybridBlock):
+    """forward(src_ids, tgt_ids) -> logits (B, T_tgt, vocab)."""
+
+    def __init__(self, src_vocab=32768, tgt_vocab=32768, units=512,
+                 hidden_size=2048, num_layers=6, num_heads=8, max_length=1024,
+                 dropout=0.1, tie_weights=True, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        with self.name_scope():
+            self.src_embed = Embedding(src_vocab, units, prefix="src_embed_")
+            self.tgt_embed = Embedding(tgt_vocab, units, prefix="tgt_embed_")
+            self.pos_embed = Embedding(max_length, units, prefix="pos_embed_")
+            self.drop = Dropout(dropout)
+            self.encoder = TransformerEncoder(
+                num_layers, units, hidden_size, num_heads, dropout,
+                prefix="enc_",
+            )
+            self.decoder = TransformerDecoder(
+                num_layers, units, hidden_size, num_heads, dropout,
+                prefix="dec_",
+            )
+            self._tied = tie_weights
+            if not tie_weights:
+                self.proj = Dense(tgt_vocab, flatten=False, prefix="proj_")
+
+    def _embed(self, F, embed, ids):
+        B, S = ids.shape[0], ids.shape[1]
+        pos = F.arange(0, S).reshape(1, S).broadcast_to((B, S))
+        return self.drop(embed(ids) * (self._units ** 0.5)
+                         + self.pos_embed(pos))
+
+    def hybrid_forward(self, F, src_ids, tgt_ids):
+        memory = self.encoder(self._embed(F, self.src_embed, src_ids))
+        out = self.decoder(self._embed(F, self.tgt_embed, tgt_ids), memory)
+        if self._tied:
+            w = self.tgt_embed.weight.data()
+            return F.dot(out, w.T)
+        return self.proj(out)
+
+
+def transformer_base(**kwargs):
+    return TransformerModel(units=512, hidden_size=2048, num_layers=6,
+                            num_heads=8, **kwargs)
+
+
+def transformer_big(**kwargs):
+    return TransformerModel(units=1024, hidden_size=4096, num_layers=6,
+                            num_heads=16, **kwargs)

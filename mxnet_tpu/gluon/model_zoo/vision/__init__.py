@@ -1,6 +1,10 @@
-"""Vision model zoo (reference: ``gluon/model_zoo/vision/`` [unverified]).
+"""Vision model zoo (reference: ``gluon/model_zoo/vision/`` [unverified]):
+resnet v1/v2 (18-152), vgg (11-19, +bn), mobilenet v1/v2/v3, densenet,
+squeezenet, inception v3, alexnet. ``get_model(name)`` is the factory.
 
-Populated incrementally; ``get_model(name)`` is the factory entry point."""
+Pretrained-weight download is unavailable (zero-egress build); load local
+``.params`` files via ``net.load_parameters`` instead.
+"""
 
 from ....base import MXNetError
 
@@ -17,8 +21,29 @@ def get_model(name, **kwargs):
     if name not in _models:
         raise MXNetError(
             f"model {name!r} is not in the zoo; available: {sorted(_models)}"
-        )
+    )
     return _models[name](**kwargs)
 
 
+# populate the registry (imports must come after register_model is defined);
+# grab module __all__ lists BEFORE star imports shadow same-named factories
+from . import resnet as _resnet  # noqa: E402
+from . import alexnet as _alexnet  # noqa: E402
+from . import vgg as _vgg  # noqa: E402
+from . import mobilenet as _mobilenet  # noqa: E402
+from . import squeezenet as _squeezenet  # noqa: E402
+from . import densenet as _densenet  # noqa: E402
+from . import inception as _inception  # noqa: E402
+
 __all__ = ["get_model", "register_model"]
+for _m in (_resnet, _alexnet, _vgg, _mobilenet, _squeezenet, _densenet,
+           _inception):
+    __all__ += _m.__all__
+
+from .resnet import *  # noqa: F401,F403,E402
+from .alexnet import *  # noqa: F401,F403,E402
+from .vgg import *  # noqa: F401,F403,E402
+from .mobilenet import *  # noqa: F401,F403,E402
+from .squeezenet import *  # noqa: F401,F403,E402
+from .densenet import *  # noqa: F401,F403,E402
+from .inception import *  # noqa: F401,F403,E402

@@ -1,0 +1,284 @@
+"""MobileNet v1/v2/v3 (reference: ``gluon/model_zoo/vision/mobilenet.py`` +
+GluonCV mobilenetv3 [unverified]). Depthwise convs = grouped Conv2D, which
+XLA lowers to MXU-friendly batched matmuls."""
+
+from __future__ import annotations
+
+from ...nn import (
+    Activation, BatchNorm, Conv2D, Dense, Flatten, GlobalAvgPool2D,
+    HybridSequential,
+)
+from ...block import HybridBlock
+from . import register_model
+
+__all__ = [
+    "MobileNet", "MobileNetV2", "MobileNetV3",
+    "mobilenet1_0", "mobilenet0_75", "mobilenet0_5", "mobilenet0_25",
+    "mobilenet_v2_1_0", "mobilenet_v2_0_75", "mobilenet_v2_0_5",
+    "mobilenet_v2_0_25",
+    "mobilenet_v3_large", "mobilenet_v3_small",
+]
+
+
+class RELU6(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.clip(x, 0, 6)
+
+
+class HSwish(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x * F.clip(x + 3, 0, 6) / 6
+
+
+class HSigmoid(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.clip(x + 3, 0, 6) / 6
+
+
+def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
+              active=True, relu6=False):
+    out.add(Conv2D(channels, kernel, stride, pad, groups=num_group,
+                   use_bias=False))
+    out.add(BatchNorm(scale=True))
+    if active:
+        out.add(RELU6() if relu6 else Activation("relu"))
+
+
+def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
+    _add_conv(out, dw_channels, kernel=3, stride=stride, pad=1,
+              num_group=dw_channels, relu6=relu6)
+    _add_conv(out, channels, relu6=relu6)
+
+
+class LinearBottleneck(HybridBlock):
+    """MobileNetV2 inverted residual."""
+
+    def __init__(self, in_channels, channels, t, stride, **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_channels == channels
+        with self.name_scope():
+            self.out = HybridSequential()
+            if t != 1:
+                _add_conv(self.out, in_channels * t, relu6=True)
+            _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
+                      pad=1, num_group=in_channels * t, relu6=True)
+            _add_conv(self.out, channels, active=False, relu6=True)
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+class MobileNet(HybridBlock):
+    """MobileNetV1."""
+
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = HybridSequential(prefix="")
+            with self.features.name_scope():
+                _add_conv(self.features, channels=int(32 * multiplier),
+                          kernel=3, pad=1, stride=2)
+                dw_channels = [
+                    int(x * multiplier)
+                    for x in [32, 64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024]
+                ]
+                channels = [
+                    int(x * multiplier)
+                    for x in [64] + [128] * 2 + [256] * 2 + [512] * 6 + [1024] * 2
+                ]
+                strides = [1, 2, 1, 2, 1, 2, 1, 1, 1, 1, 1, 2, 1]
+                for dwc, c, s in zip(dw_channels, channels, strides):
+                    _add_conv_dw(self.features, dw_channels=dwc, channels=c,
+                                 stride=s)
+                self.features.add(GlobalAvgPool2D())
+                self.features.add(Flatten())
+            self.output = Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class MobileNetV2(HybridBlock):
+    def __init__(self, multiplier=1.0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = HybridSequential(prefix="features_")
+            with self.features.name_scope():
+                _add_conv(self.features, int(32 * multiplier), kernel=3,
+                          stride=2, pad=1, relu6=True)
+                in_channels_group = [
+                    int(x * multiplier)
+                    for x in [32] + [16] + [24] * 2 + [32] * 3 + [64] * 4
+                    + [96] * 3 + [160] * 3
+                ]
+                channels_group = [
+                    int(x * multiplier)
+                    for x in [16] + [24] * 2 + [32] * 3 + [64] * 4 + [96] * 3
+                    + [160] * 3 + [320]
+                ]
+                ts = [1] + [6] * 16
+                strides = [1, 2, 1, 2, 1, 1, 2, 1, 1, 1, 1, 1, 1, 2, 1, 1, 1]
+                for in_c, c, t, s in zip(
+                    in_channels_group, channels_group, ts, strides
+                ):
+                    self.features.add(
+                        LinearBottleneck(in_channels=in_c, channels=c, t=t,
+                                         stride=s)
+                    )
+                last_channels = (
+                    int(1280 * multiplier) if multiplier > 1.0 else 1280
+                )
+                _add_conv(self.features, last_channels, relu6=True)
+                self.features.add(GlobalAvgPool2D())
+            self.output = HybridSequential(prefix="output_")
+            with self.output.name_scope():
+                self.output.add(
+                    Conv2D(classes, 1, use_bias=False, prefix="pred_"),
+                    Flatten(),
+                )
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+class _SE(HybridBlock):
+    def __init__(self, channels, reduction=4, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.pool = GlobalAvgPool2D()
+            self.fc1 = Conv2D(channels // reduction, 1, use_bias=True)
+            self.fc2 = Conv2D(channels, 1, use_bias=True)
+            self.hsig = HSigmoid()
+
+    def hybrid_forward(self, F, x):
+        w = self.pool(x)
+        w = F.relu(self.fc1(w))
+        w = self.hsig(self.fc2(w))
+        return x * w
+
+
+class _MBV3Block(HybridBlock):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, se, act,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.use_shortcut = stride == 1 and in_c == out_c
+        act_layer = HSwish() if act == "hswish" else Activation("relu")
+        with self.name_scope():
+            self.out = HybridSequential()
+            if exp_c != in_c:
+                self.out.add(Conv2D(exp_c, 1, use_bias=False), BatchNorm())
+                self.out.add(HSwish() if act == "hswish" else Activation("relu"))
+            self.out.add(
+                Conv2D(exp_c, kernel, stride, kernel // 2, groups=exp_c,
+                       use_bias=False),
+                BatchNorm(),
+            )
+            if se:
+                self.out.add(_SE(exp_c))
+            self.out.add(act_layer)
+            self.out.add(Conv2D(out_c, 1, use_bias=False), BatchNorm())
+
+    def hybrid_forward(self, F, x):
+        out = self.out(x)
+        if self.use_shortcut:
+            out = out + x
+        return out
+
+
+_V3_LARGE = [
+    # in, exp, out, k, s, se, act
+    (16, 16, 16, 3, 1, False, "relu"),
+    (16, 64, 24, 3, 2, False, "relu"),
+    (24, 72, 24, 3, 1, False, "relu"),
+    (24, 72, 40, 5, 2, True, "relu"),
+    (40, 120, 40, 5, 1, True, "relu"),
+    (40, 120, 40, 5, 1, True, "relu"),
+    (40, 240, 80, 3, 2, False, "hswish"),
+    (80, 200, 80, 3, 1, False, "hswish"),
+    (80, 184, 80, 3, 1, False, "hswish"),
+    (80, 184, 80, 3, 1, False, "hswish"),
+    (80, 480, 112, 3, 1, True, "hswish"),
+    (112, 672, 112, 3, 1, True, "hswish"),
+    (112, 672, 160, 5, 2, True, "hswish"),
+    (160, 960, 160, 5, 1, True, "hswish"),
+    (160, 960, 160, 5, 1, True, "hswish"),
+]
+_V3_SMALL = [
+    (16, 16, 16, 3, 2, True, "relu"),
+    (16, 72, 24, 3, 2, False, "relu"),
+    (24, 88, 24, 3, 1, False, "relu"),
+    (24, 96, 40, 5, 2, True, "hswish"),
+    (40, 240, 40, 5, 1, True, "hswish"),
+    (40, 240, 40, 5, 1, True, "hswish"),
+    (40, 120, 48, 5, 1, True, "hswish"),
+    (48, 144, 48, 5, 1, True, "hswish"),
+    (48, 288, 96, 5, 2, True, "hswish"),
+    (96, 576, 96, 5, 1, True, "hswish"),
+    (96, 576, 96, 5, 1, True, "hswish"),
+]
+
+
+class MobileNetV3(HybridBlock):
+    def __init__(self, spec, last_exp, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = HybridSequential(prefix="")
+            self.features.add(
+                Conv2D(16, 3, 2, 1, use_bias=False), BatchNorm(), HSwish()
+            )
+            for in_c, exp_c, out_c, k, s, se, act in spec:
+                self.features.add(
+                    _MBV3Block(in_c, exp_c, out_c, k, s, se, act)
+                )
+            self.features.add(
+                Conv2D(last_exp, 1, use_bias=False), BatchNorm(), HSwish()
+            )
+            self.features.add(GlobalAvgPool2D())
+            self.features.add(Conv2D(1280, 1, use_bias=True), HSwish())
+            self.output = HybridSequential()
+            self.output.add(Conv2D(classes, 1, use_bias=True), Flatten())
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def _make_v1(mult, name):
+    def f(**kwargs):
+        return MobileNet(mult, **kwargs)
+
+    f.__name__ = name
+    return register_model(f)
+
+
+def _make_v2(mult, name):
+    def f(**kwargs):
+        return MobileNetV2(mult, **kwargs)
+
+    f.__name__ = name
+    return register_model(f)
+
+
+mobilenet1_0 = _make_v1(1.0, "mobilenet1_0")
+mobilenet0_75 = _make_v1(0.75, "mobilenet0_75")
+mobilenet0_5 = _make_v1(0.5, "mobilenet0_5")
+mobilenet0_25 = _make_v1(0.25, "mobilenet0_25")
+mobilenet_v2_1_0 = _make_v2(1.0, "mobilenet_v2_1_0")
+mobilenet_v2_0_75 = _make_v2(0.75, "mobilenet_v2_0_75")
+mobilenet_v2_0_5 = _make_v2(0.5, "mobilenet_v2_0_5")
+mobilenet_v2_0_25 = _make_v2(0.25, "mobilenet_v2_0_25")
+
+
+@register_model
+def mobilenet_v3_large(**kwargs):
+    return MobileNetV3(_V3_LARGE, 960, **kwargs)
+
+
+@register_model
+def mobilenet_v3_small(**kwargs):
+    return MobileNetV3(_V3_SMALL, 576, **kwargs)

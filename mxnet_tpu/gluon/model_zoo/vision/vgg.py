@@ -1,0 +1,79 @@
+"""VGG 11/13/16/19 (+BN variants) (reference:
+``gluon/model_zoo/vision/vgg.py`` [unverified])."""
+
+from __future__ import annotations
+
+from ...nn import (
+    Activation, BatchNorm, Conv2D, Dense, Dropout, HybridSequential, MaxPool2D,
+)
+from ...block import HybridBlock
+from . import register_model
+
+__all__ = [
+    "VGG", "vgg11", "vgg13", "vgg16", "vgg19",
+    "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn", "get_vgg",
+]
+
+vgg_spec = {
+    11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
+    13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
+    16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
+    19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512]),
+}
+
+
+class VGG(HybridBlock):
+    def __init__(self, layers, filters, classes=1000, batch_norm=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        assert len(layers) == len(filters)
+        with self.name_scope():
+            self.features = self._make_features(layers, filters, batch_norm)
+            self.features.add(Dense(4096, activation="relu", flatten=True))
+            self.features.add(Dropout(rate=0.5))
+            self.features.add(Dense(4096, activation="relu"))
+            self.features.add(Dropout(rate=0.5))
+            self.output = Dense(classes)
+
+    def _make_features(self, layers, filters, batch_norm):
+        featurizer = HybridSequential(prefix="")
+        for i, num in enumerate(layers):
+            for _ in range(num):
+                featurizer.add(
+                    Conv2D(filters[i], kernel_size=3, padding=1)
+                )
+                if batch_norm:
+                    featurizer.add(BatchNorm())
+                featurizer.add(Activation("relu"))
+            featurizer.add(MaxPool2D(strides=2))
+        return featurizer
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def get_vgg(num_layers, pretrained=False, **kwargs):
+    layers, filters = vgg_spec[num_layers]
+    net = VGG(layers, filters, **kwargs)
+    return net
+
+
+def _make(layers, bn):
+    def f(**kwargs):
+        if bn:
+            kwargs["batch_norm"] = True
+        return get_vgg(layers, **kwargs)
+
+    f.__name__ = f"vgg{layers}" + ("_bn" if bn else "")
+    return register_model(f)
+
+
+vgg11 = _make(11, False)
+vgg13 = _make(13, False)
+vgg16 = _make(16, False)
+vgg19 = _make(19, False)
+vgg11_bn = _make(11, True)
+vgg13_bn = _make(13, True)
+vgg16_bn = _make(16, True)
+vgg19_bn = _make(19, True)

@@ -3,7 +3,9 @@
 from .activations import *  # noqa: F401,F403
 from .basic_layers import *  # noqa: F401,F403
 from .conv_layers import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
 
-from . import activations, basic_layers, conv_layers
+from . import activations, basic_layers, conv_layers, attention
 
-__all__ = activations.__all__ + basic_layers.__all__ + conv_layers.__all__
+__all__ = (activations.__all__ + basic_layers.__all__ + conv_layers.__all__
+           + attention.__all__)

@@ -1,0 +1,104 @@
+"""Attention layers over the Pallas flash kernel.
+
+API parity target: the reference's interleaved multi-head attention ops
+(``src/operator/contrib/transformer.cc`` [unverified], used by GluonNLP
+BERT) — one fused QKV projection, heads split internally. The score matrix
+is never materialized (flash path), so long sequences are O(S) memory:
+beyond-reference capability per SURVEY.md §5.
+"""
+
+from __future__ import annotations
+
+import math
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from .basic_layers import Dense, Dropout
+
+__all__ = ["MultiHeadAttention"]
+
+
+class MultiHeadAttention(HybridBlock):
+    """Fused multi-head attention.
+
+    Parameters
+    ----------
+    units : total hidden size (= num_heads * head_dim)
+    num_heads : number of attention heads
+    dropout : attention output dropout rate
+    use_bias : bias on projections
+    self_attention : if True one fused QKV projection (interleaved layout,
+        matching ``_contrib_interleaved_matmul_selfatt_*`` semantics)
+    causal : apply causal mask (decoder self-attention)
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 self_attention=True, causal=False, flatten=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads != 0:
+            raise MXNetError(
+                f"units {units} not divisible by num_heads {num_heads}"
+            )
+        self._units = units
+        self._num_heads = num_heads
+        self._head_dim = units // num_heads
+        self._causal = causal
+        self._self_attention = self_attention
+        with self.name_scope():
+            if self_attention:
+                self.qkv_proj = Dense(3 * units, use_bias=use_bias,
+                                      flatten=False, prefix="qkv_")
+            else:
+                self.q_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                    prefix="q_")
+                self.k_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                    prefix="k_")
+                self.v_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                    prefix="v_")
+            self.out_proj = Dense(units, use_bias=use_bias, flatten=False,
+                                  prefix="out_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def _split(self, x):
+        # (B, S, units) -> (B, H, S, head_dim)
+        B, S = x.shape[0], x.shape[1]
+        return x.reshape(B, S, self._num_heads, self._head_dim).transpose(
+            0, 2, 1, 3
+        )
+
+    def _merge(self, x):
+        B, H, S, D = x.shape
+        return x.transpose(0, 2, 1, 3).reshape(B, S, H * D)
+
+    def hybrid_forward(self, F, query, key=None, value=None):
+        if self._self_attention:
+            qkv = self.qkv_proj(query)  # (B, S, 3*units)
+            B, S = qkv.shape[0], qkv.shape[1]
+            qkv = qkv.reshape(B, S, self._num_heads, 3 * self._head_dim)
+            q = self._split_packed(qkv, 0)
+            k = self._split_packed(qkv, 1)
+            v = self._split_packed(qkv, 2)
+        else:
+            if key is None:
+                key = query
+            if value is None:
+                value = key
+            q = self._split(self.q_proj(query))
+            k = self._split(self.k_proj(key))
+            v = self._split(self.v_proj(value))
+        out = F.flash_attention(
+            q, k, v, causal=self._causal,
+            sm_scale=1.0 / math.sqrt(self._head_dim),
+        )
+        out = self._merge(out)
+        out = self.out_proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+    def _split_packed(self, qkv, which):
+        # qkv (B, S, H, 3*D) interleaved per head like the reference's
+        # interleaved_matmul_selfatt layout
+        d = self._head_dim
+        part = qkv[:, :, :, which * d : (which + 1) * d]
+        return part.transpose(0, 2, 1, 3)

@@ -1,6 +1,9 @@
-"""Recurrent layers (reference: ``python/mxnet/gluon/rnn/`` [unverified]).
+"""Recurrent layers + cells (reference: ``python/mxnet/gluon/rnn/``
+[unverified])."""
 
-Placeholder module populated in a later milestone (fused RNN over lax.scan
-plus cell-level API); importing it early keeps `gluon.rnn` importable."""
+from .rnn_layer import *  # noqa: F401,F403
+from .rnn_cell import *  # noqa: F401,F403
 
-__all__ = []
+from . import rnn_layer, rnn_cell
+
+__all__ = rnn_layer.__all__ + rnn_cell.__all__

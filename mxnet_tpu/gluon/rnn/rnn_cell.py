@@ -1,0 +1,343 @@
+"""RNN cells (reference: ``python/mxnet/gluon/rnn/rnn_cell.py``
+[unverified]): step-level API with ``unroll``, sequential/bidirectional/
+residual/dropout compositors. ``unroll`` is a Python loop — under
+``hybridize()`` the whole unrolled graph stages into one XLA program."""
+
+from __future__ import annotations
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+from ..nn import Dense  # noqa: F401 (reference parity import)
+
+__all__ = [
+    "RecurrentCell", "RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+    "BidirectionalCell", "DropoutCell", "ResidualCell", "ZoneoutCell",
+]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+        self.reset()
+
+    def reset(self):
+        self._init_counter = -1
+        self._counter = -1
+        for cell in self._children.values():
+            if isinstance(cell, RecurrentCell):
+                cell.reset()
+
+    def state_info(self, batch_size=0):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        import jax.numpy as jnp
+
+        states = []
+        for info in self.state_info(batch_size):
+            self._init_counter += 1
+            shape = info["shape"]
+            states.append(NDArray(jnp.zeros(shape)))
+        return states
+
+    def __call__(self, inputs, states=None, **kwargs):
+        # cells take (input, states) per step (unlike Block.__call__ arity)
+        self._counter += 1
+        return super().__call__(inputs, states, **kwargs)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Run the cell over ``length`` steps (reference: ``unroll``)."""
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            step_in = (
+                inputs[t] if axis == 0 else inputs[:, t]
+            )
+            out, states = self(step_in, states)
+            outputs.append(out)
+        if merge_outputs is None or merge_outputs:
+            from ...ndarray import stack
+
+            outputs = stack(*outputs, axis=axis)
+        if valid_length is not None:
+            from ... import ndarray as nd
+
+            outputs = nd.SequenceMask(
+                outputs, sequence_length=valid_length, use_sequence_length=True,
+                value=0, axis=axis,
+            )
+        return outputs, states
+
+
+class _BaseFusableCell(RecurrentCell):
+    """Single-step cell with i2h/h2h params (gates packed like the ref)."""
+
+    def __init__(self, hidden_size, ngates, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._input_size = input_size
+        ng = ngates
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(ng * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(ng * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(ng * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(ng * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+        self._ng = ng
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (
+            self._ng * self._hidden_size, int(x.shape[-1])
+        )
+
+
+class RNNCell(_BaseFusableCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0, **kwargs):
+        super().__init__(hidden_size, 1, input_size, **kwargs)
+        self._activation = activation
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "rnn"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        output = F.Activation(i2h + h2h, act_type=self._activation)
+        return output, [output]
+
+
+class LSTMCell(_BaseFusableCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 4, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+            {"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+        ]
+
+    def _alias(self):
+        return "lstm"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        H = self._hidden_size
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=4 * H)
+        h2h = F.FullyConnected(states[0], h2h_weight, h2h_bias,
+                               num_hidden=4 * H)
+        gates = i2h + h2h
+        slices = F.split(gates, num_outputs=4, axis=1)
+        in_gate = F.sigmoid(slices[0])
+        forget_gate = F.sigmoid(slices[1])
+        in_transform = slices[2].tanh()
+        out_gate = F.sigmoid(slices[3])
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * next_c.tanh()
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(_BaseFusableCell):
+    def __init__(self, hidden_size, input_size=0, **kwargs):
+        super().__init__(hidden_size, 3, input_size, **kwargs)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def _alias(self):
+        return "gru"
+
+    def hybrid_forward(self, F, inputs, states, i2h_weight, h2h_weight,
+                       i2h_bias, h2h_bias):
+        H = self._hidden_size
+        prev = states[0]
+        i2h = F.FullyConnected(inputs, i2h_weight, i2h_bias, num_hidden=3 * H)
+        h2h = F.FullyConnected(prev, h2h_weight, h2h_bias, num_hidden=3 * H)
+        i2h_r, i2h_z, i2h_n = F.split(i2h, num_outputs=3, axis=1)
+        h2h_r, h2h_z, h2h_n = F.split(h2h, num_outputs=3, axis=1)
+        reset = F.sigmoid(i2h_r + h2h_r)
+        update = F.sigmoid(i2h_z + h2h_z)
+        new_mem = (i2h_n + reset * h2h_n).tanh()
+        next_h = (1.0 - update) * new_mem + update * prev
+        return next_h, [next_h]
+
+
+class SequentialRNNCell(RecurrentCell):
+    """Stack cells; state list is concatenated across children."""
+
+    def add(self, cell):
+        self.register_child(cell)
+
+    def state_info(self, batch_size=0):
+        info = []
+        for cell in self._children.values():
+            info.extend(cell.state_info(batch_size))
+        return info
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for cell in self._children.values():
+            states.extend(cell.begin_state(batch_size, **kwargs))
+        return states
+
+    def __len__(self):
+        return len(self._children)
+
+    def __getitem__(self, i):
+        return list(self._children.values())[i]
+
+    def forward(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._children.values():
+            n = len(cell.state_info())
+            inputs, cell_states = cell(inputs, states[p : p + n])
+            next_states.extend(cell_states)
+            p += n
+        return inputs, next_states
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def forward(self, inputs, states):
+        from ... import ndarray as nd
+
+        if self._rate > 0:
+            inputs = nd.Dropout(inputs, p=self._rate, axes=self._axes)
+        return inputs, states
+
+
+class _ModifierCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__(prefix=None, params=None)
+        base_cell._modified = True
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        self.base_cell._modified = False
+        begin = self.base_cell.begin_state(batch_size, **kwargs)
+        self.base_cell._modified = True
+        return begin
+
+
+class ResidualCell(_ModifierCell):
+    def forward(self, inputs, states):
+        output, states = self.base_cell(inputs, states)
+        return output + inputs, states
+
+
+class ZoneoutCell(_ModifierCell):
+    def __init__(self, base_cell, zoneout_outputs=0.0, zoneout_states=0.0):
+        super().__init__(base_cell)
+        self.zoneout_outputs = zoneout_outputs
+        self.zoneout_states = zoneout_states
+        self._prev_output = None
+
+    def reset(self):
+        super().reset()
+        self._prev_output = None
+
+    def forward(self, inputs, states):
+        from ... import autograd, ndarray as nd
+
+        next_output, next_states = self.base_cell(inputs, states)
+        if not autograd.is_training():
+            return next_output, next_states
+
+        def mask(p, like):
+            return nd.Dropout(nd.ones_like(like), p=p, training=True) * (1 - p)
+
+        prev = self._prev_output
+        if prev is None:
+            prev = nd.zeros_like(next_output)
+        if self.zoneout_outputs > 0:
+            m = mask(self.zoneout_outputs, next_output)
+            next_output = m * next_output + (1 - m) * prev
+        if self.zoneout_states > 0:
+            masked = []
+            for ns, os in zip(next_states, states):
+                m = mask(self.zoneout_states, ns)
+                masked.append(m * ns + (1 - m) * os)
+            next_states = masked
+        self._prev_output = next_output
+        return next_output, next_states
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell, **kwargs):
+        super().__init__(**kwargs)
+        self.register_child(l_cell, "l_cell")
+        self.register_child(r_cell, "r_cell")
+
+    def state_info(self, batch_size=0):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.state_info(batch_size) + r.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        l, r = self._children["l_cell"], self._children["r_cell"]
+        return l.begin_state(batch_size, **kwargs) + r.begin_state(
+            batch_size, **kwargs
+        )
+
+    def __call__(self, inputs, states=None):
+        raise MXNetError("BidirectionalCell cannot be stepped; use unroll")
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ...ndarray import concat, stack
+
+        axis = layout.find("T")
+        batch_size = inputs.shape[layout.find("N")]
+        if begin_state is None:
+            begin_state = self.begin_state(batch_size)
+        l_cell = self._children["l_cell"]
+        r_cell = self._children["r_cell"]
+        nl = len(l_cell.state_info())
+        l_out, l_states = l_cell.unroll(
+            length, inputs, begin_state[:nl], layout, True,
+            valid_length=valid_length,
+        )
+        rev = inputs.flip(axis=axis) if hasattr(inputs, "flip") else inputs
+        from ... import ndarray as nd
+
+        rev = nd.flip(inputs, axis=axis)
+        r_out, r_states = r_cell.unroll(
+            length, rev, begin_state[nl:], layout, True,
+            valid_length=valid_length,
+        )
+        r_out = nd.flip(r_out, axis=axis)
+        outputs = nd.concat(l_out, r_out, dim=2)
+        return outputs, l_states + r_states

@@ -1,0 +1,159 @@
+"""Fused RNN layers (reference: ``python/mxnet/gluon/rnn/rnn_layer.py`` over
+``src/operator/rnn.cc`` [unverified]). Parameters are registered per
+layer/direction with the reference's names (``l0_i2h_weight``,
+``r0_h2h_bias``, …) so checkpoints map; the forward packs them and calls the
+fused ``RNN`` op (one ``lax.scan`` program on device)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, mode, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), f"invalid layout {layout}"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in ["l", "r"][: self._dir]:
+                    self._register_param(
+                        f"{j}{i}_i2h_weight",
+                        (ng * nh, ni if i == 0 else nh * self._dir),
+                        i2h_weight_initializer,
+                    )
+                    self._register_param(
+                        f"{j}{i}_h2h_weight", (ng * nh, nh),
+                        h2h_weight_initializer,
+                    )
+                    self._register_param(
+                        f"{j}{i}_i2h_bias", (ng * nh,), i2h_bias_initializer
+                    )
+                    self._register_param(
+                        f"{j}{i}_h2h_bias", (ng * nh,), h2h_bias_initializer
+                    )
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(
+            name, shape=shape, init=init, allow_deferred_init=True
+        )
+        self._reg_params[name] = p
+        object.__setattr__(self, name, p)
+
+    def __repr__(self):
+        return (
+            f"{self.__class__.__name__}({self._input_size} -> "
+            f"{self._hidden_size}, {self._layout}, layers={self._num_layers}"
+            f"{', bidirectional' if self._dir == 2 else ''})"
+        )
+
+    def state_info(self, batch_size=0):  # pragma: no cover - reference API
+        raise NotImplementedError
+
+    def infer_shape(self, x, *args):
+        ni = int(x.shape[2] if self._layout == "TNC" else x.shape[2])
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                p = self._reg_params[f"{j}{i}_i2h_weight"]
+                p.shape = (
+                    self._gates * self._hidden_size,
+                    ni if i == 0 else self._hidden_size * self._dir,
+                )
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        states = []
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        n_states = 2 if self._mode == "lstm" else 1
+        for _ in range(n_states):
+            states.append(NDArray(jnp.zeros(shape)))
+        return states
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = inputs.swapaxes(0, 1)
+        T, N = inputs.shape[0], inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(N)
+        if isinstance(states, NDArray):
+            states = [states]
+        packed = self._pack_params(params)
+        h0 = states[0]
+        c0 = states[1] if self._mode == "lstm" and len(states) > 1 else None
+        from ... import autograd
+
+        result = F.RNN(
+            inputs, packed, h0, c0,
+            state_size=self._hidden_size, num_layers=self._num_layers,
+            bidirectional=self._dir == 2, mode=self._mode, p=self._dropout,
+            state_outputs=True, training=autograd.is_training(),
+        )
+        if self._mode == "lstm":
+            outputs, hT, cT = result
+            out_states = [hT, cT]
+        else:
+            outputs, hT = result
+            out_states = [hT]
+        if self._layout == "NTC":
+            outputs = outputs.swapaxes(0, 1)
+        return outputs if skip_states else (outputs, out_states)
+
+    def _pack_params(self, params):
+        """Flatten per-layer params into the fused op's packed layout
+        (weights for every layer/direction first, then biases)."""
+        order = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                order.append(f"{j}{i}_i2h_weight")
+                order.append(f"{j}{i}_h2h_weight")
+        border = []
+        for i in range(self._num_layers):
+            for j in ["l", "r"][: self._dir]:
+                border.append(f"{j}{i}_i2h_bias")
+                border.append(f"{j}{i}_h2h_bias")
+        flat = [params[n].reshape(-1) for n in order + border]
+        from ...ndarray import concatenate
+
+        return concatenate(flat, axis=0)
+
+
+class RNN(_RNNLayer):
+    """Elman RNN (relu/tanh) (reference API)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "rnn_" + activation,
+                         **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, "gru", **kwargs)

@@ -31,16 +31,17 @@ class Trainer:
                 f"got {type(params)}"
             )
         self._params = []
-        self._param2idx = {}
-        for i, param in enumerate(params):
+        for param in params:
             if not isinstance(param, Parameter):
                 raise MXNetError(
                     "first argument must be a list or dict of Parameters, "
                     f"got list of {type(param)}"
                 )
             if param.grad_req != "null":
-                self._param2idx[param.name] = i
                 self._params.append(param)
+        # name -> index in the FILTERED list (the index space used for
+        # optimizer state and kvstore keys)
+        self._param2idx = {p.name: i for i, p in enumerate(self._params)}
         self._compression_params = compression_params
         self._contains_sparse_weight = False
         optimizer_params = optimizer_params if optimizer_params else {}
@@ -130,6 +131,11 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None or self._kvstore.num_workers == 1:
             return  # grads already global: single replica or in-program psum
+        if self._update_on_kvstore:
+            # the push inside _update() both all-reduces and applies the
+            # server-side optimizer; pre-reducing here would double-sum and
+            # run the updater against the gradient buffers
+            return
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 grad = param.grad()

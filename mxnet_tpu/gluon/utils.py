@@ -69,7 +69,7 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     if scale < 1.0:
         for a in arrays:
             a._rebind(a.data * scale)
-    return total_f if check_isfinite else NDArray(total)
+    return total_f
 
 
 def check_sha1(filename, sha1_hash):

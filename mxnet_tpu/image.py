@@ -1,0 +1,423 @@
+"""Image API (reference: ``python/mxnet/image/image.py`` [unverified]):
+decode/resize/augment pipeline + ``ImageIter``. Host-side numpy (cv2/PIL for
+codecs when present); the batched output feeds the device once per batch."""
+
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ndarray import array as nd_array
+from .io import DataIter, DataBatch, DataDesc
+
+__all__ = [
+    "imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+    "center_crop", "random_crop", "random_size_crop", "color_normalize",
+    "Augmenter", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+    "CenterCropAug", "HorizontalFlipAug", "ColorNormalizeAug", "CastAug",
+    "BrightnessJitterAug", "ContrastJitterAug", "SaturationJitterAug",
+    "ColorJitterAug", "LightingAug", "RandomOrderAug",
+    "CreateAugmenter", "ImageIter",
+]
+
+
+def _to_np(img):
+    return img.asnumpy() if isinstance(img, NDArray) else _np.asarray(img)
+
+
+def imdecode(buf, flag=1, to_rgb=True, **kwargs):
+    """Decode a jpeg/png byte buffer to an HWC NDArray (reference API)."""
+    from .recordio import _decode_image
+
+    img = _decode_image(bytes(buf), 1 if flag else 0)
+    if img is None:
+        raise MXNetError("image decode failed")
+    if to_rgb and img.ndim == 3:
+        img = img[..., ::-1]  # BGR (cv2 convention) -> RGB
+    return nd_array(_np.ascontiguousarray(img))
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    from .gluon.data.vision.transforms import _resize
+
+    return nd_array(_resize(_to_np(src), (w, h), interp))
+
+
+def resize_short(src, size, interp=2):
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp=1)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    img = _to_np(src)[y0 : y0 + h, x0 : x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(nd_array(img), size[0], size[1])
+    return nd_array(img)
+
+
+def center_crop(src, size, interp=2):
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    new_w, new_h = size
+    x0 = _pyrandom.randint(0, max(0, w - new_w))
+    y0 = _pyrandom.randint(0, max(0, h - new_h))
+    out = fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2, **kwargs):
+    img = _to_np(src)
+    h, w = img.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(*area) * src_area
+        log_ratio = (_np.log(ratio[0]), _np.log(ratio[1]))
+        new_ratio = _np.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_np.sqrt(target_area * new_ratio)))
+        new_h = int(round(_np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    img = _to_np(src).astype("float32")
+    img = img - _to_np(mean)
+    if std is not None:
+        img = img / _to_np(std)
+    return nd_array(img)
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd_array(_np.ascontiguousarray(_to_np(src)[:, ::-1]))
+        return src
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__(mean=list(_np.ravel(mean)), std=list(_np.ravel(std)))
+        self.mean = _np.asarray(mean, "float32")
+        self.std = _np.asarray(std, "float32")
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return nd_array(_to_np(src).astype(self.typ))
+
+
+class BrightnessJitterAug(Augmenter):
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _pyrandom.uniform(-self.brightness, self.brightness)
+        return nd_array(_to_np(src).astype("float32") * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        img = _to_np(src).astype("float32")
+        alpha = 1.0 + _pyrandom.uniform(-self.contrast, self.contrast)
+        gray = (img * self._coef).sum() * 3.0 / img.size
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    _coef = _np.array([[[0.299, 0.587, 0.114]]], "float32")
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        img = _to_np(src).astype("float32")
+        alpha = 1.0 + _pyrandom.uniform(-self.saturation, self.saturation)
+        gray = (img * self._coef).sum(axis=2, keepdims=True)
+        return nd_array(img * alpha + gray * (1 - alpha))
+
+
+class RandomOrderAug(Augmenter):
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        ts = list(self.ts)
+        _pyrandom.shuffle(ts)
+        for t in ts:
+            src = t(src)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__()
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, "float32")
+        self.eigvec = _np.asarray(eigvec, "float32")
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,)).astype("float32")
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return nd_array(_to_np(src).astype("float32") + rgb)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Standard augmenter list builder (reference API)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(
+            type("RandomSizedCropAug", (Augmenter,), {
+                "__call__": lambda self, src: random_size_crop(
+                    src, crop_size, (0.08, 1.0), (3 / 4.0, 4 / 3.0)
+                )[0]
+            })()
+        )
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array(
+            [[-0.5675, 0.7192, 0.4009],
+             [-0.5808, -0.0045, -0.8140],
+             [-0.5836, -0.6948, 0.4203]]
+        )
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if mean is not None or std is not None:
+        if mean is True:
+            mean = _np.array([123.68, 116.28, 103.53])
+        if std is True:
+            std = _np.array([58.395, 57.12, 57.375])
+        auglist.append(ColorNormalizeAug(mean, std if std is not None else 1.0))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Image iterator over .rec shards or a path list (reference:
+    ``mx.image.ImageIter`` over the C++ ImageRecordIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root="",
+                 shuffle=False, part_index=0, num_parts=1, aug_list=None,
+                 imglist=None, data_name="data", label_name="softmax_label",
+                 dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        assert path_imgrec or path_imglist or imglist, (
+            "one of path_imgrec/path_imglist/imglist required"
+        )
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.shuffle = shuffle
+        self.dtype = dtype
+        self._data_name = data_name
+        self._label_name = label_name
+        self.auglist = aug_list if aug_list is not None else CreateAugmenter(
+            data_shape
+        )
+        self.imgrec = None
+        self.seq = None
+        if path_imgrec:
+            from .recordio import MXIndexedRecordIO
+
+            idx_path = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = MXIndexedRecordIO(idx_path, path_imgrec, "r")
+            self.seq = list(self.imgrec.keys)
+        else:
+            entries = []
+            if path_imglist:
+                with open(path_imglist) as fin:
+                    for line in fin:
+                        parts = line.strip().split("\t")
+                        label = _np.array(
+                            [float(i) for i in parts[1:-1]], "float32"
+                        )
+                        entries.append((parts[-1], label))
+            else:
+                for item in imglist:
+                    entries.append((item[-1], _np.asarray(item[:-1], "float32")))
+            self.imglist = entries
+            self.path_root = path_root
+            self.seq = list(range(len(entries)))
+        # sharded reading (reference: part_index/num_parts)
+        n = len(self.seq)
+        per = n // num_parts
+        self.seq = self.seq[part_index * per : (part_index + 1) * per] \
+            if num_parts > 1 else self.seq
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name, (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self._label_name, (self.batch_size, self.label_width)
+                         if self.label_width > 1 else (self.batch_size,))]
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self.seq)
+        self.cur = 0
+
+    def _read_sample(self, idx):
+        if self.imgrec is not None:
+            from .recordio import unpack
+
+            header, img_bytes = unpack(self.imgrec.read_idx(idx))
+            label = header.label
+            img = imdecode(img_bytes)
+        else:
+            fname, label = self.imglist[idx]
+            img = imread(os.path.join(self.path_root, fname))
+        return img, label
+
+    def next(self):
+        if self.cur + self.batch_size > len(self.seq):
+            raise StopIteration
+        c, h, w = self.data_shape
+        data = _np.zeros((self.batch_size, c, h, w), self.dtype)
+        labels = _np.zeros(
+            (self.batch_size, self.label_width), "float32"
+        )
+        for i in range(self.batch_size):
+            img, label = self._read_sample(self.seq[self.cur + i])
+            for aug in self.auglist:
+                img = aug(img)
+            arr = _to_np(img)
+            if arr.ndim == 2:
+                arr = arr[:, :, None]
+            data[i] = arr.transpose(2, 0, 1)
+            labels[i] = _np.ravel(label)[: self.label_width]
+        self.cur += self.batch_size
+        label_out = labels if self.label_width > 1 else labels[:, 0]
+        return DataBatch(
+            data=[nd_array(data)], label=[nd_array(label_out)], pad=0,
+        )

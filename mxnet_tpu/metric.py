@@ -180,10 +180,14 @@ class TopKAccuracy(EvalMetric):
         for label, pred in zip(labels, preds):
             pred, label = _as_numpy(pred), _as_numpy(label).astype("int32")
             assert pred.ndim == 2, "Predictions should be 2 dims"
-            pred = _np.argpartition(pred.astype("float32"), -self.top_k)
             num_samples = pred.shape[0]
             num_classes = pred.shape[1]
-            top_k = min(num_classes, self.top_k)
+            top_k = min(num_classes, self.top_k)  # clamp BEFORE argpartition
+            if top_k == num_classes:
+                self.sum_metric += float(num_samples)  # every label is in top-k
+                self.num_inst += num_samples
+                continue
+            pred = _np.argpartition(pred.astype("float32"), -top_k)
             for j in range(top_k):
                 self.sum_metric += float(
                     (pred[:, num_classes - 1 - j].ravel() == label.ravel()).sum()
@@ -193,7 +197,8 @@ class TopKAccuracy(EvalMetric):
 
 @register
 class F1(EvalMetric):
-    """Binary F1 (reference semantics: average='macro' over resets)."""
+    """Binary F1. average='macro' (reference default): mean of per-batch F1
+    scores; 'micro': F1 of the cumulative tp/fp/fn counts."""
 
     def __init__(self, name="f1", output_names=None, label_names=None,
                  average="macro"):
@@ -208,6 +213,14 @@ class F1(EvalMetric):
         super().reset()
         self.reset_stats()
 
+    @staticmethod
+    def _f1(tp, fp, fn):
+        precision = tp / (tp + fp) if tp + fp > 0 else 0.0
+        recall = tp / (tp + fn) if tp + fn > 0 else 0.0
+        if precision + recall > 0:
+            return 2 * precision * recall / (precision + recall)
+        return 0.0
+
     def update(self, labels, preds):
         labels, preds = check_label_shapes(_as_list(labels), _as_list(preds), True)
         for label, pred in zip(labels, preds):
@@ -216,18 +229,18 @@ class F1(EvalMetric):
                 pred = _np.argmax(pred, axis=-1)
             pred = pred.ravel().astype("int32")
             label = label.ravel().astype("int32")
-            self.tp += float(((pred == 1) & (label == 1)).sum())
-            self.fp += float(((pred == 1) & (label == 0)).sum())
-            self.fn += float(((pred == 0) & (label == 1)).sum())
-            precision = self.tp / (self.tp + self.fp) if self.tp + self.fp > 0 else 0.0
-            recall = self.tp / (self.tp + self.fn) if self.tp + self.fn > 0 else 0.0
-            f1 = (
-                2 * precision * recall / (precision + recall)
-                if precision + recall > 0
-                else 0.0
-            )
-            self.sum_metric = f1
-            self.num_inst = 1
+            tp = float(((pred == 1) & (label == 1)).sum())
+            fp = float(((pred == 1) & (label == 0)).sum())
+            fn = float(((pred == 0) & (label == 1)).sum())
+            if self.average == "macro":
+                self.sum_metric += self._f1(tp, fp, fn)
+                self.num_inst += 1
+            else:  # micro: cumulative counts
+                self.tp += tp
+                self.fp += fp
+                self.fn += fn
+                self.sum_metric = self._f1(self.tp, self.fp, self.fn)
+                self.num_inst = 1
 
 
 @register

@@ -1,0 +1,11 @@
+"""Pallas TPU kernels — the native-kernel budget of this framework
+(SURVEY.md §7: attention fwd/bwd, layer_norm, softmax, fused optimizers go
+to hand kernels where the reference had CUDA).
+
+Kernels fall back to interpreter mode off-TPU so the one test suite runs on
+the virtual CPU mesh unchanged (reference trick: one suite, many contexts).
+"""
+
+from .flash_attention import flash_attention  # noqa: F401
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,222 @@
+"""Flash attention: Pallas TPU kernel, online-softmax forward + blockwise
+recompute backward (both O(S) memory).
+
+Replaces the reference's ``src/operator/contrib/transformer.cc`` interleaved
+attention ops [unverified], which materialize the full O(L²) score matrix —
+the reference's long-context ceiling (SURVEY.md §5). Design follows the
+standard flash algorithm: Q blocks ride the grid, K/V blocks stream through
+an inner loop carrying (running max, denominator, accumulator); the MXU sees
+(block_q × d) @ (d × block_k) tiles, VMEM holds one head's K/V.
+
+Backward recomputes P blockwise from the saved logsumexp under ``lax.scan``
+(XLA fuses it into one loop); a hand-written Pallas backward is a later
+optimization — the recompute pass is already fused and O(S)-memory.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU backend module; absent in some CPU-only builds
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PLTPU = True
+except ImportError:  # pragma: no cover
+    pltpu = None
+    _HAS_PLTPU = False
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _use_interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
+                kv_len, causal, block_q):
+    # refs: q (1, block_q, d), k/v (1, padded_kv, d), o (1, block_q, d),
+    # lse (1, block_q, 1) — leading dim is the (b*h) grid block of size 1
+    iq = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    d = q.shape[-1]
+    padded_kv = k_ref.shape[1]
+    nk = padded_kv // block_k
+
+    q_pos = iq * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0)
+
+    def body(jk, carry):
+        m_prev, l_prev, acc = carry
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale  # (block_q, block_k)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        mask = k_pos < kv_len
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * alpha + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc
+
+    m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    if causal:
+        # blocks fully above the diagonal contribute nothing — skip them
+        nk_eff = jnp.minimum(
+            nk, pl.cdiv((iq + 1) * block_q, block_k)
+        )
+    else:
+        nk_eff = nk
+    m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l)).astype(jnp.float32)
+
+
+def _pad_to(x, axis, multiple):
+    size = x.shape[axis]
+    pad = (-size) % multiple
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k")
+)
+def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
+    """q (B,H,Sq,D), k/v (B,H,Sk,D) -> out (B,H,Sq,D), lse (B,H,Sq)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, max(Sq, 8))
+    bk = min(block_k, max(Sk, 8))
+    qp = _pad_to(q, 2, bq)
+    kp = _pad_to(k, 2, bk)
+    vp = _pad_to(v, 2, bk)
+    Sq_p, Sk_p = qp.shape[2], kp.shape[2]
+    qp = qp.reshape(B * H, Sq_p, D)
+    kp = kp.reshape(B * H, Sk_p, D)
+    vp = vp.reshape(B * H, Sk_p, D)
+    grid = (B * H, Sq_p // bq)
+    kernel = functools.partial(
+        _fwd_kernel, sm_scale=sm_scale, block_k=bk, kv_len=Sk,
+        causal=causal, block_q=bq,
+    )
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, Sq_p, 1), jnp.float32),
+        ],
+        interpret=_use_interpret(),
+    )(qp, kp, vp)
+    out = out.reshape(B, H, Sq_p, D)[:, :, :Sq]
+    lse = lse.reshape(B, H, Sq_p)[:, :, :Sq]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
+                    block_k=128):
+    """Fused softmax(q·kᵀ·scale)·v. Shapes (B, H, S, D); O(S) memory."""
+    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_fwd_impl(q, k, v, causal, float(sm_scale), block_q, block_k)
+
+
+def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_k")
+)
+def _flash_bwd_impl(q, k, v, out, lse, do, causal, sm_scale, block_k):
+    """Blockwise recompute backward (scan over K blocks, O(S·block) memory)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bk = min(block_k, Sk)
+    kp = _pad_to(k, 2, bk)
+    vp = _pad_to(v, 2, bk)
+    Sk_p = kp.shape[2]
+    nk = Sk_p // bk
+
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,Sq)
+    q_pos = jnp.arange(Sq)[:, None]
+
+    def body(dq_acc, jk):
+        kb = jax.lax.dynamic_slice_in_dim(kp, jk * bk, bk, 2).astype(jnp.float32)
+        vb = jax.lax.dynamic_slice_in_dim(vp, jk * bk, bk, 2).astype(jnp.float32)
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * sm_scale
+        k_pos = jk * bk + jnp.arange(bk)[None, :]
+        mask = k_pos < Sk
+        if causal:
+            mask = jnp.logical_and(mask, k_pos <= q_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+        p = jnp.exp(s - lse[..., None])  # (B,H,Sq,bk)
+        dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+        dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb)
+        ds = p * (dp - delta[..., None]) * sm_scale
+        dq_acc = dq_acc + jnp.einsum("bhqk,bhkd->bhqd", ds, kb)
+        dk_b = jnp.einsum("bhqk,bhqd->bhkd", ds, qf)
+        return dq_acc, (dk_b, dv_b)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, jnp.arange(nk))
+    # dks: (nk, B, H, bk, D) -> (B, H, Sk_p, D)
+    dk = jnp.moveaxis(dks, 0, 2).reshape(B, H, Sk_p, D)[:, :, :Sk]
+    dv = jnp.moveaxis(dvs, 0, 2).reshape(B, H, Sk_p, D)[:, :, :Sk]
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+def _bwd_rule(causal, sm_scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    dq, dk, dv = _flash_bwd_impl(
+        q, k, v, out, lse, g, causal, float(sm_scale), block_k
+    )
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_fwd_rule, _bwd_rule)

@@ -137,3 +137,8 @@ def init_process_group(coordinator_address: str, num_processes: int,
         process_id=process_id,
         local_device_ids=local_device_ids,
     )
+
+
+from .step import TrainStep  # noqa: E402  (public API; needs defs above)
+
+__all__.append("TrainStep")

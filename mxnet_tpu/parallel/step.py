@@ -1,0 +1,352 @@
+"""Whole-model jitted training step with GSPMD sharding.
+
+This is the TPU-native replacement for the reference's training hot path
+(SURVEY.md §3.3: per-op engine pushes + KVStore push/pull + per-param fused
+optimizer kernels). Here ONE XLA executable contains forward, backward,
+gradient all-reduce (psum inserted by GSPMD over the mesh's ``data`` axis)
+and the optimizer update, with parameter/optimizer buffers donated — the
+compiled analogue of CachedOp + kvstore + multi-tensor update in a single
+program, with comm/compute overlap handled by XLA's latency-hiding
+scheduler.
+
+Tensor parallelism comes free by rule: ``param_rules`` maps parameter-name
+regexes to PartitionSpecs; annotated weights shard over the ``model`` axis
+and GSPMD inserts the matching collectives.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import random as _random
+from .. import optimizer as _opt
+from ..ops import optimizer_op as _fused
+
+__all__ = ["TrainStep"]
+
+
+def _pure_update_factory(optimizer):
+    """Map an Optimizer instance to (state_init, pure_update).
+
+    pure_update(w, g, states, lr, wd, t) -> (new_w, new_states); hypers are
+    closed over statically, lr/wd/t are dynamic scalars (no retrace when the
+    schedule moves).
+    """
+    clip = optimizer.clip_gradient if optimizer.clip_gradient is not None else -1.0
+
+    if isinstance(optimizer, _opt.SGD):
+        mom = optimizer.momentum
+
+        def init(w):
+            return (jnp.zeros_like(w),) if mom else ()
+
+        def update(w, g, states, lr, wd, t, rescale):
+            if mom:
+                new_w, new_m = _fused.sgd_mom_update(
+                    w, g, states[0], lr=lr, momentum=mom, wd=wd,
+                    rescale_grad=rescale, clip_gradient=clip,
+                )
+                return new_w, (new_m,)
+            return (
+                _fused.sgd_update(w, g, lr=lr, wd=wd, rescale_grad=rescale,
+                                  clip_gradient=clip),
+                (),
+            )
+
+        return init, update
+
+    if isinstance(optimizer, _opt.LAMB):
+        b1, b2, eps = optimizer.beta1, optimizer.beta2, optimizer.epsilon
+        lower = optimizer.lower_bound if optimizer.lower_bound is not None else -1.0
+        upper = optimizer.upper_bound if optimizer.upper_bound is not None else -1.0
+        bias_corr = optimizer.bias_correction
+
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, states, lr, wd, t, rescale):
+            gup, m, v = _fused.lamb_update_phase1(
+                w, g, states[0], states[1], beta1=b1, beta2=b2, epsilon=eps,
+                t=t.astype(jnp.float32), bias_correction=bias_corr, wd=wd,
+                rescale_grad=rescale, clip_gradient=clip,
+            )
+            r1 = jnp.linalg.norm(w)
+            r2 = jnp.linalg.norm(gup)
+            new_w = _fused.lamb_update_phase2(
+                w, gup, r1, r2, lr=lr, lower_bound=lower, upper_bound=upper
+            )
+            return new_w, (m, v)
+
+        return init, update
+
+    if isinstance(optimizer, _opt.AdamW):
+        b1, b2, eps = optimizer.beta1, optimizer.beta2, optimizer.epsilon
+        correct = optimizer.correct_bias
+
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, states, lr, wd, t, rescale):
+            if correct:
+                tf = t.astype(jnp.float32)
+                lr = lr * jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
+            new_w, m, v = _fused.adamw_update(
+                w, g, states[0], states[1], lr=lr, beta1=b1, beta2=b2,
+                epsilon=eps, wd=wd, rescale_grad=rescale, clip_gradient=clip,
+            )
+            return new_w, (m, v)
+
+        return init, update
+
+    if isinstance(optimizer, _opt.Adam):
+        b1, b2, eps = optimizer.beta1, optimizer.beta2, optimizer.epsilon
+
+        def init(w):
+            return (jnp.zeros_like(w), jnp.zeros_like(w))
+
+        def update(w, g, states, lr, wd, t, rescale):
+            tf = t.astype(jnp.float32)
+            lr = lr * jnp.sqrt(1.0 - b2 ** tf) / (1.0 - b1 ** tf)
+            new_w, m, v = _fused.adam_update(
+                w, g, states[0], states[1], lr=lr, beta1=b1, beta2=b2,
+                epsilon=eps, wd=wd, rescale_grad=rescale, clip_gradient=clip,
+            )
+            return new_w, (m, v)
+
+        return init, update
+
+    raise MXNetError(
+        f"TrainStep has no fused pure update for {type(optimizer).__name__}; "
+        "use Trainer.step (per-param path) or add a mapping"
+    )
+
+
+class TrainStep:
+    """Compile net+loss+optimizer into one sharded XLA training step.
+
+    Parameters
+    ----------
+    net : initialized Gluon Block
+    loss_fn : gluon Loss block (applied as ``loss_fn(net(*data), label)``)
+    optimizer : Optimizer instance (SGD/Adam/AdamW/LAMB fused)
+    mesh : jax Mesh or None (single device)
+    data_spec : PartitionSpec for every batch input (default shard axis 0
+        over 'data' when the mesh has a data axis)
+    param_rules : [(regex, PartitionSpec)] tensor-parallel placement rules
+    grad_accum : microbatch accumulation steps (lax.scan over microbatches)
+    """
+
+    def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
+                 data_spec: Optional[PartitionSpec] = None,
+                 param_rules: Sequence[Tuple[str, PartitionSpec]] = (),
+                 donate: bool = True, grad_accum: int = 1,
+                 compute_dtype=None):
+        self._net = net
+        self._loss = loss_fn
+        self._optimizer = optimizer
+        self._mesh = mesh
+        self._accum = int(grad_accum)
+        # AMP: cast float params/inputs to this dtype INSIDE the jitted step
+        # (f32 masters + optimizer state stay, grads flow back through the
+        # cast) — the reference's multi-precision fp16 scheme, bf16-first
+        self._compute_dtype = (
+            jnp.dtype(compute_dtype) if compute_dtype is not None else None
+        )
+        self._params = list(net.collect_params().items())
+        for name, p in self._params:
+            if p._data is None:
+                raise MXNetError(
+                    f"parameter {name} not initialized; run one forward (or "
+                    "initialize with known shapes) before building TrainStep"
+                )
+        self._train_names = [n for n, p in self._params
+                             if p.grad_req != "null"]
+        self._init_state, self._pure_update = _pure_update_factory(optimizer)
+        self._t = 0
+
+        # placement -------------------------------------------------------
+        if mesh is not None:
+            axis_names = mesh.axis_names
+            if data_spec is None:
+                data_spec = PartitionSpec("data") if "data" in axis_names \
+                    else PartitionSpec()
+            self._data_sharding = NamedSharding(mesh, data_spec)
+            rules = [(re.compile(pat), spec) for pat, spec in param_rules]
+
+            def param_sharding(name):
+                for pat, spec in rules:
+                    if pat.search(name):
+                        return NamedSharding(mesh, spec)
+                return NamedSharding(mesh, PartitionSpec())
+
+            self._param_sharding = param_sharding
+        else:
+            self._data_sharding = None
+            self._param_sharding = None
+
+        # device state ----------------------------------------------------
+        self._values: Dict[str, jax.Array] = {}
+        for name, p in self._params:
+            v = p._data.data
+            if self._param_sharding is not None:
+                v = jax.device_put(v, self._param_sharding(name))
+            self._values[name] = v
+        self._opt_state = {
+            n: self._init_state(self._values[n]) for n in self._train_names
+        }
+        if self._param_sharding is not None:
+            self._opt_state = {
+                n: tuple(
+                    jax.device_put(s, self._param_sharding(n)) for s in st
+                )
+                for n, st in self._opt_state.items()
+            }
+
+        self._step_fn = self._build(donate)
+
+    # ---------------------------------------------------------------- build
+    def _build(self, donate):
+        from ..gluon.block import _aux_scope, _trace_scope
+        from ..gluon.parameter import param_override
+        from .. import autograd
+
+        net, loss_block = self._net, self._loss
+        params = self._params
+        train_names = set(self._train_names)
+        name2param = {n: p for n, p in params}
+        pure_update = self._pure_update
+        rescale = float(self._optimizer.rescale_grad)
+        accum = self._accum
+        # static per-param hyper multipliers
+        lr_mult = {n: name2param[n].lr_mult for n in train_names}
+        wd_mult = {n: name2param[n].wd_mult for n in train_names}
+        base_wd = float(self._optimizer.wd)
+
+        name2param_inv = {id(p): n for n, p in params}
+        cdt = self._compute_dtype
+
+        def _cast(v):
+            if cdt is not None and jnp.issubdtype(v.dtype, jnp.floating):
+                return v.astype(cdt)
+            return v
+
+        def forward_loss(train_vals, frozen_vals, batch, label, key):
+            mapping = {}
+            for n, p in params:
+                v = train_vals[n] if n in train_vals else frozen_vals[n]
+                mapping[p] = NDArray(_cast(v))
+            sink = {}
+            with param_override(mapping), _random.key_supply(key), \
+                    _aux_scope(sink), _trace_scope(), \
+                    autograd._scope(False, True):
+                out = net(*[NDArray(_cast(b)) for b in batch])
+                outs = out if isinstance(out, tuple) else (out,)
+                L = loss_block(*outs, NDArray(label))
+                Lm = L.data.astype(jnp.float32).mean()
+            aux = {name2param_inv[id(p)]: v for p, v in sink.items()}
+            return Lm, aux
+
+        def step(train_vals, frozen_vals, opt_state, batch, label, key,
+                 lr, t):
+            # batch: tuple of arrays; with accum > 1 each has a leading
+            # microbatch dim of size `accum` scanned by lax.scan
+            if accum == 1:
+                (L, aux), grads = jax.value_and_grad(
+                    forward_loss, has_aux=True
+                )(train_vals, frozen_vals, batch, label, key)
+            else:
+                def micro(carry, inp):
+                    g_acc, k = carry
+                    k, sub = jax.random.split(k)
+                    mb, ml = inp
+                    (Lm, aux_m), g = jax.value_and_grad(
+                        forward_loss, has_aux=True
+                    )(train_vals, frozen_vals, mb, ml, sub)
+                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    return (g_acc, k), (Lm, aux_m)
+
+                g0 = jax.tree.map(jnp.zeros_like, train_vals)
+                (grads, _), (Ls, auxs) = jax.lax.scan(
+                    micro, (g0, key), (batch, label)
+                )
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                L = Ls.mean()
+                aux = jax.tree.map(lambda a: a[-1], auxs)
+            new_vals = {}
+            new_opt = {}
+            for n in sorted(train_vals):
+                w, g = train_vals[n], grads[n]
+                nw, ns = pure_update(
+                    w, g, opt_state[n], lr * lr_mult[n],
+                    base_wd * wd_mult[n], t, rescale,
+                )
+                new_vals[n] = nw.astype(w.dtype)
+                new_opt[n] = ns
+            return L, new_vals, new_opt, aux
+
+        donate_args = (0, 2) if donate else ()
+        return jax.jit(step, donate_argnums=donate_args)
+
+    # ----------------------------------------------------------------- call
+    def __call__(self, *batch_and_label):
+        """Run one step. Last argument is the label; returns loss NDArray."""
+        *batch, label = batch_and_label
+        batch = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
+                 for b in batch]
+        label = label.data if isinstance(label, NDArray) else jnp.asarray(label)
+        if self._accum > 1:
+            n = self._accum
+            batch = [b.reshape((n, b.shape[0] // n) + b.shape[1:])
+                     for b in batch]
+            label = label.reshape((n, label.shape[0] // n) + label.shape[1:])
+        if self._data_sharding is not None:
+            # with accum, shard the per-microbatch axis (axis 1) instead
+            if self._accum > 1:
+                spec = self._data_sharding.spec
+                shard = NamedSharding(
+                    self._mesh, PartitionSpec(None, *spec)
+                )
+            else:
+                shard = self._data_sharding
+            batch = [jax.device_put(b, shard) for b in batch]
+            label = jax.device_put(label, shard)
+        self._t += 1
+        lr = self._current_lr()
+        train_set = set(self._train_names)
+        train_vals = {n: self._values[n] for n in self._train_names}
+        frozen_vals = {n: v for n, v in self._values.items()
+                       if n not in train_set}
+        key = _random.next_key()
+        L, new_vals, self._opt_state, aux = self._step_fn(
+            train_vals, frozen_vals, self._opt_state, tuple(batch), label,
+            key, jnp.float32(lr), jnp.int32(self._t),
+        )
+        self._values.update(new_vals)
+        for n, v in aux.items():
+            self._values[n] = v
+        return NDArray(L)
+
+    def _current_lr(self):
+        opt = self._optimizer
+        if opt.lr_scheduler is not None:
+            return opt.lr_scheduler(self._t)
+        return opt.lr
+
+    # ------------------------------------------------------------- sync out
+    def sync_params(self):
+        """Write device values back into the net's Parameters (for eval /
+        checkpointing through the normal Gluon APIs)."""
+        for n, p in self._params:
+            p._data._rebind(self._values[n])
+
+    @property
+    def loss_scale(self):
+        return 1.0

@@ -9,11 +9,20 @@ the multi-chip path).
 
 import os
 
-# must be set before jax import
-os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# must be set before jax import; FORCE cpu — the session environment pins
+# JAX_PLATFORMS to the tunneled TPU (axon), but the suite needs the 8-device
+# virtual CPU mesh (set MXTPU_TEST_PLATFORM to override, e.g. for a TPU run)
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+_platform = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
+os.environ["JAX_PLATFORMS"] = _platform
 
 import jax
+
+# a pytest plugin may import jax before this conftest runs, freezing the
+# env-derived platform config — override through the config API as well
+jax.config.update("jax_platforms", _platform)
 import numpy as np
 import pytest
 

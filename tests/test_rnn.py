@@ -1,0 +1,105 @@
+"""RNN layer/cell tests (reference: tests/python/unittest/test_gluon_rnn.py
+[unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import rnn
+
+
+@pytest.mark.parametrize("cls,nstates", [(rnn.LSTM, 2), (rnn.GRU, 1),
+                                         (rnn.RNN, 1)])
+def test_fused_layer_shapes(cls, nstates):
+    layer = cls(8, num_layers=2, bidirectional=True)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(5, 3, 4).astype("float32"))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    out2, states = layer(x, layer.begin_state(3))
+    assert len(states) == nstates
+    assert states[0].shape == (4, 3, 8)  # layers*dirs, N, H
+
+
+def test_lstm_layer_ntc_layout():
+    layer = rnn.LSTM(6, layout="NTC")
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(3, 5, 4).astype("float32"))
+    out = layer(x)
+    assert out.shape == (3, 5, 6)
+
+
+def test_lstm_layer_grads():
+    layer = rnn.LSTM(8, num_layers=1)
+    layer.initialize()
+    x = mx.nd.array(np.random.randn(5, 3, 4).astype("float32"))
+    with autograd.record():
+        loss = layer(x).sum()
+    loss.backward()
+    g = layer.l0_i2h_weight.grad().asnumpy()
+    assert not np.allclose(g, 0)
+
+
+def test_lstm_cell_matches_fused_single_layer():
+    """Cell unroll must equal the fused LSTM layer given the same weights."""
+    T, N, I, H = 4, 2, 3, 5
+    x = np.random.randn(T, N, I).astype("float32")
+    fused = rnn.LSTM(H, input_size=I)
+    fused.initialize()
+    cell = rnn.LSTMCell(H, input_size=I)
+    cell.initialize()
+    # copy weights
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+    out_fused = fused(mx.nd.array(x)).asnumpy()
+    out_cell, _ = cell.unroll(T, mx.nd.array(x), layout="TNC")
+    np.testing.assert_allclose(out_fused, out_cell.asnumpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_cell_unroll_and_grads():
+    cell = rnn.GRUCell(8)
+    cell.initialize()
+    x = mx.nd.array(np.random.randn(3, 7, 4).astype("float32"))
+    with autograd.record():
+        outs, states = cell.unroll(7, x, layout="NTC")
+        loss = outs.sum()
+    loss.backward()
+    assert outs.shape == (3, 7, 8)
+    assert not np.allclose(cell.i2h_weight.grad().asnumpy(), 0)
+
+
+def test_sequential_and_residual_cells():
+    sc = rnn.SequentialRNNCell()
+    sc.add(rnn.GRUCell(8))
+    sc.add(rnn.ResidualCell(rnn.GRUCell(8)))
+    sc.initialize()
+    x = mx.nd.array(np.random.randn(3, 5, 4).astype("float32"))
+    outs, states = sc.unroll(5, x, layout="NTC")
+    assert outs.shape == (3, 5, 8)
+    assert len(states) == 2
+
+
+def test_bidirectional_cell():
+    bi = rnn.BidirectionalCell(rnn.GRUCell(6), rnn.GRUCell(6))
+    bi.initialize()
+    x = mx.nd.array(np.random.randn(3, 7, 4).astype("float32"))
+    outs, states = bi.unroll(7, x, layout="NTC")
+    assert outs.shape == (3, 7, 12)
+
+
+def test_rnn_layer_in_hybrid_net():
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(rnn.LSTM(8, layout="NTC"))
+        net.add(gluon.nn.Dense(2))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.random.randn(3, 5, 4).astype("float32"))
+    out = net(x)
+    assert out.shape == (3, 2)
+    out2 = net(x)
+    np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=1e-5)
