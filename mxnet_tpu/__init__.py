@@ -41,7 +41,10 @@ for _mod, _aliases in [
     ("initializer", ()),
     ("optimizer", ()),
     ("metric", ()),
+    ("symbol", ("sym",)),
+    ("executor", ()),
     ("gluon", ()),
+    ("module", ()),
     ("kvstore", ("kv",)),
     ("parallel", ()),
     ("recordio", ()),

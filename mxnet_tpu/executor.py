@@ -1,0 +1,134 @@
+"""Executor (reference: ``src/executor/graph_executor.cc`` +
+``python/mxnet/executor.py`` [unverified]).
+
+``simple_bind``'s whole pipeline — InferShape, PlanMemory, AttachOpExecs,
+pointwise fusion — is one ``jax.jit`` here: the graph evaluates as a single
+XLA executable; backward is its vjp. Buffer sharing/liveness is XLA's
+problem (it does the reference's PlanMemory job during buffer assignment)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, shapes=None, grad_req="write",
+                 args=None, args_grad=None):
+        self._symbol = symbol
+        self._ctx = ctx
+        self._grad_req = grad_req
+        self._arg_names = symbol.list_arguments()
+        self.arg_dict: Dict[str, NDArray] = {}
+        self.grad_dict: Dict[str, NDArray] = {}
+        self.aux_dict: Dict[str, NDArray] = {}
+        if args is not None:
+            if isinstance(args, dict):
+                self.arg_dict = dict(args)
+            else:
+                self.arg_dict = dict(zip(self._arg_names, args))
+        elif shapes:
+            missing = [n for n in self._arg_names if n not in shapes]
+            if missing:
+                # infer parameter shapes from the data shapes (the nnvm
+                # InferShape role — see Symbol._infer_all_shapes)
+                shapes = symbol._infer_all_shapes(
+                    {k: tuple(v) for k, v in shapes.items()}
+                )
+            for name in self._arg_names:
+                if name in shapes:
+                    self.arg_dict[name] = NDArray(
+                        jnp.zeros(shapes[name], jnp.float32)
+                    )
+                else:
+                    raise MXNetError(
+                        f"simple_bind needs a shape for argument {name}"
+                    )
+        if args_grad is not None:
+            if isinstance(args_grad, dict):
+                self.grad_dict = dict(args_grad)
+            else:
+                self.grad_dict = dict(zip(self._arg_names, args_grad))
+        elif grad_req != "null":
+            self.grad_dict = {
+                n: NDArray(jnp.zeros_like(a.data))
+                for n, a in self.arg_dict.items()
+            }
+        self.outputs: List[NDArray] = []
+        self._fwd = jax.jit(self._run)
+        self._vjp_fn = None
+
+    def _run(self, values):
+        out = self._symbol._eval(dict(values), {})
+        return out if isinstance(out, tuple) else (out,)
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(
+                    v.data if isinstance(v, NDArray) else jnp.asarray(v)
+                )
+            else:
+                self.arg_dict[k] = v if isinstance(v, NDArray) else NDArray(
+                    jnp.asarray(v)
+                )
+        values = {n: a.data for n, a in self.arg_dict.items()}
+        if is_train and self._grad_req != "null":
+            outs, self._vjp_fn = jax.vjp(self._run, values)
+        else:
+            outs = self._fwd(values)
+            self._vjp_fn = None
+        self.outputs = [NDArray(o) for o in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._vjp_fn is None:
+            raise MXNetError("call forward(is_train=True) before backward()")
+        if out_grads is None:
+            cts = tuple(jnp.ones_like(o.data) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(
+                g.data if isinstance(g, NDArray) else jnp.asarray(g)
+                for g in out_grads
+            )
+        (grads,) = self._vjp_fn(cts)
+        for name, g in grads.items():
+            if name not in self.grad_dict or self.grad_dict[name] is None:
+                continue
+            if self._grad_req == "add":
+                self.grad_dict[name]._rebind(self.grad_dict[name].data + g)
+            elif self._grad_req == "write":
+                self.grad_dict[name]._rebind(g)
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._rebind(
+                    arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+                )
+            elif not allow_extra_params:
+                raise MXNetError(f"extra parameter {name}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}
+        shapes.update(kwargs)
+        return Executor(self._symbol, self._ctx, shapes, self._grad_req)

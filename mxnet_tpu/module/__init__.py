@@ -1,0 +1,5 @@
+"""Legacy Module API (reference: ``python/mxnet/module/`` [unverified])."""
+
+from .module import Module, BucketingModule
+
+__all__ = ["Module", "BucketingModule"]

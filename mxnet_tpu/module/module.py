@@ -1,0 +1,344 @@
+"""Module: symbol-based training loop (reference:
+``python/mxnet/module/module.py``, ``base_module.py`` [unverified]).
+
+The reference's ``DataParallelExecutorGroup`` (one executor per GPU, split
+batches) is NOT replicated: one Executor backed by a jitted program covers a
+chip, and multi-device data parallelism is a sharding of that program
+(SURVEY.md §2.3) — the Module API surface stays the same."""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray import array as nd_array
+from .. import initializer as _init
+from .. import metric as _metric
+from .. import optimizer as _opt
+
+__all__ = ["Module", "BucketingModule"]
+
+
+class Module:
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = list(fixed_param_names or [])
+        self._exec = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+        self._logger = logger
+
+    # ------------------------------------------------------------ properties
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._symbol.list_outputs()
+
+    def _param_names(self):
+        return [
+            n for n in self._symbol.list_arguments()
+            if n not in self._data_names and n not in self._label_names
+        ]
+
+    # ----------------------------------------------------------------- bind
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = desc[0], desc[1]
+            shapes[name] = tuple(shape)
+        if label_shapes:
+            for desc in label_shapes:
+                shapes[desc[0]] = tuple(desc[1])
+        self._exec = self._symbol.simple_bind(
+            grad_req=grad_req if for_training else "null", **shapes
+        )
+        self._for_training = for_training
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False, allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("call bind before init_params")
+        if initializer is None:
+            initializer = _init.Uniform(0.01)
+        for name in self._param_names():
+            arr = self._exec.arg_dict[name]
+            if arg_params and name in arg_params:
+                src = arg_params[name]
+                arr._rebind(
+                    src.data if isinstance(src, NDArray) else jnp.asarray(src)
+                )
+            else:
+                initializer(_init.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = _opt.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        self._updater = _opt.get_updater(optimizer)
+        self.optimizer_initialized = True
+
+    # -------------------------------------------------------------- compute
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self._for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        if not self.optimizer_initialized:
+            raise MXNetError("call init_optimizer before update")
+        for i, name in enumerate(self._param_names()):
+            grad = self._exec.grad_dict.get(name)
+            if grad is None or name in self._fixed_param_names:
+                continue
+            self._updater(i, grad, self._exec.arg_dict[name])
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_params(self):
+        args = {n: self._exec.arg_dict[n] for n in self._param_names()}
+        return args, dict(self._exec.aux_dict)
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init,
+                         allow_extra=allow_extra)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self._exec.outputs)
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None, kvstore="local",
+            optimizer="sgd", optimizer_params=(("learning_rate", 0.01),),
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        assert num_epoch is not None, "num_epoch required for fit"
+        if not self.binded:
+            self.bind(
+                data_shapes=train_data.provide_data,
+                label_shapes=train_data.provide_label,
+                for_training=True, force_rebind=force_rebind,
+            )
+        self.init_params(initializer, arg_params, aux_params, allow_missing,
+                         force_init)
+        self.init_optimizer(kvstore, optimizer, optimizer_params)
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+
+        for epoch in range(begin_epoch, num_epoch):
+            eval_metric.reset()
+            train_data.reset()
+            for nbatch, batch in enumerate(train_data):
+                self.forward(batch, is_train=True)
+                self.backward()
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    for cb in _as_list(batch_end_callback):
+                        cb(_BatchEndParam(epoch, nbatch, eval_metric))
+            name_val = eval_metric.get_name_value()
+            for name, val in name_val:
+                self._logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            if epoch_end_callback is not None:
+                arg_params, aux_params = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self._symbol, arg_params, aux_params)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric or eval_metric)
+                for name, val in res:
+                    self._logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                      name, val)
+
+    def score(self, eval_data, eval_metric, num_batch=None, **kwargs):
+        if not isinstance(eval_metric, _metric.EvalMetric):
+            eval_metric = _metric.create(eval_metric)
+        eval_metric.reset()
+        eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, merge_batches=True,
+                always_output_list=False):
+        outputs = []
+        eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outputs.append([o.asnumpy() for o in self._exec.outputs])
+        if merge_batches:
+            merged = [
+                nd_array(_np.concatenate([o[i] for o in outputs]))
+                for i in range(len(outputs[0]))
+            ]
+            return merged[0] if len(merged) == 1 and not always_output_list \
+                else merged
+        return outputs
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        self._symbol.save(f"{prefix}-symbol.json")
+        from ..ndarray import save as nd_save
+
+        args, aux = self.get_params()
+        save_dict = {f"arg:{k}": v for k, v in args.items()}
+        save_dict.update({f"aux:{k}": v for k, v in aux.items()})
+        nd_save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+    @staticmethod
+    def load_checkpoint(prefix, epoch):
+        from .. import symbol as sym_mod
+        from ..ndarray import load as nd_load
+
+        symbol = sym_mod.load(f"{prefix}-symbol.json")
+        loaded = nd_load(f"{prefix}-{epoch:04d}.params")
+        arg_params = {
+            k[4:]: v for k, v in loaded.items() if k.startswith("arg:")
+        }
+        aux_params = {
+            k[4:]: v for k, v in loaded.items() if k.startswith("aux:")
+        }
+        return symbol, arg_params, aux_params
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = Module.load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded = (arg_params, aux_params)
+        return mod
+
+
+class _BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = None
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class BucketingModule:
+    """Variable-length sequence training (reference: ``BucketingModule``).
+
+    One Module per bucket key; XLA's per-shape compile cache plays the role
+    the per-bucket executor pool played in the reference."""
+
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._modules: Dict = {}
+        self._kwargs = kwargs
+        self._curr_module = None
+        self.binded = False
+        self.params_initialized = False
+
+    def _get_module(self, bucket_key):
+        if bucket_key not in self._modules:
+            symbol, data_names, label_names = self._sym_gen(bucket_key)
+            self._modules[bucket_key] = Module(
+                symbol, data_names, label_names, **self._kwargs
+            )
+        return self._modules[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             **kwargs):
+        mod = self._get_module(self._default_bucket_key)
+        mod.bind(data_shapes, label_shapes, for_training, **kwargs)
+        self._curr_module = mod
+        self.binded = True
+
+    def init_params(self, *args, **kwargs):
+        self._curr_module.init_params(*args, **kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, *args, **kwargs):
+        self._curr_module.init_optimizer(*args, **kwargs)
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", self._default_bucket_key)
+        mod = self._get_module(key)
+        if not mod.binded:
+            mod.bind(
+                [(n, a.shape) for n, a in zip(
+                    self._curr_module._data_names, data_batch.data)],
+                [(n, a.shape) for n, a in zip(
+                    self._curr_module._label_names, data_batch.label or [])]
+                or None,
+                for_training=True,
+            )
+            # share weights with the default-bucket module: same NDArray
+            # objects, so updates through any bucket are visible to all
+            for n in mod._param_names():
+                if n in self._curr_module._exec.arg_dict:
+                    mod._exec.arg_dict[n] = self._curr_module._exec.arg_dict[n]
+            mod.params_initialized = True
+            mod._optimizer = self._curr_module._optimizer
+            mod._updater = self._curr_module._updater
+            mod.optimizer_initialized = True
+        self._switched = mod
+        mod.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._switched.backward(out_grads)
+
+    def update(self):
+        self._switched.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._switched.get_outputs(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._switched.update_metric(eval_metric, labels)

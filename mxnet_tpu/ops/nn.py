@@ -76,12 +76,47 @@ def softmax_cross_entropy(data, label, **kw):
     return jnp.sum(nll)
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         smooth_alpha):
+    return jax.nn.softmax(data, axis=-1)
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        smooth_alpha):
+    prob = jax.nn.softmax(data, axis=-1)
+    return prob, (prob, label)
+
+
+def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, smooth_alpha,
+                        res, g):
+    # loss-layer semantics (reference src/operator/softmax_output.cc
+    # [unverified]): incoming cotangent is IGNORED; d(data) is the cross-
+    # entropy gradient softmax(data) - onehot(label), optionally masked
+    prob, label = res
+    n_class = prob.shape[-1]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), n_class,
+                            dtype=prob.dtype)
+    if smooth_alpha > 0:
+        onehot = onehot * (1 - smooth_alpha) + smooth_alpha / n_class
+    grad = (prob - onehot) * grad_scale
+    if use_ignore:
+        mask = (label.astype(jnp.int32) != int(ignore_label)).astype(prob.dtype)
+        grad = grad * mask[..., None]
+    return grad, jnp.zeros_like(label)
+
+
+_softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)
+
+
 @register("SoftmaxOutput")
 def softmax_output(data, label, grad_scale=1.0, ignore_label=-1, multi_output=False,
                    use_ignore=False, preserve_shape=False, normalization="null",
                    out_grad=False, smooth_alpha=0.0, **kw):
     """Legacy op: forward = softmax; backward = (softmax - onehot(label))."""
-    return jax.nn.softmax(data, axis=-1)
+    return _softmax_output_core(data, label, float(grad_scale),
+                                int(ignore_label), bool(use_ignore),
+                                float(smooth_alpha))
 
 
 register("smooth_l1")(

@@ -1,0 +1,13 @@
+"""Symbolic API (reference: ``python/mxnet/symbol/`` over nnvm
+[unverified])."""
+
+from .symbol import Symbol, Variable, var, Group, load, load_json
+from . import register as _register
+import sys as _sys
+
+from .. import ops as _ops  # ensure registry populated
+from ..ops import registry as _registry
+
+_register.populate_module(_sys.modules[__name__])
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]

@@ -1,0 +1,36 @@
+"""Generate ``mx.sym.*`` from the shared op registry (reference:
+``python/mxnet/symbol/register.py`` [unverified]) — one registry, every
+frontend (SURVEY.md §1 key fact)."""
+
+from __future__ import annotations
+
+from ..ops import registry as _registry
+from .symbol import Symbol
+
+
+def _make_sym_func(op):
+    def sym_func(*args, name=None, **kwargs):
+        inputs = [a for a in args if isinstance(a, Symbol)]
+        if len(inputs) != len(args):
+            raise TypeError(
+                f"sym.{op.name} expects Symbol inputs; got "
+                f"{[type(a).__name__ for a in args]}"
+            )
+        return Symbol(op.name, inputs, attrs=kwargs, name=name,
+                      num_outputs=op.num_outputs or 1)
+
+    sym_func.__name__ = op.name
+    sym_func.__doc__ = (op.fn.__doc__ or "") + "\n(symbolic variant)"
+    return sym_func
+
+
+def populate_module(module):
+    installed = []
+    for name in _registry.list_ops():
+        op = _registry.get(name)
+        fn = _make_sym_func(op)
+        setattr(module, name, fn)
+        installed.append(name)
+        for a in op.aliases:
+            setattr(module, a, fn)
+    return installed

@@ -1,0 +1,452 @@
+"""Symbol: a lazy operator DAG (reference: ``python/mxnet/symbol/symbol.py``
+over ``nnvm::Symbol`` [unverified]).
+
+TPU-native design (SURVEY.md §7 stance: "no dual IR" in the hot path): a
+Symbol is a thin recorded-call graph over the SAME op registry the
+imperative path uses. ``bind``/``simple_bind`` compile the whole graph with
+``jax.jit`` — the nnvm passes (InferShape via eval_shape, Gradient via
+jax.grad, PlanMemory via XLA's buffer assignment) all collapse into the XLA
+pipeline. This keeps the legacy Module/SymbolBlock API surface working
+without maintaining a second IR."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ops import registry as _registry
+
+__all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
+
+_UID = [0]
+
+
+def _next_name(hint):
+    _UID[0] += 1
+    return f"{hint}{_UID[0] - 1}"
+
+
+class Symbol:
+    """A node in the symbolic graph."""
+
+    def __init__(self, op: Optional[str], inputs: Sequence["Symbol"],
+                 attrs: Optional[dict] = None, name: Optional[str] = None,
+                 out_index: Optional[int] = None, num_outputs: int = 1):
+        self._op = op  # None for variables / groups
+        self._inputs = list(inputs)
+        self._attrs = dict(attrs or {})
+        self._name = name or (_next_name(op.lower()) if op else _next_name("sym"))
+        self._out_index = out_index
+        self._num_outputs = num_outputs
+
+    # ------------------------------------------------------------- metadata
+    @property
+    def name(self):
+        return self._name
+
+    def attr(self, key):
+        return self._attrs.get(key)
+
+    def list_attr(self):
+        return dict(self._attrs)
+
+    def _is_var(self):
+        return self._op is None and not self._inputs
+
+    def list_arguments(self) -> List[str]:
+        seen, order = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                walk(i)
+            if s._is_var():
+                order.append(s._name)
+
+        walk(self)
+        return order
+
+    def list_outputs(self) -> List[str]:
+        if self._op is None and self._inputs:  # group
+            out = []
+            for i in self._inputs:
+                out.extend(i.list_outputs())
+            return out
+        if self._num_outputs == 1:
+            return [self._name + "_output"]
+        return [f"{self._name}_output{i}" for i in range(self._num_outputs)]
+
+    def get_internals(self):
+        seen, nodes = set(), []
+
+        def walk(s):
+            if id(s) in seen:
+                return
+            seen.add(id(s))
+            for i in s._inputs:
+                walk(i)
+            nodes.append(s)
+
+        walk(self)
+        return Group(nodes)
+
+    def __getitem__(self, index):
+        if isinstance(index, str):
+            for s in self.get_internals()._inputs:
+                if s.list_outputs()[0] == index or s._name == index:
+                    return s
+            raise MXNetError(f"no output named {index}")
+        if self._op is None and self._inputs:  # group indexing
+            return self._inputs[index]
+        if self._num_outputs == 1:
+            if index != 0:
+                raise MXNetError("index out of range")
+            return self
+        return Symbol(self._op, self._inputs, self._attrs, self._name,
+                      out_index=index, num_outputs=self._num_outputs)
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+    def __iter__(self):
+        n = self._num_outputs if not (self._op is None and self._inputs) \
+            else len(self._inputs)
+        return (self[i] for i in range(n))
+
+    # ------------------------------------------------------------ evaluation
+    def _eval(self, values: Dict[str, jnp.ndarray], cache: Dict[int, object]):
+        if id(self) in cache:
+            out = cache[id(self)]
+        elif self._is_var():
+            if self._name not in values:
+                raise MXNetError(f"missing value for argument {self._name}")
+            out = values[self._name]
+            cache[id(self)] = out
+        elif self._op is None:  # group
+            out = tuple(i._eval(values, cache) for i in self._inputs)
+            cache[id(self)] = out
+        else:
+            op = _registry.get(self._op)
+            args = [i._eval(values, cache) for i in self._inputs]
+            out = op.fn(*args, **self._attrs)
+            cache[id(self)] = out
+        if self._out_index is not None:
+            return out[self._out_index]
+        return out
+
+    def eval(self, ctx=None, **kwargs):
+        """Evaluate eagerly from name->NDArray kwargs (reference API)."""
+        from ..ndarray.ndarray import NDArray
+
+        values = {
+            k: (v.data if isinstance(v, NDArray) else jnp.asarray(v))
+            for k, v in kwargs.items()
+        }
+        out = self._eval(values, {})
+        outs = out if isinstance(out, tuple) else (out,)
+        return [NDArray(o) for o in outs]
+
+    # ----------------------------------------------------------- shape/type
+    def infer_shape(self, **kwargs):
+        args = self.list_arguments()
+        known = {k: jnp.zeros(v, jnp.float32) if isinstance(v, tuple) else v
+                 for k, v in kwargs.items()}
+
+        def run(vals):
+            return self._eval(vals, {})
+
+        try:
+            out = jax.eval_shape(run, {
+                k: jax.ShapeDtypeStruct(tuple(v.shape), v.dtype)
+                if hasattr(v, "shape") else v
+                for k, v in known.items()
+            })
+        except Exception as e:
+            raise MXNetError(f"shape inference failed: {e}") from e
+        outs = out if isinstance(out, tuple) else (out,)
+        arg_shapes = [tuple(known[a].shape) if a in known else None
+                      for a in args]
+        return arg_shapes, [tuple(o.shape) for o in outs], []
+
+    def infer_type(self, **kwargs):
+        args = self.list_arguments()
+        return ([kwargs.get(a) for a in args], [_np.float32], [])
+
+    def _infer_all_shapes(self, known: Dict[str, tuple]) -> Dict[str, tuple]:
+        """Forward shape propagation filling in parameter-variable shapes
+        (the nnvm InferShape role): walk topologically; unshaped variable
+        inputs of parameterized ops get shapes from `_PARAM_SHAPE_RULES`;
+        each op's output shape comes from jax.eval_shape of its kernel."""
+        shapes = dict(known)
+        node_out: Dict[int, object] = {}
+
+        def out_shape(s):
+            if id(s) in node_out:
+                return node_out[id(s)]
+            if isinstance(s, _Const):
+                res = jax.ShapeDtypeStruct(tuple(s._value.shape),
+                                           s._value.dtype)
+            elif s._is_var():
+                if s._name not in shapes:
+                    raise MXNetError(
+                        f"cannot infer shape of variable {s._name}; provide "
+                        "it to simple_bind"
+                    )
+                res = jax.ShapeDtypeStruct(tuple(shapes[s._name]), _np.float32)
+            elif s._op is None:  # group
+                res = tuple(out_shape(i) for i in s._inputs)
+            else:
+                in_specs = []
+                rule = _PARAM_SHAPE_RULES.get(s._op)
+                first = out_shape(s._inputs[0]) if s._inputs else None
+                for pos, inp in enumerate(s._inputs):
+                    if (inp._is_var() and inp._name not in shapes
+                            and rule is not None and pos > 0):
+                        inferred = rule(pos, tuple(first.shape), s._attrs)
+                        if inferred is None:
+                            raise MXNetError(
+                                f"cannot infer shape of {inp._name} "
+                                f"(input {pos} of {s._op})"
+                            )
+                        shapes[inp._name] = inferred
+                    in_specs.append(out_shape(inp))
+                op = _registry.get(s._op)
+                try:
+                    res = jax.eval_shape(
+                        lambda *a: op.fn(*a, **s._attrs), *in_specs
+                    )
+                except Exception as e:
+                    raise MXNetError(
+                        f"shape inference through {s._op} failed: {e}"
+                    ) from e
+            node_out[id(s)] = res
+            return res
+
+        out_shape(self)
+        return shapes
+
+    # ------------------------------------------------------------- binding
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from ..executor import Executor
+
+        return Executor(self, ctx, shapes, grad_req)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, **kwargs):
+        from ..executor import Executor
+
+        return Executor(self, ctx, None, grad_req, args=args,
+                        args_grad=args_grad)
+
+    # ---------------------------------------------------------- arithmetic
+    def _binop(self, other, opname, reverse=False):
+        if not isinstance(other, Symbol):
+            other = _Const(other)
+        a, b = (other, self) if reverse else (self, other)
+        return Symbol(opname, [a, b])
+
+    def __add__(self, other):
+        return self._binop(other, "broadcast_add")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._binop(other, "broadcast_sub")
+
+    def __rsub__(self, other):
+        return self._binop(other, "broadcast_sub", reverse=True)
+
+    def __mul__(self, other):
+        return self._binop(other, "broadcast_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._binop(other, "broadcast_div")
+
+    def __rtruediv__(self, other):
+        return self._binop(other, "broadcast_div", reverse=True)
+
+    def __pow__(self, other):
+        return self._binop(other, "broadcast_power")
+
+    def __neg__(self):
+        return Symbol("negative", [self])
+
+    # ------------------------------------------------------------- serialize
+    def tojson(self):
+        nodes = []
+        index = {}
+
+        def walk(s):
+            if id(s) in index:
+                return index[id(s)]
+            inputs = [walk(i) for i in s._inputs]
+            idx = len(nodes)
+            nodes.append({
+                "op": s._op or "null",
+                "name": s._name,
+                "attrs": {k: str(v) for k, v in s._attrs.items()},
+                "inputs": [[i, 0, 0] for i in inputs],
+            })
+            index[id(s)] = idx
+            return idx
+
+        walk(self)
+        return json.dumps(
+            {"nodes": nodes, "heads": [[len(nodes) - 1, 0, 0]],
+             "mxnet_tpu_version": 1},
+            indent=2,
+        )
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+
+class _Const(Symbol):
+    def __init__(self, value):
+        super().__init__(None, [], name=_next_name("const"))
+        self._value = jnp.asarray(value)
+
+    def _is_var(self):
+        return False
+
+    def _eval(self, values, cache):
+        return self._value
+
+    def list_arguments(self):
+        return []
+
+
+def Variable(name, attr=None, shape=None, dtype=None, init=None, **kwargs):
+    s = Symbol(None, [], attrs=attr, name=name)
+    if shape is not None:
+        s._attrs["__shape__"] = shape
+    return s
+
+
+var = Variable
+
+
+def Group(symbols):
+    return Symbol(None, list(symbols), name=_next_name("group"))
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    nodes = data["nodes"]
+    built = []
+    for node in nodes:
+        if node["op"] == "null":
+            built.append(Variable(node["name"]))
+        else:
+            inputs = [built[i[0]] for i in node["inputs"]]
+            attrs = {k: _parse_attr(v) for k, v in node.get("attrs", {}).items()}
+            built.append(Symbol(node["op"], inputs, attrs, node["name"]))
+    head = data["heads"][0][0]
+    return built[head]
+
+
+def _parse_attr(v):
+    try:
+        return json.loads(v)
+    except (ValueError, TypeError):
+        try:
+            import ast
+
+            return ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            return v
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def _prod(xs):
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def _tup(v, n):
+    if v is None:
+        return (1,) * n
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in v)
+    return (int(v),) * n
+
+
+def _fc_rule(pos, data_shape, attrs):
+    nh = int(attrs["num_hidden"])
+    flatten = attrs.get("flatten", True)
+    in_units = _prod(data_shape[1:]) if flatten else int(data_shape[-1])
+    if pos == 1:
+        return (nh, in_units)
+    if pos == 2:
+        return (nh,)
+    return None
+
+
+def _conv_rule(pos, data_shape, attrs):
+    nf = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    nd_sp = len(data_shape) - 2
+    kernel = _tup(attrs.get("kernel"), nd_sp)
+    if pos == 1:
+        return (nf, int(data_shape[1]) // groups) + kernel
+    if pos == 2:
+        return (nf,)
+    return None
+
+
+def _deconv_rule(pos, data_shape, attrs):
+    nf = int(attrs["num_filter"])
+    groups = int(attrs.get("num_group", 1))
+    nd_sp = len(data_shape) - 2
+    kernel = _tup(attrs.get("kernel"), nd_sp)
+    if pos == 1:
+        return (int(data_shape[1]), nf // groups) + kernel
+    if pos == 2:
+        return (nf,)
+    return None
+
+
+def _bn_rule(pos, data_shape, attrs):
+    axis = int(attrs.get("axis", 1))
+    return (int(data_shape[axis]),)
+
+
+def _ln_rule(pos, data_shape, attrs):
+    axis = int(attrs.get("axis", -1))
+    return (int(data_shape[axis]),)
+
+
+def _embed_rule(pos, data_shape, attrs):
+    if pos == 1:
+        return (int(attrs["input_dim"]), int(attrs["output_dim"]))
+    return None
+
+
+# pos -> expected shape given the first input's shape and op attrs
+# (reference: per-op FInferShape attrs on the nnvm registry [unverified])
+_PARAM_SHAPE_RULES = {
+    "FullyConnected": _fc_rule,
+    "Convolution": _conv_rule,
+    "Deconvolution": _deconv_rule,
+    "BatchNorm": _bn_rule,
+    "InstanceNorm": _bn_rule,
+    "GroupNorm": _bn_rule,
+    "LayerNorm": _ln_rule,
+    "Embedding": _embed_rule,
+}
