@@ -674,15 +674,6 @@ class HybridBlock(Block):
             "param_names": [n for n, _ in self._cached_op._collect()],
             "class": type(self).__name__,
         }
-        # serialize the compiled program when jax.export is present
-        try:
-            from jax import export as jax_export
-
-            (training, in_treedef), holder = next(iter(self._cached_op._staged.items()))
-            meta["stablehlo"] = f"{path}-symbol.mlir"
-            # re-export on example avals is done lazily by SymbolBlock
-        except ImportError:
-            pass
         with open(f"{path}-symbol.json", "w") as f:
             json.dump(meta, f, indent=2)
         return f"{path}-symbol.json", params_file

@@ -19,10 +19,12 @@ Stateful pieces of the reference are made functional:
 
 from __future__ import annotations
 
+import functools
 from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .registry import register, alias
 
@@ -103,7 +105,12 @@ def _softmax_output_bwd(grad_scale, ignore_label, use_ignore, smooth_alpha,
     if use_ignore:
         mask = (label.astype(jnp.int32) != int(ignore_label)).astype(prob.dtype)
         grad = grad * mask[..., None]
-    return grad, jnp.zeros_like(label)
+    if jnp.issubdtype(label.dtype, jnp.floating):
+        label_ct = jnp.zeros_like(label)
+    else:
+        # integer primals require a float0 cotangent under custom_vjp
+        label_ct = np.zeros(label.shape, jax.dtypes.float0)
+    return grad, label_ct
 
 
 _softmax_output_core.defvjp(_softmax_output_fwd, _softmax_output_bwd)

@@ -127,6 +127,8 @@ def rmspropalex_update(weight, grad, n, g_state, delta, lr=0.001, gamma1=0.95,
                        clip_gradient=-1.0, clip_weights=-1.0, **kw):
     g = _apply_wd_rescale(weight, grad, wd, rescale_grad,
                           clip_gradient if clip_gradient >= 0 else None)
+    # Graves 2013 / reference rmspropalex_update: BOTH accumulators decay
+    # with gamma1; gamma2 is only the momentum on delta
     new_n = gamma1 * n + (1 - gamma1) * jnp.square(g)
     new_g = gamma1 * g_state + (1 - gamma1) * g
     new_delta = gamma2 * delta - lr * g / jnp.sqrt(new_n - jnp.square(new_g) + epsilon)

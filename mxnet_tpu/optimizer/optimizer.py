@@ -59,10 +59,13 @@ def _jit_update(fn, static_hypers):
     hypers = dict(static_hypers)
 
     # donate weight + states (rebound after the call); grad is NOT donated —
-    # grad_req='add' accumulators are read again by the next backward
+    # grad_req='add' accumulators are read again by the next backward.
+    # rescale_grad is a dynamic operand: AMP loss scaling and batch-size
+    # changes fold into it every step and must not trigger a retrace.
     @functools.partial(jax.jit, donate_argnums=(0, 2))
-    def step(weight, grad, states, lr, wd):
-        out = fn(weight, grad, *states, lr=lr, wd=wd, **hypers)
+    def step(weight, grad, states, lr, wd, rescale_grad):
+        out = fn(weight, grad, *states, lr=lr, wd=wd,
+                 rescale_grad=rescale_grad, **hypers)
         return out if isinstance(out, tuple) else (out,)
 
     return step
@@ -206,7 +209,7 @@ class Optimizer:
     def _apply(self, fn, weight, grad, states, lr, wd, **static_hypers):
         """Run a pure fused-update op and rebind weight/states in place."""
         hypers = dict(static_hypers)
-        hypers.setdefault("rescale_grad", float(self.rescale_grad))
+        rescale = float(hypers.pop("rescale_grad", self.rescale_grad))
         hypers.setdefault(
             "clip_gradient",
             float(self.clip_gradient) if self.clip_gradient is not None else -1.0,
@@ -219,6 +222,7 @@ class Optimizer:
             tuple(s.data for s in state_list),
             jnp.float32(lr),
             jnp.float32(wd),
+            jnp.float32(rescale),
         )
         weight._rebind(outs[0])
         for s, new in zip(state_list, outs[1:]):

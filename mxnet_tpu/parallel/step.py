@@ -223,7 +223,6 @@ class TrainStep:
         train_names = set(self._train_names)
         name2param = {n: p for n, p in params}
         pure_update = self._pure_update
-        rescale = float(self._optimizer.rescale_grad)
         accum = self._accum
         # static per-param hyper multipliers
         lr_mult = {n: name2param[n].lr_mult for n in train_names}
@@ -254,8 +253,10 @@ class TrainStep:
             aux = {name2param_inv[id(p)]: v for p, v in sink.items()}
             return Lm, aux
 
+        # rescale_grad is a dynamic operand: AMP dynamic loss scaling and
+        # batch-size changes fold into it per step and must not retrace
         def step(train_vals, frozen_vals, opt_state, batch, label, key,
-                 lr, t):
+                 lr, t, rescale):
             # batch: tuple of arrays; with accum > 1 each has a leading
             # microbatch dim of size `accum` scanned by lax.scan
             if accum == 1:
@@ -328,6 +329,7 @@ class TrainStep:
         L, new_vals, self._opt_state, aux = self._step_fn(
             train_vals, frozen_vals, self._opt_state, tuple(batch), label,
             key, jnp.float32(lr), jnp.int32(self._t),
+            jnp.float32(self._optimizer.rescale_grad),
         )
         self._values.update(new_vals)
         for n, v in aux.items():

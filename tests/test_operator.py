@@ -245,3 +245,35 @@ class TestRegressions:
                           spatial_scale=1.0)
         assert out.shape == (1, 1, 2, 2)
         assert_close(out, np.ones((1, 1, 2, 2)), rtol=1e-4)
+
+
+def test_softmax_output_int_label_vjp():
+    # integer labels must yield a float0 cotangent, not a TypeError
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(3)
+    data = mx.nd.array(rng.randn(4, 5).astype("float32"))
+    label = mx.nd.array(rng.randint(0, 5, (4,)), dtype="int32")
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.SoftmaxOutput(data, label, grad_scale=2.0)
+    out.backward()
+    prob = np.exp(data.asnumpy()) / np.exp(data.asnumpy()).sum(-1, keepdims=True)
+    onehot = np.eye(5, dtype="float32")[label.asnumpy().astype(int)]
+    np.testing.assert_allclose(data.grad.asnumpy(), 2.0 * (prob - onehot),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_output_ignore_label():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    rng = np.random.RandomState(4)
+    data = mx.nd.array(rng.randn(4, 5).astype("float32"))
+    label = mx.nd.array(np.array([0, 1, -1, 2]), dtype="int32")
+    data.attach_grad()
+    with autograd.record():
+        out = mx.nd.SoftmaxOutput(data, label, use_ignore=True, ignore_label=-1)
+    out.backward()
+    g = data.grad.asnumpy()
+    assert np.allclose(g[2], 0.0)
+    assert not np.allclose(g[0], 0.0)

@@ -209,3 +209,40 @@ def test_multi_precision_fp16():
     assert state[1].dtype == np.float32  # master copy
     sgd.update_multi_precision(0, weight, grad, state)
     assert weight.dtype == np.float16
+
+
+def test_rmsprop_centered_gamma1_neq_gamma2():
+    # Graves 2013 / reference rmspropalex_update: BOTH n and the mean
+    # accumulator g decay with gamma1; gamma2 is only delta's momentum
+    w, g = _setup()
+    rms = opt.RMSProp(learning_rate=0.01, gamma1=0.95, gamma2=0.8,
+                      centered=True)
+    weight, grad = nd(w), nd(g)
+    state = rms.create_state(0, weight)
+    n = np.zeros_like(w)
+    gm = np.zeros_like(w)
+    delta = np.zeros_like(w)
+    for _ in range(3):
+        rms.update(0, weight, grad, state)
+        n = 0.95 * n + 0.05 * g * g
+        gm = 0.95 * gm + 0.05 * g
+        delta = 0.8 * delta - 0.01 * g / np.sqrt(n - gm * gm + 1e-8)
+        w = w + delta
+    assert np.isfinite(w).all()  # n - gm^2 >= 0 by Cauchy-Schwarz here
+    np.testing.assert_allclose(weight.asnumpy(), w, rtol=1e-4)
+
+
+def test_rescale_grad_change_no_retrace_semantics():
+    # AMP folds 1/loss_scale into rescale_grad every scale change; the
+    # update must honor the new value (dynamic operand, not baked static)
+    w, g = _setup()
+    sgd = opt.SGD(learning_rate=1.0, momentum=0.0)
+    weight, grad = nd(w), nd(g)
+    sgd.rescale_grad = 0.5
+    sgd.update(0, weight, grad, None)
+    expected = w - 0.5 * g
+    np.testing.assert_allclose(weight.asnumpy(), expected, rtol=1e-6)
+    w2 = weight.asnumpy().copy()
+    sgd.rescale_grad = 0.25
+    sgd.update(0, weight, grad, None)
+    np.testing.assert_allclose(weight.asnumpy(), w2 - 0.25 * g, rtol=1e-6)
