@@ -645,8 +645,7 @@ class HybridBlock(Block):
         """Serialize the staged program + params for deployment (reference:
         ``HybridBlock.export`` -> model-symbol.json + model-0000.params).
 
-        Writes ``{path}-symbol.json`` (graph metadata incl. serialized
-        StableHLO when jax.export is available) and
+        Writes ``{path}-symbol.json`` (graph metadata manifest) and
         ``{path}-{epoch:04d}.params``."""
         if not self._active or self._cached_op is None or not self._cached_op._staged:
             raise MXNetError(

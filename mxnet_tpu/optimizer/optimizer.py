@@ -55,7 +55,9 @@ __all__ = [
 
 @functools.lru_cache(maxsize=None)
 def _jit_update(fn, static_hypers):
-    """Jitted wrapper: dynamic (weight, grad, states, lr, wd), static rest."""
+    """Jitted wrapper: dynamic (weight, grad, states, lr, wd, rescale_grad),
+    static rest. rescale_grad must stay dynamic — AMP loss scaling folds a
+    new value in per scale change and must not retrace."""
     hypers = dict(static_hypers)
 
     # donate weight + states (rebound after the call); grad is NOT donated —

@@ -290,19 +290,31 @@ class Symbol:
                 return index[id(s)]
             inputs = [walk(i) for i in s._inputs]
             idx = len(nodes)
+            attrs = {k: str(v) for k, v in s._attrs.items()}
+            if isinstance(s, _Const):
+                # literal operands (sym * 2.0) must round-trip through JSON
+                attrs["__const_value__"] = json.dumps(
+                    _np.asarray(s._value).tolist()
+                )
+                attrs["__const_dtype__"] = str(s._value.dtype)
             nodes.append({
                 "op": s._op or "null",
                 "name": s._name,
-                "attrs": {k: str(v) for k, v in s._attrs.items()},
+                "attrs": attrs,
                 "inputs": [[i, 0, 0] for i in inputs],
             })
             index[id(s)] = idx
             return idx
 
-        walk(self)
+        # a Group serializes as one head per member (the reference's
+        # multi-output heads list), not as a node of its own
+        if self._op is None and self._inputs:
+            heads = [[walk(i), 0, 0] for i in self._inputs]
+        else:
+            walk(self)
+            heads = [[len(nodes) - 1, 0, 0]]
         return json.dumps(
-            {"nodes": nodes, "heads": [[len(nodes) - 1, 0, 0]],
-             "mxnet_tpu_version": 1},
+            {"nodes": nodes, "heads": heads, "mxnet_tpu_version": 1},
             indent=2,
         )
 
@@ -346,13 +358,26 @@ def load_json(json_str):
     built = []
     for node in nodes:
         if node["op"] == "null":
-            built.append(Variable(node["name"]))
+            attrs = node.get("attrs", {})
+            if "__const_value__" in attrs:
+                c = _Const(_np.asarray(
+                    json.loads(attrs["__const_value__"]),
+                    dtype=attrs.get("__const_dtype__", "float32"),
+                ))
+                c._name = node["name"]
+                built.append(c)
+            else:
+                v = Variable(node["name"])
+                v._attrs = {k: _parse_attr(a) for k, a in attrs.items()}
+                built.append(v)
         else:
             inputs = [built[i[0]] for i in node["inputs"]]
             attrs = {k: _parse_attr(v) for k, v in node.get("attrs", {}).items()}
             built.append(Symbol(node["op"], inputs, attrs, node["name"]))
-    head = data["heads"][0][0]
-    return built[head]
+    heads = data["heads"]
+    if len(heads) > 1:
+        return Group([built[h[0]] for h in heads])
+    return built[heads[0][0]]
 
 
 def _parse_attr(v):

@@ -1,0 +1,470 @@
+"""Symbol / Executor / Module surface tests.
+
+Models the reference's ``tests/python/unittest/test_symbol.py``,
+``test_executor.py`` and ``test_module.py`` [unverified]: graph
+construction + serialization round-trip, InferShape (incl. parameter-shape
+rules), Executor forward/backward under each grad_req, and the legacy
+``Module.fit`` loop training a LeNet end-to-end on synthetic MNIST-shaped
+data to a decreasing loss.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd, sym
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.io import DataBatch, NDArrayIter
+from mxnet_tpu.module import BucketingModule, Module
+
+
+def _softmax_np(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+# ===================================================================== Symbol
+def test_variable_and_list_arguments_order():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b * a
+    assert c.list_arguments() == ["a", "b"]
+
+
+def test_symbol_arithmetic_eval():
+    a, b = sym.var("a"), sym.var("b")
+    expr = (a + b) * a - b / a + 2.0 - (1.0 - a)
+    av = np.array([1.0, 2.0, 4.0], np.float32)
+    bv = np.array([2.0, 3.0, 8.0], np.float32)
+    (out,) = expr.eval(a=nd.array(av), b=nd.array(bv))
+    expected = (av + bv) * av - bv / av + 2.0 - (1.0 - av)
+    np.testing.assert_allclose(out.asnumpy(), expected, rtol=1e-6)
+
+
+def test_symbol_neg_pow():
+    a = sym.var("a")
+    expr = -(a ** 2.0)
+    (out,) = expr.eval(a=nd.array(np.array([2.0, 3.0], np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), [-4.0, -9.0], rtol=1e-6)
+
+
+def test_symbol_op_namespace_eval():
+    x = sym.var("x")
+    y = sym.relu(x)
+    (out,) = y.eval(x=nd.array(np.array([-1.0, 0.5], np.float32)))
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 0.5])
+
+
+def test_symbol_rejects_non_symbol_positional():
+    x = nd.zeros((2, 2))
+    with pytest.raises(TypeError):
+        sym.relu(x)
+
+
+def test_symbol_attrs_and_name():
+    x = sym.var("x")
+    fc = sym.FullyConnected(x, sym.var("w"), sym.var("b"),
+                            num_hidden=7, name="fc1")
+    assert fc.name == "fc1"
+    assert fc.attr("num_hidden") == 7
+    assert fc.list_attr()["num_hidden"] == 7
+
+
+def test_symbol_getitem_errors():
+    x = sym.var("x")
+    y = sym.relu(x)
+    assert y[0] is y
+    with pytest.raises(MXNetError):
+        y[1]
+    with pytest.raises(MXNetError):
+        y["nonexistent_output"]
+
+
+def test_group_outputs_and_iter():
+    a, b = sym.var("a"), sym.var("b")
+    g = sym.Group([a + b, a * b])
+    outs = g.list_outputs()
+    assert len(outs) == 2
+    av = nd.array(np.array([2.0], np.float32))
+    bv = nd.array(np.array([3.0], np.float32))
+    r = g.eval(a=av, b=bv)
+    np.testing.assert_allclose(r[0].asnumpy(), [5.0])
+    np.testing.assert_allclose(r[1].asnumpy(), [6.0])
+    parts = list(g)
+    assert len(parts) == 2
+
+
+def test_get_internals_contains_all_nodes():
+    x = sym.var("x")
+    y = sym.relu(x + 1.0)
+    names = [s.name for s in y.get_internals()._inputs]
+    assert "x" in names and y.name in names
+
+
+def test_infer_shape_simple():
+    a = sym.var("a")
+    b = sym.var("b")
+    c = a + b
+    arg_shapes, out_shapes, aux = c.infer_shape(a=(2, 3), b=(2, 3))
+    assert out_shapes == [(2, 3)]
+    assert arg_shapes == [(2, 3), (2, 3)]
+
+
+def test_infer_shape_broadcasting():
+    a, b = sym.var("a"), sym.var("b")
+    _, out_shapes, _ = (a + b).infer_shape(a=(4, 1), b=(1, 5))
+    assert out_shapes == [(4, 5)]
+
+
+def test_infer_shape_failure_raises_mxneterror():
+    a, b = sym.var("a"), sym.var("b")
+    with pytest.raises(MXNetError):
+        sym.dot(a, b).infer_shape(a=(2, 3), b=(2, 3))  # inner dims mismatch
+
+
+def test_tojson_load_json_round_trip():
+    x = sym.var("x")
+    w = sym.var("w")
+    b = sym.var("b")
+    net = sym.Activation(
+        sym.FullyConnected(x, w, b, num_hidden=3), act_type="tanh"
+    )
+    js = net.tojson()
+    assert json.loads(js)["nodes"]  # valid JSON with nodes
+    net2 = sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    rng = np.random.RandomState(0)
+    vals = {
+        "x": nd.array(rng.randn(2, 5).astype(np.float32)),
+        "w": nd.array(rng.randn(3, 5).astype(np.float32)),
+        "b": nd.array(rng.randn(3).astype(np.float32)),
+    }
+    (o1,) = net.eval(**vals)
+    (o2,) = net2.eval(**vals)
+    np.testing.assert_allclose(o1.asnumpy(), o2.asnumpy(), rtol=1e-6)
+
+
+def test_symbol_save_load_file(tmp_path):
+    x = sym.var("x")
+    y = sym.relu(x * 2.0)
+    f = str(tmp_path / "net-symbol.json")
+    y.save(f)
+    y2 = sym.load(f)
+    v = nd.array(np.array([-2.0, 3.0], np.float32))
+    np.testing.assert_allclose(
+        y.eval(x=v)[0].asnumpy(), y2.eval(x=v)[0].asnumpy()
+    )
+
+
+# =================================================================== Executor
+def test_simple_bind_explicit_shapes_forward():
+    a, b = sym.var("a"), sym.var("b")
+    ex = (a * b).simple_bind(a=(2, 2), b=(2, 2))
+    ex.arg_dict["a"]._rebind(nd.ones((2, 2)).data * 3)
+    ex.arg_dict["b"]._rebind(nd.ones((2, 2)).data * 4)
+    (out,) = ex.forward()
+    np.testing.assert_allclose(out.asnumpy(), 12 * np.ones((2, 2)))
+
+
+def test_simple_bind_infers_param_shapes():
+    x = sym.var("data")
+    net = sym.FullyConnected(x, sym.var("fc_weight"), sym.var("fc_bias"), num_hidden=6)
+    ex = net.simple_bind(data=(4, 10))
+    assert ex.arg_dict["fc_weight"].shape == (6, 10)
+    assert ex.arg_dict["fc_bias"].shape == (6,)
+    (out,) = ex.forward()
+    assert out.shape == (4, 6)
+
+
+def test_simple_bind_conv_param_shapes():
+    x = sym.var("data")
+    net = sym.Convolution(x, sym.var("w"), sym.var("b"), num_filter=8,
+                          kernel=(3, 3), pad=(1, 1))
+    ex = net.simple_bind(data=(2, 3, 16, 16))
+    assert ex.arg_dict["w"].shape == (8, 3, 3, 3)
+    assert ex.arg_dict["b"].shape == (8,)
+    (out,) = ex.forward()
+    assert out.shape == (2, 8, 16, 16)
+
+
+def test_simple_bind_missing_shape_raises():
+    a, b = sym.var("a"), sym.var("b")
+    with pytest.raises(MXNetError):
+        (a + b).simple_bind(a=(2, 2))  # b not inferable for broadcast_add
+
+
+def test_executor_backward_matches_analytic():
+    a, b = sym.var("a"), sym.var("b")
+    ex = (a * b).simple_bind(a=(3,), b=(3,))
+    av = np.array([1.0, 2.0, 3.0], np.float32)
+    bv = np.array([4.0, 5.0, 6.0], np.float32)
+    ex.forward(is_train=True, a=nd.array(av), b=nd.array(bv))
+    ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), bv)
+    np.testing.assert_allclose(ex.grad_dict["b"].asnumpy(), av)
+
+
+def test_executor_backward_out_grads():
+    a = sym.var("a")
+    ex = (a * 2.0).simple_bind(a=(3,))
+    av = np.array([1.0, 2.0, 3.0], np.float32)
+    g = np.array([1.0, 10.0, 100.0], np.float32)
+    ex.forward(is_train=True, a=nd.array(av))
+    ex.backward(nd.array(g))
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), 2.0 * g)
+
+
+def test_executor_grad_req_add_accumulates():
+    a = sym.var("a")
+    ex = (a * 3.0).simple_bind(a=(2,), grad_req="add")
+    av = nd.array(np.array([1.0, 1.0], np.float32))
+    for _ in range(2):
+        ex.forward(is_train=True, a=av)
+        ex.backward()
+    np.testing.assert_allclose(ex.grad_dict["a"].asnumpy(), [6.0, 6.0])
+
+
+def test_executor_grad_req_null_no_grads():
+    a = sym.var("a")
+    ex = (a * 3.0).simple_bind(a=(2,), grad_req="null")
+    assert ex.grad_dict == {}
+
+
+def test_executor_backward_without_train_forward_raises():
+    a = sym.var("a")
+    ex = (a * 3.0).simple_bind(a=(2,))
+    ex.forward(is_train=False, a=nd.ones((2,)))
+    with pytest.raises(MXNetError):
+        ex.backward()
+
+
+def test_executor_softmax_output_backward():
+    """The legacy loss-layer: backward emits softmax - onehot regardless of
+    the incoming cotangent (reference SoftmaxOutput semantics)."""
+    data = sym.var("data")
+    label = sym.var("softmax_label")
+    net = sym.SoftmaxOutput(data, label, name="softmax")
+    ex = net.simple_bind(data=(4, 5), softmax_label=(4,))
+    rng = np.random.RandomState(0)
+    dv = rng.randn(4, 5).astype(np.float32)
+    lv = rng.randint(0, 5, (4,)).astype(np.float32)
+    ex.forward(is_train=True, data=nd.array(dv), softmax_label=nd.array(lv))
+    prob = _softmax_np(dv)
+    np.testing.assert_allclose(ex.outputs[0].asnumpy(), prob, rtol=1e-5)
+    ex.backward()
+    onehot = np.eye(5, dtype=np.float32)[lv.astype(int)]
+    np.testing.assert_allclose(
+        ex.grad_dict["data"].asnumpy(), prob - onehot, rtol=1e-5, atol=1e-6
+    )
+
+
+def test_executor_copy_params_from():
+    a, b = sym.var("a"), sym.var("b")
+    ex = (a + b).simple_bind(a=(2,), b=(2,))
+    ex.copy_params_from({"a": nd.ones((2,)) * 5})
+    np.testing.assert_allclose(ex.arg_dict["a"].asnumpy(), [5.0, 5.0])
+    with pytest.raises(MXNetError):
+        ex.copy_params_from({"zzz": nd.ones((2,))})
+    ex.copy_params_from({"zzz": nd.ones((2,))}, allow_extra_params=True)
+
+
+def test_executor_reshape():
+    x = sym.var("data")
+    net = sym.FullyConnected(x, sym.var("w"), sym.var("b"), num_hidden=3)
+    ex = net.simple_bind(data=(4, 6))
+    ex2 = ex.reshape(data=(8, 6))
+    assert ex2.arg_dict["data"].shape == (8, 6)
+    assert ex2.arg_dict["w"].shape == (3, 6)
+    (out,) = ex2.forward()
+    assert out.shape == (8, 3)
+
+
+def test_bind_with_explicit_args():
+    a, b = sym.var("a"), sym.var("b")
+    av = nd.array(np.array([1.0, 2.0], np.float32))
+    bv = nd.array(np.array([3.0, 4.0], np.float32))
+    ex = (a * b).bind(args={"a": av, "b": bv})
+    (out,) = ex.forward()
+    np.testing.assert_allclose(out.asnumpy(), [3.0, 8.0])
+
+
+# ===================================================================== Module
+def _lenet_symbol():
+    data = sym.var("data")
+    c1 = sym.Convolution(data, sym.var("c1_weight"), sym.var("c1_bias"),
+                         num_filter=8, kernel=(3, 3), name="c1")
+    a1 = sym.Activation(c1, act_type="tanh")
+    p1 = sym.Pooling(a1, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    c2 = sym.Convolution(p1, sym.var("c2_weight"), sym.var("c2_bias"),
+                         num_filter=16, kernel=(3, 3), name="c2")
+    a2 = sym.Activation(c2, act_type="tanh")
+    p2 = sym.Pooling(a2, pool_type="max", kernel=(2, 2), stride=(2, 2))
+    fl = sym.Flatten(p2)
+    f1 = sym.FullyConnected(fl, sym.var("f1_weight"), sym.var("f1_bias"),
+                            num_hidden=32, name="f1")
+    a3 = sym.Activation(f1, act_type="tanh")
+    f2 = sym.FullyConnected(a3, sym.var("f2_weight"), sym.var("f2_bias"),
+                            num_hidden=10, name="f2")
+    return sym.SoftmaxOutput(f2, sym.var("softmax_label"), name="softmax")
+
+
+def _synthetic_mnist(n=64, seed=0):
+    """Class-dependent blob patterns: learnable by LeNet in a few steps."""
+    rng = np.random.RandomState(seed)
+    y = rng.randint(0, 10, n)
+    x = rng.randn(n, 1, 16, 16).astype(np.float32) * 0.1
+    for i, yi in enumerate(y):
+        r, c = divmod(yi, 4)
+        x[i, 0, 3 * r:3 * r + 4, 3 * c:3 * c + 4] += 1.0
+    return x, y.astype(np.float32)
+
+
+def test_module_bind_init_forward():
+    net = _lenet_symbol()
+    mod = Module(net)
+    mod.bind(data_shapes=[("data", (4, 1, 16, 16))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    x, y = _synthetic_mnist(4)
+    mod.forward(DataBatch([nd.array(x)], [nd.array(y)]), is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.asnumpy().sum(-1), np.ones(4), rtol=1e-5)
+
+
+def test_module_fit_lenet_loss_decreases():
+    x, y = _synthetic_mnist(64)
+    it = NDArrayIter(x, y, batch_size=16, shuffle=True)
+    mod = Module(_lenet_symbol())
+    # SoftmaxOutput grads are unnormalized batch sums (reference
+    # normalization='null' default), so lr is scaled down accordingly
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.03), ("momentum", 0.9)),
+            initializer=mx.initializer.Xavier())
+    score = mod.score(NDArrayIter(x, y, batch_size=16), "acc")
+    acc = dict(score)["accuracy"]
+    assert acc > 0.5, f"LeNet did not learn synthetic blobs: acc={acc}"
+
+
+def test_module_manual_loop_updates_params():
+    x, y = _synthetic_mnist(16)
+    mod = Module(_lenet_symbol())
+    mod.bind(data_shapes=[("data", (16, 1, 16, 16))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.1),))
+    w_before = mod._exec.arg_dict["f2_weight"].asnumpy().copy()
+    batch = DataBatch([nd.array(x)], [nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    assert not np.allclose(mod._exec.arg_dict["f2_weight"].asnumpy(), w_before)
+
+
+def test_module_fixed_params_not_updated():
+    x, y = _synthetic_mnist(16)
+    mod = Module(_lenet_symbol(), fixed_param_names=["f2_weight"])
+    mod.bind(data_shapes=[("data", (16, 1, 16, 16))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    mod.init_optimizer()
+    w_before = mod._exec.arg_dict["f2_weight"].asnumpy().copy()
+    batch = DataBatch([nd.array(x)], [nd.array(y)])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    mod.update()
+    np.testing.assert_allclose(mod._exec.arg_dict["f2_weight"].asnumpy(), w_before)
+
+
+def test_module_predict_merges_batches():
+    x, y = _synthetic_mnist(32)
+    mod = Module(_lenet_symbol())
+    mod.bind(data_shapes=[("data", (8, 1, 16, 16))],
+             label_shapes=[("softmax_label", (8,))])
+    mod.init_params()
+    preds = mod.predict(NDArrayIter(x, y, batch_size=8))
+    assert preds.shape == (32, 10)
+
+
+def test_module_save_load_checkpoint(tmp_path):
+    x, y = _synthetic_mnist(16)
+    prefix = str(tmp_path / "lenet")
+    mod = Module(_lenet_symbol())
+    mod.bind(data_shapes=[("data", (16, 1, 16, 16))],
+             label_shapes=[("softmax_label", (16,))])
+    mod.init_params()
+    batch = DataBatch([nd.array(x)], [nd.array(y)])
+    mod.forward(batch, is_train=False)
+    ref_out = mod.get_outputs()[0].asnumpy()
+    mod.save_checkpoint(prefix, 3)
+
+    symbol, arg_params, aux_params = Module.load_checkpoint(prefix, 3)
+    mod2 = Module(symbol)
+    mod2.bind(data_shapes=[("data", (16, 1, 16, 16))],
+              label_shapes=[("softmax_label", (16,))])
+    mod2.init_params(arg_params=arg_params, aux_params=aux_params)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(
+        mod2.get_outputs()[0].asnumpy(), ref_out, rtol=1e-5, atol=1e-6
+    )
+
+
+def _bucket_sym_gen(seq_len):
+    """Mean-pooled embedding classifier over variable-length sequences."""
+    data = sym.var("data")
+    emb = sym.Embedding(data, sym.var("emb_weight"), input_dim=20, output_dim=8,
+                        name="emb")
+    pooled = sym.mean(emb, axis=1)
+    fc = sym.FullyConnected(pooled, sym.var("fc_weight"), sym.var("fc_bias"),
+                            num_hidden=4, name="fc")
+    net = sym.SoftmaxOutput(fc, sym.var("softmax_label"), name="softmax")
+    return net, ("data",), ("softmax_label",)
+
+
+def test_bucketing_module_shares_params_across_buckets():
+    rng = np.random.RandomState(0)
+    bm = BucketingModule(_bucket_sym_gen, default_bucket_key=10)
+    bm.bind(data_shapes=[("data", (4, 10))],
+            label_shapes=[("softmax_label", (4,))])
+    bm.init_params()
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params=(("learning_rate", 0.1),))
+
+    def run(seq_len):
+        x = rng.randint(0, 20, (4, seq_len)).astype(np.float32)
+        y = rng.randint(0, 4, (4,)).astype(np.float32)
+        b = DataBatch([nd.array(x)], [nd.array(y)], bucket_key=seq_len)
+        bm.forward(b, is_train=True)
+        out = bm.get_outputs()[0]
+        bm.backward()
+        bm.update()
+        return out
+
+    out10 = run(10)
+    assert out10.shape == (4, 4)
+    out6 = run(6)  # different bucket; shares (and sees updated) params
+    assert out6.shape == (4, 4)
+    w_default = bm._modules[10]._exec.arg_dict["fc_weight"]
+    w_small = bm._modules[6]._exec.arg_dict["fc_weight"]
+    assert w_default is w_small  # same NDArray object: true weight sharing
+
+
+def test_group_json_round_trip():
+    a = sym.var("a")
+    g = sym.Group([a * 2.0, a + 1.0])
+    g2 = sym.load_json(g.tojson())
+    av = nd.array(np.array([3.0], np.float32))
+    r = g2.eval(a=av)
+    assert len(r) == 2
+    np.testing.assert_allclose(r[0].asnumpy(), [6.0])
+    np.testing.assert_allclose(r[1].asnumpy(), [4.0])
+
+
+def test_variable_attrs_json_round_trip():
+    v = sym.Variable("x", shape=(2, 3), attr={"lr_mult": "2"})
+    v2 = sym.load_json(v.tojson())
+    assert v2.attr("lr_mult") == 2
+    assert tuple(v2.attr("__shape__")) == (2, 3)
