@@ -346,15 +346,18 @@ class Block:
     load_params = load_parameters
 
     # ------------------------------------------------------------------ call
-    def __call__(self, *args):
+    def __call__(self, *args, **kwargs):
+        # hooks see kwargs inputs too (appended, keeping the reference's
+        # (block, inputs[, output]) hook arity)
+        hook_args = args + tuple(kwargs.values()) if kwargs else args
         for hook in self._forward_pre_hooks.values():
-            hook(self, args)
-        out = self.forward(*args)
+            hook(self, hook_args)
+        out = self.forward(*args, **kwargs)
         for hook in self._forward_hooks.values():
-            hook(self, args, out)
+            hook(self, hook_args, out)
         return out
 
-    def forward(self, *args):  # pragma: no cover - abstract
+    def forward(self, *args, **kwargs):  # pragma: no cover - abstract
         raise NotImplementedError
 
     def summary(self, *inputs):
@@ -464,14 +467,14 @@ class CachedOp:
             param_datas = flat[:n_params]
             input_datas = flat[n_params:-1]
             mapping = {p: NDArray(d) for p, d in zip(params, param_datas)}
-            inputs = jax.tree.unflatten(
+            inputs, kwargs = jax.tree.unflatten(
                 in_treedef, [NDArray(d) for d in input_datas]
             )
             sink = OrderedDict()
             with param_override(mapping), _random.key_supply(key), _aux_scope(
                 sink
             ), _trace_scope(), autograd._scope(False, training):
-                out = block.forward(*inputs)
+                out = block.forward(*inputs, **kwargs)
             out_nds, out_tree = jax.tree.flatten(
                 out, is_leaf=_is_nd
             )
@@ -487,10 +490,14 @@ class CachedOp:
         holder.fn = jax.jit(staged)
         return holder
 
-    def __call__(self, *inputs):
+    def __call__(self, *inputs, **kwargs):
         from .. import autograd
 
-        input_nds, in_treedef = jax.tree.flatten(inputs, is_leaf=_is_nd)
+        # kwargs ride the same pytree as positional inputs, so the staging
+        # cache key (treedef) distinguishes e.g. valid_length present/absent
+        input_nds, in_treedef = jax.tree.flatten(
+            (inputs, dict(kwargs)), is_leaf=_is_nd
+        )
         if not all(isinstance(i, NDArray) for i in input_nds):
             input_nds = [
                 i if isinstance(i, NDArray) else NDArray(jnp.asarray(i))
@@ -604,15 +611,17 @@ class HybridBlock(Block):
                 return True
         return False
 
-    def forward(self, x, *args):
+    def forward(self, x, *args, **kwargs):
         if self._active and not _in_trace():
             if not getattr(self, "_params_ready", False):
                 if self._deferred_pending():
+                    # probe with positional inputs only: optional kwargs
+                    # (masks, lengths) never determine parameter shapes
                     self._probe_shapes(x, *args)
                 object.__setattr__(self, "_params_ready", True)
             if self._cached_op is None:
                 self._cached_op = CachedOp(self, self._flags)
-            return self._cached_op(x, *args)
+            return self._cached_op(x, *args, **kwargs)
         # eager path (also the body that gets traced by CachedOp)
         try:
             params = {name: p.data() for name, p in self._reg_params.items()}
@@ -635,7 +644,7 @@ class HybridBlock(Block):
                 params = {
                     name: p.data() for name, p in self._reg_params.items()
                 }
-        return self.hybrid_forward(nd_namespace, x, *args, **params)
+        return self.hybrid_forward(nd_namespace, x, *args, **kwargs, **params)
 
     def hybrid_forward(self, F, x, *args, **kwargs):  # pragma: no cover
         raise NotImplementedError

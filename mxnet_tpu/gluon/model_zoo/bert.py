@@ -52,8 +52,8 @@ class BERTEncoderLayer(HybridBlock):
             self.ln_ffn = LayerNorm(in_channels=units, prefix="ln_ffn_")
             self.drop = Dropout(dropout)
 
-    def hybrid_forward(self, F, x):
-        attn = self.drop(self.attention(x))
+    def hybrid_forward(self, F, x, valid_length=None):
+        attn = self.drop(self.attention(x, valid_length=valid_length))
         x = self.ln_attn(x + attn)
         ffn = self.ffn(x)
         return self.ln_ffn(x + ffn)
@@ -70,8 +70,10 @@ class BERTEncoder(HybridBlock):
                     BERTEncoderLayer(units, hidden_size, num_heads, dropout)
                 )
 
-    def hybrid_forward(self, F, x):
-        return self.layers(x)
+    def hybrid_forward(self, F, x, valid_length=None):
+        for layer in self.layers:
+            x = layer(x, valid_length)
+        return x
 
 
 class BERTModel(HybridBlock):
@@ -101,14 +103,15 @@ class BERTModel(HybridBlock):
             self.pooler = Dense(units, activation="tanh", flatten=False,
                                 prefix="pooler_")
 
-    def hybrid_forward(self, F, token_ids, token_types=None):
+    def hybrid_forward(self, F, token_ids, token_types=None,
+                       valid_length=None):
         B, S = token_ids.shape[0], token_ids.shape[1]
         positions = F.arange(0, S).reshape(1, S).broadcast_to((B, S))
         emb = self.word_embed(token_ids) + self.position_embed(positions)
         if token_types is not None:
             emb = emb + self.token_type_embed(token_types)
         emb = self.embed_drop(self.embed_ln(emb))
-        seq = self.encoder(emb)
+        seq = self.encoder(emb, valid_length)
         pooled = self.pooler(seq[:, 0, :])
         return seq, pooled
 
@@ -127,8 +130,9 @@ class BERTForPretraining(HybridBlock):
             self.mlm_ln = LayerNorm(in_channels=units, prefix="mlm_ln_")
             self.nsp = Dense(2, flatten=False, prefix="nsp_")
 
-    def hybrid_forward(self, F, token_ids, token_types=None):
-        seq, pooled = self.bert(token_ids, token_types)
+    def hybrid_forward(self, F, token_ids, token_types=None,
+                       valid_length=None):
+        seq, pooled = self.bert(token_ids, token_types, valid_length)
         h = self.mlm_ln(self.mlm_act(self.mlm_transform(seq)))
         # tied decoder: logits = h @ word_embedding^T
         embed_w = self.bert.word_embed.weight.data()

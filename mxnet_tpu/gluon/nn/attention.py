@@ -70,7 +70,11 @@ class MultiHeadAttention(HybridBlock):
         B, H, S, D = x.shape
         return x.transpose(0, 2, 1, 3).reshape(B, S, H * D)
 
-    def hybrid_forward(self, F, query, key=None, value=None):
+    def hybrid_forward(self, F, query, key=None, value=None,
+                       valid_length=None):
+        """``valid_length`` (B,) int: number of non-padding KEY positions per
+        batch row (reference softmax ``use_length`` semantics); keys past it
+        are masked out of the attention."""
         if self._self_attention:
             qkv = self.qkv_proj(query)  # (B, S, 3*units)
             B, S = qkv.shape[0], qkv.shape[1]
@@ -87,7 +91,7 @@ class MultiHeadAttention(HybridBlock):
             k = self._split(self.k_proj(key))
             v = self._split(self.v_proj(value))
         out = F.flash_attention(
-            q, k, v, causal=self._causal,
+            q, k, v, valid_length, causal=self._causal,
             sm_scale=1.0 / math.sqrt(self._head_dim),
         )
         out = self._merge(out)

@@ -328,12 +328,16 @@ def count_sketch(data, h, s, out_dim=None, **kw):  # rarely used; minimal
 
 # ------------------------------------------------------- fused attention
 @register("_contrib_flash_attention", aliases=["flash_attention"])
-def _flash_attention_op(query, key, value, causal=False, sm_scale=None,
-                        block_q=128, block_k=128, **kw):
+def _flash_attention_op(query, key, value, valid_length=None, causal=False,
+                        sm_scale=None, block_q=128, block_k=128, **kw):
     """Fused O(S)-memory attention over the Pallas kernel (beyond-reference:
     replaces the O(L^2) interleaved ops of src/operator/contrib/transformer.cc
-    [unverified] as the long-context path). Shapes (B, H, S, D)."""
+    [unverified] as the long-context path). Shapes (B, H, S, D);
+    ``valid_length`` (B,) masks padding keys (reference softmax
+    ``use_length`` semantics)."""
     from .pallas import flash_attention as _fa
 
-    return _fa(query, key, value, bool(causal), sm_scale, int(block_q),
-               int(block_k))
+    # keyword args bypass invoke()'s NDArray unwrapping — accept both styles
+    valid_length = getattr(valid_length, "data", valid_length)
+    return _fa(query, key, value, valid_length, bool(causal), sm_scale,
+               int(block_q), int(block_k))

@@ -39,10 +39,21 @@ def _use_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
-                kv_len, causal, block_q):
-    # refs: q (1, block_q, d), k/v (1, padded_kv, d), o (1, block_q, d),
-    # lse (1, block_q, 1) — leading dim is the (b*h) grid block of size 1
+def _fwd_kernel(q_ref, k_ref, v_ref, *refs, sm_scale, block_k, kv_len,
+                causal, block_q, use_vl):
+    # refs: q (1, block_q, d), k/v (1, padded_kv, d); with use_vl an extra
+    # vl (B*H, 1) int32 ref (full array — tiny, so every grid step sees it
+    # whole; a (1,1) block would violate the TPU (8,128) tiling rule);
+    # then o (1, block_q, d), lse (1, block_q, 1) — leading dim is the
+    # (b*h) grid block of size 1. vl is this batch row's valid key length
+    # (reference softmax use_length semantics: keys >= vl are padding);
+    # the dense path compiles without the vl operand at all.
+    if use_vl:
+        vl_ref, o_ref, lse_ref = refs
+        vl = jnp.minimum(vl_ref[pl.program_id(0), 0], kv_len)
+    else:
+        o_ref, lse_ref = refs
+        vl = kv_len
     iq = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     d = q.shape[-1]
@@ -62,7 +73,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
         k_pos = jk * block_k + jax.lax.broadcasted_iota(
             jnp.int32, (1, block_k), 1
         )
-        mask = k_pos < kv_len
+        mask = k_pos < vl
         if causal:
             mask = jnp.logical_and(mask, k_pos <= q_pos)
         s = jnp.where(mask, s, _NEG_INF)
@@ -80,13 +91,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, block_k,
     m0 = jnp.full((block_q, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, d), jnp.float32)
+    # blocks past the valid length contribute nothing — skip them
+    nk_eff = jnp.minimum(nk, pl.cdiv(vl, block_k)) if use_vl else nk
     if causal:
         # blocks fully above the diagonal contribute nothing — skip them
         nk_eff = jnp.minimum(
-            nk, pl.cdiv((iq + 1) * block_q, block_k)
+            nk_eff, pl.cdiv((iq + 1) * block_q, block_k)
         )
-    else:
-        nk_eff = nk
     m, l, acc = jax.lax.fori_loop(0, nk_eff, body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
@@ -106,8 +117,9 @@ def _pad_to(x, axis, multiple):
 @functools.partial(
     jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k")
 )
-def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
-    """q (B,H,Sq,D), k/v (B,H,Sk,D) -> out (B,H,Sq,D), lse (B,H,Sq)."""
+def _flash_fwd_impl(q, k, v, vl, causal, sm_scale, block_q, block_k):
+    """q (B,H,Sq,D), k/v (B,H,Sk,D), vl (B,) int32
+    -> out (B,H,Sq,D), lse (B,H,Sq)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
     bq = min(block_q, max(Sq, 8))
@@ -119,19 +131,26 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
     qp = qp.reshape(B * H, Sq_p, D)
     kp = kp.reshape(B * H, Sk_p, D)
     vp = vp.reshape(B * H, Sk_p, D)
+    use_vl = vl is not None
+    in_specs = [
+        pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
+        pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
+    ]
+    operands = [qp, kp, vp]
+    if use_vl:
+        # one valid-length scalar per (b*h) grid row, b-major per reshape
+        operands.append(jnp.repeat(vl.astype(jnp.int32), H).reshape(B * H, 1))
+        in_specs.append(pl.BlockSpec((B * H, 1), lambda b, i: (0, 0)))
     grid = (B * H, Sq_p // bq)
     kernel = functools.partial(
         _fwd_kernel, sm_scale=sm_scale, block_k=bk, kv_len=Sk,
-        causal=causal, block_q=bq,
+        causal=causal, block_q=bq, use_vl=use_vl,
     )
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
@@ -141,35 +160,43 @@ def _flash_fwd_impl(q, k, v, causal, sm_scale, block_q, block_k):
             jax.ShapeDtypeStruct((B * H, Sq_p, 1), jnp.float32),
         ],
         interpret=_use_interpret(),
-    )(qp, kp, vp)
+    )(*operands)
     out = out.reshape(B, H, Sq_p, D)[:, :, :Sq]
     lse = lse.reshape(B, H, Sq_p)[:, :, :Sq]
     return out, lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def flash_attention(q, k, v, causal=False, sm_scale=None, block_q=128,
-                    block_k=128):
-    """Fused softmax(q·kᵀ·scale)·v. Shapes (B, H, S, D); O(S) memory."""
-    out, _ = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention(q, k, v, valid_length=None, causal=False, sm_scale=None,
+                    block_q=128, block_k=128):
+    """Fused softmax(q·kᵀ·scale)·v. Shapes (B, H, S, D); O(S) memory.
+
+    ``valid_length`` (B,) int: per-row count of non-padding keys (reference
+    softmax ``use_length`` / ``contrib/transformer.cc`` mask semantics
+    [unverified]); keys at positions >= valid_length are ignored."""
+    out, _ = _flash_fwd(q, k, v, valid_length, causal, sm_scale, block_q,
+                        block_k)
     return out
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+def _flash_fwd(q, k, v, valid_length, causal, sm_scale, block_q, block_k):
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_fwd_impl(q, k, v, causal, float(sm_scale), block_q, block_k)
+    vl = None if valid_length is None else valid_length.astype(jnp.int32)
+    return _flash_fwd_impl(q, k, v, vl, causal, float(sm_scale), block_q,
+                           block_k)
 
 
-def _fwd_rule(q, k, v, causal, sm_scale, block_q, block_k):
-    out, lse = _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k)
-    return out, (q, k, v, out, lse)
+def _fwd_rule(q, k, v, valid_length, causal, sm_scale, block_q, block_k):
+    out, lse = _flash_fwd(q, k, v, valid_length, causal, sm_scale, block_q,
+                          block_k)
+    return out, (q, k, v, valid_length, out, lse)
 
 
 @functools.partial(
     jax.jit, static_argnames=("causal", "sm_scale", "block_k")
 )
-def _flash_bwd_impl(q, k, v, out, lse, do, causal, sm_scale, block_k):
+def _flash_bwd_impl(q, k, v, vl, out, lse, do, causal, sm_scale, block_k):
     """Blockwise recompute backward (scan over K blocks, O(S·block) memory)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -183,17 +210,20 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal, sm_scale, block_k):
     dof = do.astype(jnp.float32)
     delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B,H,Sq)
     q_pos = jnp.arange(Sq)[:, None]
+    vl4 = jnp.minimum(vl, Sk).reshape(B, 1, 1, 1)
 
     def body(dq_acc, jk):
         kb = jax.lax.dynamic_slice_in_dim(kp, jk * bk, bk, 2).astype(jnp.float32)
         vb = jax.lax.dynamic_slice_in_dim(vp, jk * bk, bk, 2).astype(jnp.float32)
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb) * sm_scale
         k_pos = jk * bk + jnp.arange(bk)[None, :]
-        mask = k_pos < Sk
+        mask = k_pos[None, None] < vl4  # (B,1,1,bk)
         if causal:
-            mask = jnp.logical_and(mask, k_pos <= q_pos)
+            mask = jnp.logical_and(mask, (k_pos <= q_pos)[None, None])
         s = jnp.where(mask, s, _NEG_INF)
-        p = jnp.exp(s - lse[..., None])  # (B,H,Sq,bk)
+        # explicit zero outside the mask: a fully-masked row has lse ~
+        # _NEG_INF too, where exp(s - lse) would wrongly give 1
+        p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)  # (B,H,Sq,bk)
         dv_b = jnp.einsum("bhqk,bhqd->bhkd", p, dof)
         dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vb)
         ds = p * (dp - delta[..., None]) * sm_scale
@@ -210,13 +240,24 @@ def _flash_bwd_impl(q, k, v, out, lse, do, causal, sm_scale, block_k):
 
 
 def _bwd_rule(causal, sm_scale, block_q, block_k, res, g):
-    q, k, v, out, lse = res
+    q, k, v, valid_length, out, lse = res
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(q.shape[-1])
+    Sk = k.shape[2]
+    vl = (jnp.full((q.shape[0],), Sk, jnp.int32) if valid_length is None
+          else valid_length.astype(jnp.int32))
     dq, dk, dv = _flash_bwd_impl(
-        q, k, v, out, lse, g, causal, float(sm_scale), block_k
+        q, k, v, vl, out, lse, g, causal, float(sm_scale), block_k
     )
-    return dq, dk, dv
+    if valid_length is None:
+        dvl = None
+    elif jnp.issubdtype(valid_length.dtype, jnp.floating):
+        dvl = jnp.zeros_like(valid_length)
+    else:
+        import numpy as _onp
+
+        dvl = _onp.zeros(valid_length.shape, jax.dtypes.float0)
+    return dq, dk, dv, dvl
 
 
 flash_attention.defvjp(_fwd_rule, _bwd_rule)

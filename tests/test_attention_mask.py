@@ -1,0 +1,212 @@
+"""Padding-mask (valid_length) support through the attention stack.
+
+Reference semantics: softmax ``use_length`` + the contrib transformer ops'
+key-padding masks (``src/operator/nn/softmax.cc``,
+``src/operator/contrib/transformer.cc`` [unverified]) — keys at positions
+>= valid_length[b] must not contribute to attention for batch row b.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.ops.pallas import flash_attention
+
+
+def _naive_attention(q, k, v, valid_length=None, causal=False, sm_scale=None):
+    """Dense O(S^2) reference in f32."""
+    if sm_scale is None:
+        sm_scale = 1.0 / np.sqrt(q.shape[-1])
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * sm_scale
+    Sq, Sk = q.shape[2], k.shape[2]
+    mask = jnp.ones((q.shape[0], 1, Sq, Sk), bool)
+    if valid_length is not None:
+        mask = mask & (jnp.arange(Sk)[None, None, None, :]
+                       < valid_length[:, None, None, None])
+    if causal:
+        mask = mask & (jnp.arange(Sk)[None, None, None, :]
+                       <= jnp.arange(Sq)[None, None, :, None])
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(mask, p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def _rand_qkv(B=2, H=3, S=37, D=16, seed=0):
+    rng = np.random.RandomState(seed)
+    q = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    k = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    v = jnp.asarray(rng.randn(B, H, S, D).astype(np.float32))
+    return q, k, v
+
+
+def test_flash_valid_length_forward_parity():
+    q, k, v = _rand_qkv()
+    vl = jnp.asarray([17, 37], jnp.int32)
+    out = flash_attention(q, k, v, vl)
+    ref = _naive_attention(q, k, v, vl)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_valid_length_causal_forward_parity():
+    q, k, v = _rand_qkv(seed=1)
+    vl = jnp.asarray([9, 30], jnp.int32)
+    out = flash_attention(q, k, v, vl, True)
+    ref = _naive_attention(q, k, v, vl, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_valid_length_matches_truncated_keys():
+    q, k, v = _rand_qkv(B=1, seed=2)
+    vl = jnp.asarray([21], jnp.int32)
+    out_masked = flash_attention(q, k, v, vl)
+    out_trunc = flash_attention(q, k[:, :, :21], v[:, :, :21])
+    np.testing.assert_allclose(np.asarray(out_masked), np.asarray(out_trunc),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_flash_valid_length_grads_parity():
+    q, k, v = _rand_qkv(B=2, H=2, S=29, D=8, seed=3)
+    vl = jnp.asarray([13, 29], jnp.int32)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, vl) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(_naive_attention(q, k, v, vl) ** 2)
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-3, atol=2e-3)
+
+
+def test_flash_masked_key_grads_are_zero():
+    q, k, v = _rand_qkv(B=1, H=1, S=16, D=4, seed=4)
+    vl = jnp.asarray([10], jnp.int32)
+
+    def loss(k, v):
+        return jnp.sum(flash_attention(q, k, v, vl))
+
+    dk, dv = jax.grad(loss, argnums=(0, 1))(k, v)
+    np.testing.assert_allclose(np.asarray(dk)[0, 0, 10:], 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(dv)[0, 0, 10:], 0.0, atol=1e-7)
+    assert np.abs(np.asarray(dv)[0, 0, :10]).max() > 0
+
+
+def test_flash_valid_length_none_unchanged():
+    q, k, v = _rand_qkv(seed=5)
+    full = jnp.asarray([q.shape[2]] * q.shape[0], jnp.int32)
+    np.testing.assert_allclose(
+        np.asarray(flash_attention(q, k, v)),
+        np.asarray(flash_attention(q, k, v, full)),
+        rtol=1e-6,
+    )
+
+
+def test_mha_layer_valid_length():
+    """Padded batch through the layer == truncated batch, on valid rows."""
+    rng = np.random.RandomState(0)
+    B, S, units, H = 2, 12, 16, 4
+    vl_np = np.array([7, 12])
+    mha = gluon.nn.MultiHeadAttention(units, H, self_attention=True)
+    mha.initialize()
+    x = rng.randn(B, S, units).astype(np.float32)
+    x_pad = x.copy()
+    x_pad[0, 7:] = 99.0  # garbage in the padding region
+    out = mha(nd.array(x_pad), valid_length=nd.array(vl_np, dtype="int32"))
+    # row 0: compare against running only its valid prefix
+    out_ref = mha(nd.array(x[:1, :7]))
+    np.testing.assert_allclose(
+        out.asnumpy()[0, :7], out_ref.asnumpy()[0], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_mha_valid_length_autograd():
+    rng = np.random.RandomState(1)
+    B, S, units, H = 2, 10, 8, 2
+    mha = gluon.nn.MultiHeadAttention(units, H)
+    mha.initialize()
+    x = nd.array(rng.randn(B, S, units).astype(np.float32))
+    vl = nd.array(np.array([5, 10]), dtype="int32")
+    with autograd.record():
+        out = mha(x, valid_length=vl)
+        loss = (out ** 2).sum()
+    loss.backward()
+    w = mha.qkv_proj.weight
+    assert w.grad() is not None
+    assert np.isfinite(w.grad().asnumpy()).all()
+
+
+def test_bert_padding_invariance():
+    """Changing token content past valid_length must not change valid-row
+    outputs (the property that makes ragged-batch pretraining correct)."""
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+
+    net = BERTModel(vocab_size=50, units=16, hidden_size=32, num_layers=2,
+                    num_heads=2, max_length=32, dropout=0.0)
+    net.initialize()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 50, (2, 12)).astype(np.int32)
+    vl = nd.array(np.array([8, 12]), dtype="int32")
+    seq1, _ = net(nd.array(ids, dtype="int32"), None, vl)
+    ids2 = ids.copy()
+    ids2[0, 8:] = (ids2[0, 8:] + 7) % 50  # scramble padding tokens
+    seq2, _ = net(nd.array(ids2, dtype="int32"), None, vl)
+    np.testing.assert_allclose(
+        seq1.asnumpy()[0, :8], seq2.asnumpy()[0, :8], rtol=2e-4, atol=2e-4
+    )
+    np.testing.assert_allclose(
+        seq1.asnumpy()[1], seq2.asnumpy()[1], rtol=2e-4, atol=2e-4
+    )
+
+
+def test_bert_ragged_pretrain_step():
+    """One fused train step on a ragged batch: finite loss, params move."""
+    from mxnet_tpu import optimizer as opt
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.parallel import TrainStep
+
+    net = BERTModel(vocab_size=50, units=16, hidden_size=32, num_layers=2,
+                    num_heads=2, max_length=32, dropout=0.0)
+    net.initialize()
+    net._probe_shapes(nd.zeros((2, 8), dtype="int32"))
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    word_w = net.word_embed.weight
+
+    class _MLMLoss:
+        def __call__(self, seq_out, pooled, label):
+            w = word_w.data()
+            logits = seq_out.reshape(-1, seq_out.shape[-1]).dot(w.T)
+            return ce(logits, label.reshape(-1))
+
+    step = TrainStep(net, _MLMLoss(), opt.SGD(learning_rate=0.1))
+    rng = np.random.RandomState(0)
+    ids = nd.array(rng.randint(0, 50, (4, 16)), dtype="int32")
+    types = nd.zeros((4, 16), dtype="int32")
+    vl = nd.array(np.array([16, 9, 12, 5]), dtype="int32")
+    labels = nd.array(rng.randint(0, 50, (4, 16)), dtype="int32")
+    l1 = float(step(ids, types, vl, labels).asscalar())
+    l2 = float(step(ids, types, vl, labels).asscalar())
+    assert np.isfinite(l1) and np.isfinite(l2)
+    assert l2 < l1  # same batch twice: loss must drop
+
+
+def test_nd_flash_attention_keyword_valid_length():
+    # keyword NDArray args must be unwrapped by the op itself
+    rng = np.random.RandomState(7)
+    q = nd.array(rng.randn(1, 2, 8, 4).astype(np.float32))
+    k = nd.array(rng.randn(1, 2, 8, 4).astype(np.float32))
+    v = nd.array(rng.randn(1, 2, 8, 4).astype(np.float32))
+    vl = nd.array(np.array([5]), dtype="int32")
+    out_kw = mx.nd.flash_attention(q, k, v, valid_length=vl)
+    out_pos = mx.nd.flash_attention(q, k, v, vl)
+    np.testing.assert_allclose(out_kw.asnumpy(), out_pos.asnumpy(), rtol=1e-6)
