@@ -1,0 +1,15 @@
+"""Driver-format benchmarks for the BASELINE.json configs.
+
+Run from the repo root as modules (so ``mxnet_tpu`` imports without
+PYTHONPATH, which breaks the axon TPU plugin):
+
+    python -m benchmarks.bench_lenet        # config 1
+    python -m benchmarks.bench_resnet50     # config 2
+    python bench.py                         # config 3 (driver metric)
+    python -m benchmarks.bench_transformer  # config 4
+    python -m benchmarks.bench_ssd          # config 5
+    python -m benchmarks.run_all            # all five
+
+Each prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+Ceilings come from BASELINE.md's v4-derived 45%-MFU arithmetic.
+"""

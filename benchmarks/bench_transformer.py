@@ -1,0 +1,51 @@
+"""BASELINE config 4: Transformer-base WMT En-De train step (the config
+that exercises graph fusion: encoder+decoder+tied-logits in one XLA
+program via TrainStep, bf16 + AdamW)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .common import run_bench
+
+BATCH, SRC_LEN, TGT_LEN = 32, 64, 64
+VOCAB = 32768
+# derived ceiling (BASELINE.md arithmetic style): ~61M non-embedding params
+# => ~0.37 GFLOPs/token train cost; 45% of v4 peak 275T => ~3.3e5 tok/s.
+CEILING = 3.3e5
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd, optimizer as opt
+    from mxnet_tpu.gluon.model_zoo.transformer import transformer_base
+    from mxnet_tpu.parallel import TrainStep
+
+    net = transformer_base(src_vocab=VOCAB, tgt_vocab=VOCAB, max_length=512,
+                           dropout=0.1)
+    net.initialize(mx.initializer.Xavier())
+    net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                      nd.zeros((2, 8), dtype="int32"))
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    class _Loss:
+        def __call__(self, logits, label):
+            return ce(logits.reshape(-1, VOCAB), label.reshape(-1))
+
+    step_fn = TrainStep(net, _Loss(), opt.AdamW(learning_rate=1e-4),
+                        compute_dtype="bfloat16")
+    rng = np.random.RandomState(0)
+    src = nd.array(rng.randint(0, VOCAB, (BATCH, SRC_LEN)), dtype="int32")
+    tgt = nd.array(rng.randint(0, VOCAB, (BATCH, TGT_LEN)), dtype="int32")
+    labels = nd.array(rng.randint(0, VOCAB, (BATCH, TGT_LEN)), dtype="int32")
+
+    run_bench(
+        "transformer_wmt_tokens_per_sec_per_chip", "tokens/sec", CEILING,
+        lambda: step_fn(src, tgt, labels),
+        lambda loss: float(loss.asscalar()), BATCH * TGT_LEN,
+        warmup=3, steps=20,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Shared benchmark harness: warmup, timed loop, driver JSON line."""
+
+from __future__ import annotations
+
+import json
+import time
+
+
+def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
+              warmup=3, steps=20):
+    """Time ``step_fn`` and print the driver JSON line.
+
+    ``sync_fn`` must force completion via a host transfer — on the tunneled
+    TPU backend ``block_until_ready`` does not actually block.
+    """
+    try:
+        for _ in range(warmup):
+            out = step_fn()
+        sync_fn(out)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            out = step_fn()
+        sync_fn(out)
+        dt = time.perf_counter() - t0
+        value = steps * items_per_step / dt
+        print(json.dumps({
+            "metric": metric,
+            "value": round(value, 1),
+            "unit": unit,
+            "vs_baseline": round(value / ceiling, 4),
+        }))
+        return value
+    except Exception as e:  # noqa: BLE001 - driver wants a line either way
+        print(json.dumps({
+            "metric": metric,
+            "value": 0.0,
+            "unit": unit,
+            "vs_baseline": 0.0,
+            "error": f"{type(e).__name__}: {e}"[:300],
+        }))
+        return 0.0

@@ -1,0 +1,19 @@
+"""Run all five BASELINE configs; one driver JSON line each."""
+
+from __future__ import annotations
+
+
+def main():
+    from . import bench_lenet, bench_resnet50, bench_ssd, bench_transformer
+
+    bench_lenet.main()
+    bench_resnet50.main()
+    import bench as bench_bert  # repo-root bench.py = config 3
+
+    bench_bert.main()
+    bench_transformer.main()
+    bench_ssd.main()
+
+
+if __name__ == "__main__":
+    main()

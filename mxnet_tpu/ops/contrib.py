@@ -128,27 +128,29 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
         if background_id >= 0 and id_index >= 0:
             valid = valid & (batch[:, id_index] != background_id)
         order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
-        if topk > 0:
-            in_topk = jnp.arange(N) < topk
-        else:
-            in_topk = jnp.ones((N,), bool)
-        sboxes = boxes[order]
-        svalid = valid[order] & in_topk
+        # entries beyond topk can neither survive NOR suppress (a suppressor
+        # must itself be kept, and keep0 is False past topk), so restricting
+        # the IoU matrix and the suppression scan to the top-M sorted entries
+        # is exact — O(topk^2) instead of O(N^2), O(topk) scan steps
+        M = min(N, topk) if topk > 0 else N
+        order_m = order[:M]
+        sboxes = boxes[order_m]
+        svalid = valid[order_m]
         iou = box_iou(sboxes, sboxes)
         if not force_suppress and id_index >= 0:
-            ids = batch[:, id_index][order]
+            ids = batch[:, id_index][order_m]
             same = ids[:, None] == ids[None, :]
             iou = jnp.where(same, iou, 0.0)
 
         def step(keep, i):
-            sup = (iou[i] > overlap_thresh) & (jnp.arange(N) > i) & keep[i]
+            sup = (iou[i] > overlap_thresh) & (jnp.arange(M) > i) & keep[i]
             keep = keep & ~sup
             return keep, 0
 
         keep0 = svalid
-        keep, _ = jax.lax.scan(step, keep0, jnp.arange(N))
-        # scatter back to original positions
-        keep_orig = jnp.zeros((N,), bool).at[order].set(keep)
+        keep, _ = jax.lax.scan(step, keep0, jnp.arange(M))
+        # scatter back to original positions (beyond-topk stays suppressed)
+        keep_orig = jnp.zeros((N,), bool).at[order_m].set(keep)
         out = batch.at[:, score_index].set(
             jnp.where(keep_orig, batch[:, score_index], -1.0)
         )
@@ -210,6 +212,8 @@ def _roi_sample(data, rois, pooled_size, spatial_scale, sample_ratio, aligned,
     sr = sample_ratio if sample_ratio > 0 else 2
     offset = 0.5 if aligned else 0.0
 
+    H, W = data.shape[2], data.shape[3]
+
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
         img = data[bidx]  # (C, H, W)
@@ -220,13 +224,14 @@ def _roi_sample(data, rois, pooled_size, spatial_scale, sample_ratio, aligned,
         # sample grid: (ph*sr, pw*sr)
         ys = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
         xs = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
-        yy, xx = jnp.meshgrid(ys, xs, indexing="ij")
-        coords = jnp.stack([yy.ravel(), xx.ravel()])
-
-        def sample_channel(ch):
-            return jax.scipy.ndimage.map_coordinates(ch, coords, order=1, mode="constant")
-
-        sampled = jax.vmap(sample_channel)(img)  # (C, ph*sr*pw*sr)
+        # separable bilinear interpolation as two matmuls (MXU path; a
+        # per-point gather formulation is scatter-bound on TPU):
+        # weight of pixel h for sample y is the bilinear hat max(0, 1-|y-h|),
+        # which is exactly map_coordinates(order=1, mode="constant", cval=0)
+        wy = jnp.maximum(0.0, 1.0 - jnp.abs(ys[:, None] - jnp.arange(H)[None, :]))
+        wx = jnp.maximum(0.0, 1.0 - jnp.abs(xs[:, None] - jnp.arange(W)[None, :]))
+        t1 = jnp.einsum("sh,chw->csw", wy, img)
+        sampled = jnp.einsum("csw,tw->cst", t1, wx)
         sampled = sampled.reshape(img.shape[0], ph, sr, pw, sr)
         return reduce_fn(sampled, (2, 4))
 
@@ -337,7 +342,9 @@ def _flash_attention_op(query, key, value, valid_length=None, causal=False,
     ``use_length`` semantics)."""
     from .pallas import flash_attention as _fa
 
-    # keyword args bypass invoke()'s NDArray unwrapping — accept both styles
-    valid_length = getattr(valid_length, "data", valid_length)
+    # keyword args bypass invoke()'s NDArray unwrapping — accept both
+    # styles; NOT getattr(..., "data"): numpy arrays expose a memoryview
+    if hasattr(valid_length, "asnumpy"):
+        valid_length = valid_length.data
     return _fa(query, key, value, valid_length, bool(causal), sm_scale,
                int(block_q), int(block_k))

@@ -41,8 +41,10 @@ def _tuplify(x, n):
 # ------------------------------------------------------------------ softmax
 @register("softmax")
 def softmax(data, length=None, axis=-1, temperature=None, dtype=None, use_length=False, **kw):
-    # length may arrive as a keyword NDArray (bypasses invoke unwrapping)
-    length = getattr(length, "data", length)
+    # length may arrive as a keyword NDArray (bypasses invoke unwrapping);
+    # NOT getattr(..., "data"): numpy arrays expose a .data memoryview
+    if hasattr(length, "asnumpy"):
+        length = length.data
     d = data / temperature if temperature else data
     if use_length and length is not None:
         steps = jnp.arange(d.shape[axis])

@@ -210,3 +210,15 @@ def test_nd_flash_attention_keyword_valid_length():
     out_kw = mx.nd.flash_attention(q, k, v, valid_length=vl)
     out_pos = mx.nd.flash_attention(q, k, v, vl)
     np.testing.assert_allclose(out_kw.asnumpy(), out_pos.asnumpy(), rtol=1e-6)
+
+
+def test_keyword_length_accepts_numpy():
+    # numpy arrays expose a .data memoryview — the kwarg unwrap must not
+    # mistake them for NDArrays
+    x = nd.array(np.random.RandomState(0).randn(2, 5).astype("float32"))
+    out = mx.nd.softmax(x, length=np.array([2, 3]), use_length=True)
+    assert out.shape == (2, 5)
+    q = nd.array(np.random.RandomState(1).randn(1, 2, 8, 4).astype("float32"))
+    out2 = mx.nd.flash_attention(q, q, q,
+                                 valid_length=np.array([5], np.int32))
+    assert out2.shape == (1, 2, 8, 4)
