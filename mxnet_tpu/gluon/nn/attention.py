@@ -33,7 +33,8 @@ class MultiHeadAttention(HybridBlock):
     """
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
-                 self_attention=True, causal=False, flatten=False, **kwargs):
+                 self_attention=True, causal=False, flatten=False,
+                 ring_axis=None, **kwargs):
         super().__init__(**kwargs)
         if units % num_heads != 0:
             raise MXNetError(
@@ -44,6 +45,10 @@ class MultiHeadAttention(HybridBlock):
         self._head_dim = units // num_heads
         self._causal = causal
         self._self_attention = self_attention
+        # sequence/context parallelism: name of the mesh axis the sequence
+        # dim is sharded over (ring attention); resolved against
+        # parallel.current_mesh() at forward time
+        self._ring_axis = ring_axis
         with self.name_scope():
             if self_attention:
                 self.qkv_proj = Dense(3 * units, use_bias=use_bias,
@@ -90,10 +95,38 @@ class MultiHeadAttention(HybridBlock):
             q = self._split(self.q_proj(query))
             k = self._split(self.k_proj(key))
             v = self._split(self.v_proj(value))
-        out = F.flash_attention(
-            q, k, v, valid_length, causal=self._causal,
-            sm_scale=1.0 / math.sqrt(self._head_dim),
-        )
+        use_ring = self._ring_axis is not None
+        if use_ring:
+            from ..block import _in_probe
+            from ...parallel import current_mesh
+            from ...parallel.ring_attention import ring_flash_attention
+
+            mesh = current_mesh()
+            if _in_probe() or mesh is None:
+                # shape probe and plain (meshless) inference — e.g. eval
+                # after sync_params on one device — run the numerically
+                # identical dense kernel; ring needs no mesh to be correct
+                use_ring = False
+            elif self._ring_axis not in mesh.axis_names:
+                raise MXNetError(
+                    f"ring_axis={self._ring_axis!r} not in the active "
+                    f"mesh's axes {mesh.axis_names}"
+                )
+        if use_ring:
+            if valid_length is not None:
+                raise MXNetError(
+                    "valid_length is not supported with ring attention yet; "
+                    "pad to full length or use the single-chip kernel"
+                )
+            out = ring_flash_attention(
+                q, k, v, mesh, self._ring_axis, causal=self._causal,
+                sm_scale=1.0 / math.sqrt(self._head_dim),
+            )
+        else:
+            out = F.flash_attention(
+                q, k, v, valid_length, causal=self._causal,
+                sm_scale=1.0 / math.sqrt(self._head_dim),
+            )
         out = self._merge(out)
         out = self.out_proj(out)
         if self.drop is not None:

@@ -142,6 +142,13 @@ class TrainStep:
         over 'data' when the mesh has a data axis)
     param_rules : [(regex, PartitionSpec)] tensor-parallel placement rules
     grad_accum : microbatch accumulation steps (lax.scan over microbatches)
+
+    Sequence/context parallelism: give the mesh a ``seq`` axis, shard batch
+    inputs over it via ``data_spec`` (e.g. ``P('data', 'seq')`` for (B, S)
+    token ids), and build the model's attention with ``ring_axis='seq'``
+    (``MultiHeadAttention``) — the step's trace runs under this mesh's
+    scope, so ring attention resolves the axis automatically and GSPMD
+    composes the ring ppermutes with the data-parallel psum.
     """
 
     def __init__(self, net, loss_fn, optimizer, mesh: Optional[Mesh] = None,
@@ -237,13 +244,20 @@ class TrainStep:
                 return v.astype(cdt)
             return v
 
+        mesh = self._mesh
+        from . import mesh_scope as _mesh_scope
+        import contextlib as _ctx
+
         def forward_loss(train_vals, frozen_vals, batch, label, key):
             mapping = {}
             for n, p in params:
                 v = train_vals[n] if n in train_vals else frozen_vals[n]
                 mapping[p] = NDArray(_cast(v))
             sink = {}
-            with param_override(mapping), _random.key_supply(key), \
+            # activate the mesh during tracing so mesh-aware layers (ring
+            # attention) can resolve their axis from current_mesh()
+            mscope = _mesh_scope(mesh) if mesh is not None else _ctx.nullcontext()
+            with mscope, param_override(mapping), _random.key_supply(key), \
                     _aux_scope(sink), _trace_scope(), \
                     autograd._scope(False, True):
                 out = net(*[NDArray(_cast(b)) for b in batch])
