@@ -425,13 +425,16 @@ def _indent(s, num_spaces):
 class _StagedHolder:
     """Per-(mode, structure) trace metadata captured during jit tracing."""
 
-    __slots__ = ("fn", "n_out", "out_treedef", "aux_params")
+    __slots__ = ("fn", "n_out", "out_treedef", "aux_params", "last_flat",
+                 "last_used")
 
     def __init__(self):
         self.fn = None
         self.n_out = None
         self.out_treedef = None
         self.aux_params = None
+        self.last_flat = None  # flat_args of the most recent call (for export)
+        self.last_used = 0  # global call sequence (export picks the newest)
 
 
 def _is_nd(x):
@@ -442,6 +445,8 @@ class CachedOp:
     """Stages a Block's forward through ``jax.jit`` (reference:
     ``src/imperative/cached_op.cc``; ``static_alloc``/``static_shape`` map to
     XLA's buffer management and are accepted as no-ops)."""
+
+    _call_seq = 0  # class-wide recency counter for export()
 
     def __init__(self, block: "HybridBlock", flags=()):
         self._block = block
@@ -513,6 +518,11 @@ class CachedOp:
         param_nds = [p.data() for p in params]
         key = _random.next_key()
         flat_args = [n.data for n in param_nds] + [n.data for n in input_nds] + [key]
+        # export() serializes the shapes/signature actually in use: remember
+        # the latest call's args (one attr store — hot path) and recency
+        holder.last_flat = flat_args
+        CachedOp._call_seq += 1
+        holder.last_used = CachedOp._call_seq
 
         all_in_nds = param_nds + input_nds
         if autograd.is_recording() and any(
@@ -654,32 +664,56 @@ class HybridBlock(Block):
         """Serialize the staged program + params for deployment (reference:
         ``HybridBlock.export`` -> model-symbol.json + model-0000.params).
 
-        Writes ``{path}-symbol.json`` (graph metadata manifest) and
-        ``{path}-{epoch:04d}.params``."""
+        Writes ``{path}-symbol.json`` (manifest), ``{path}-{epoch:04d}.params``
+        and ``{path}-symbol.stablehlo`` — the staged XLA program serialized
+        via ``jax.export`` so ``SymbolBlock.imports`` can reconstruct a
+        runnable forward with no Python model code (the TPU-native analogue
+        of the reference's nnvm graph JSON)."""
         if not self._active or self._cached_op is None or not self._cached_op._staged:
             raise MXNetError(
                 "run at least one forward after hybridize() before export"
             )
         params_file = f"{path}-{epoch:04d}.params"
-        arg_dict = {
-            f"arg:{name}": p.data()
+        # single source of truth for the arg:/aux: classification — the
+        # .params keys and the manifest's param_order must never diverge
+        ordered = [
+            (f"arg:{name}" if p.grad_req != "null" else f"aux:{name}", p)
             for name, p in self._cached_op._collect()
-            if p.grad_req != "null"
-        }
-        arg_dict.update(
-            {
-                f"aux:{name}": p.data()
-                for name, p in self._cached_op._collect()
-                if p.grad_req == "null"
-            }
-        )
+        ]
         from ..ndarray import save as nd_save
 
-        nd_save(params_file, arg_dict)
+        nd_save(params_file, {key: p.data() for key, p in ordered})
+
+        # the deployment artifact must be a predict-mode program (dropout
+        # off, batchnorm in running-stats mode); among predict traces pick
+        # the most recently called input signature
+        staged = self._cached_op._staged
+        predict = [h for k, h in staged.items() if not k[0]]
+        if not predict:
+            raise MXNetError(
+                "export needs a predict-mode trace: run one forward outside "
+                "autograd.record()/train_mode() before export()"
+            )
+        holder = max(predict, key=lambda h: h.last_used)
+        in_avals = [
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in holder.last_flat
+        ]
+        hlo_file = f"{path}-symbol.stablehlo"
+        from jax import export as jax_export
+
+        exported = jax_export.export(holder.fn)(*in_avals)
+        with open(hlo_file, "wb") as f:
+            f.write(bytes(exported.serialize()))
+
+        # manifest stores basenames: the artifact triple relocates as a unit
         meta = {
             "format": "mxnet_tpu-export-v1",
-            "params": params_file,
+            "params": os.path.basename(params_file),
+            "stablehlo": os.path.basename(hlo_file),
+            "param_order": [key for key, _ in ordered],
             "param_names": [n for n, _ in self._cached_op._collect()],
+            "n_out": holder.n_out,
+            "n_inputs": len(in_avals) - len(ordered) - 1,
             "class": type(self).__name__,
         }
         with open(f"{path}-symbol.json", "w") as f:
@@ -688,29 +722,75 @@ class HybridBlock(Block):
 
 
 class SymbolBlock(HybridBlock):
-    """Load an exported model (reference: ``SymbolBlock.imports``). The TPU
-    build reconstructs from the params file + user-supplied forward function
-    (arbitrary Python cannot be round-tripped through JSON; compiled StableHLO
-    deployment is served by ``jax.export`` separately)."""
+    """Load an exported model into a runnable forward (reference:
+    ``SymbolBlock.imports`` over model-symbol.json [unverified]).
 
-    def __init__(self, outputs=None, inputs=None, params=None):
+    The exported ``.stablehlo`` artifact (written by ``HybridBlock.export``)
+    is deserialized via ``jax.export`` into a compiled callable; parameters
+    come from the ``.params`` file in the manifest's recorded order. The
+    result runs with no Python model code, like the reference's
+    symbol-graph deployment path."""
+
+    def __init__(self, outputs=None, inputs=None, params=None, meta=None):
         super().__init__(prefix="", params=None)
-        self._fn = outputs  # a callable(params_dict, *inputs)
+        self._fn = outputs  # callable(params_dict, *inputs) | Exported
         self._loaded = params or {}
+        self._meta = meta or {}
+        self._exported = None
 
     @staticmethod
-    def imports(symbol_file, input_names, param_file=None, ctx=None):
+    def imports(symbol_file, input_names=None, param_file=None, ctx=None):
         with open(symbol_file) as f:
             meta = json.load(f)
         if meta.get("format") != "mxnet_tpu-export-v1":
             raise MXNetError(f"unrecognized export format in {symbol_file}")
         from ..ndarray import load as nd_load
 
-        params = nd_load(param_file or meta["params"])
-        blk = SymbolBlock(params=params)
+        # manifest paths resolve next to the manifest itself (basenames are
+        # stored; any legacy path is reduced to its basename), so the
+        # artifact triple relocates as a unit
+        base = os.path.dirname(os.path.abspath(symbol_file))
+
+        def _resolve(p):
+            return os.path.join(base, os.path.basename(p))
+
+        params = nd_load(param_file or _resolve(meta["params"]))
+        blk = SymbolBlock(params=params, meta=meta)
+        hlo_file = meta.get("stablehlo")
+        hlo_file = _resolve(hlo_file) if hlo_file else None
+        if hlo_file and os.path.exists(hlo_file):
+            from jax import export as jax_export
+
+            with open(hlo_file, "rb") as f:
+                blk._exported = jax_export.deserialize(bytearray(f.read()))
         return blk
 
     def forward(self, *args):
+        from ..ndarray.ndarray import NDArray as _ND
+
+        if self._exported is not None:
+            order = self._meta["param_order"]
+            missing = [n for n in order if n not in self._loaded]
+            if missing:
+                raise MXNetError(f"params file missing entries: {missing}")
+            flat = [self._loaded[n].data for n in order]
+            # flatten nested input structures the same way the trace did
+            in_leaves, _ = jax.tree.flatten(args, is_leaf=lambda x: isinstance(x, _ND))
+            expect = self._meta.get("n_inputs")
+            if expect is not None and len(in_leaves) != expect:
+                raise MXNetError(
+                    f"this exported model takes {expect} input array(s), "
+                    f"got {len(in_leaves)}"
+                )
+            flat += [
+                a.data if isinstance(a, _ND) else jnp.asarray(a)
+                for a in in_leaves
+            ]
+            flat.append(jax.random.PRNGKey(0))  # predict-mode program
+            outs = self._exported.call(*flat)
+            outs = outs if isinstance(outs, (tuple, list)) else (outs,)
+            primary = [_ND(o) for o in outs[: self._meta.get("n_out", len(outs))]]
+            return primary[0] if len(primary) == 1 else tuple(primary)
         if self._fn is None:
             raise MXNetError(
                 "this SymbolBlock holds parameters only; attach a forward "

@@ -42,7 +42,9 @@ class KVStoreDist(KVStore):
         nproc = os.environ.get("MXNET_TPU_NUM_PROCS")
         pid = os.environ.get("MXNET_TPU_PROC_ID")
         if coord and nproc and pid and not self._initialized_dist:
-            jax.distributed.initialize(
+            from ..parallel import init_process_group
+
+            init_process_group(
                 coordinator_address=coord,
                 num_processes=int(nproc),
                 process_id=int(pid),

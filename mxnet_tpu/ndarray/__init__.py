@@ -118,3 +118,4 @@ def waitall_():  # legacy alias
 _register.populate_module(sys.modules[__name__], namespace="nd")
 
 from . import sparse  # noqa: E402  (facade; row_sparse/csr)
+from . import contrib  # noqa: E402  (mx.nd.contrib.* incl. control flow)

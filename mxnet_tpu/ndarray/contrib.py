@@ -1,0 +1,31 @@
+"""``mx.nd.contrib``: contrib operator namespace + control flow.
+
+Reference: ``python/mxnet/ndarray/contrib.py`` [unverified] — generated
+``_contrib_*`` op wrappers (exposed with the prefix stripped) plus the
+hand-written control-flow helpers ``foreach`` / ``while_loop`` / ``cond``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from ..ops import registry as _registry
+from . import register as _register
+
+# control flow (hand-written, takes callables — cannot be registry ops)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401
+
+def _populate():
+    mod = sys.modules[__name__]
+    for name in _registry.list_ops():
+        if not name.startswith("_contrib_"):
+            continue
+        op = _registry.get(name)
+        fn = _register._make_op_func(op)
+        setattr(mod, name, fn)
+        setattr(mod, name[len("_contrib_"):], fn)
+        for a in op.aliases:
+            setattr(mod, a, fn)
+
+
+_populate()

@@ -1,0 +1,326 @@
+"""Control-flow operators: ``foreach``, ``while_loop``, ``cond``.
+
+TPU-native analogue of ``src/operator/control_flow.cc`` +
+``python/mxnet/ndarray/contrib.py`` control-flow helpers [unverified].
+
+The reference implements these as subgraph operators: the body is traced
+into a nested symbolic graph and an imperative executor loops over it. The
+TPU-native design maps them onto XLA's structured control flow instead:
+
+- ``foreach``   -> ``lax.scan``       (one fused XLA While, MXU-friendly)
+- ``while_loop``-> bounded ``lax.scan`` with an active-predicate carry
+                   (static shapes; reverse-mode differentiable, unlike a raw
+                   ``lax.while_loop``)
+- ``cond``      -> ``lax.cond``
+
+Execution modes, chosen automatically per call:
+
+1. **Staged** (inputs are jax tracers — i.e. inside a ``hybridize()`` /
+   ``jax.jit`` trace): lower directly to the lax primitive. Closed-over
+   NDArrays that wrap tracers (e.g. Gluon parameters inside a CachedOp
+   trace) participate in the outer jit's autodiff for free.
+2. **Eager, recording** (``autograd.record()`` with tracked arrays, concrete
+   values): run a Python loop dispatching ops per iteration, exactly like the
+   reference's imperative path — so gradients flow to *closed-over* tracked
+   arrays (RNN-cell weights), which a single fused ``jax.vjp`` over the scan
+   could not see.
+3. **Eager, not recording**: lower to the lax primitive and dispatch once
+   (fast inference path).
+
+Bodies receive NDArrays (possibly wrapping tracers) and may use any
+registered op, matching the reference contract that the body is ordinary
+frontend code.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+from jax import lax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["foreach", "while_loop", "cond"]
+
+
+def _is_nd(x):
+    return isinstance(x, NDArray)
+
+
+def _data(x):
+    return x.data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def _flatten(tree):
+    leaves, treedef = jax.tree.flatten(tree, is_leaf=_is_nd)
+    return leaves, treedef
+
+
+def _is_traced(leaves) -> bool:
+    return any(isinstance(_data(l), jax.core.Tracer) for l in leaves)
+
+
+def _recording_eager(leaves) -> bool:
+    from .. import autograd
+
+    return autograd.is_recording() and not _is_traced(leaves)
+
+
+def _wrap(treedef, datas):
+    return jax.tree.unflatten(treedef, [NDArray(d) for d in datas])
+
+
+def _stack0(arrays: Sequence[NDArray]) -> NDArray:
+    from ..imperative import invoke_fn
+
+    return invoke_fn(lambda *xs: jnp.stack(xs, axis=0), *arrays)
+
+
+def _check_state_match(init_leaves, new_leaves, what: str):
+    if len(init_leaves) != len(new_leaves):
+        raise MXNetError(
+            f"{what}: loop state structure changed inside the body "
+            f"({len(init_leaves)} leaves became {len(new_leaves)})"
+        )
+    for i, (a, b) in enumerate(zip(init_leaves, new_leaves)):
+        da, db = _data(a), _data(b)
+        if da.shape != db.shape or da.dtype != db.dtype:
+            raise MXNetError(
+                f"{what}: state leaf {i} changed shape/dtype inside the body: "
+                f"{da.shape}/{da.dtype} -> {db.shape}/{db.dtype}"
+            )
+
+
+# ------------------------------------------------------------------- foreach
+def foreach(body: Callable, data, init_states) -> Tuple[Any, Any]:
+    """Iterate ``body`` over the leading axis of ``data`` carrying states.
+
+    ``body(data_slice, states) -> (outputs, new_states)``. ``data``,
+    ``init_states`` and the body results may be NDArrays or (nested)
+    lists/tuples of NDArrays. Returns ``(outputs, final_states)`` with every
+    output stacked along a new leading axis of length ``data.shape[0]``.
+
+    Reference semantics: ``mx.nd.contrib.foreach``
+    (``python/mxnet/ndarray/contrib.py`` [unverified]).
+    """
+    data_leaves, data_tree = _flatten(data)
+    state_leaves, state_tree = _flatten(init_states)
+    if not data_leaves:
+        raise MXNetError("foreach: data must contain at least one array")
+    n = _data(data_leaves[0]).shape[0]
+    for l in data_leaves[1:]:
+        if _data(l).shape[0] != n:
+            raise MXNetError(
+                "foreach: all data arrays must share the leading axis length"
+            )
+
+    # n == 0 falls through to the fused path: lax.scan infers the output
+    # structure by tracing without executing, which a Python loop cannot
+    if n > 0 and _recording_eager(data_leaves + state_leaves):
+        # Python loop: per-iteration op recording (reference imperative path).
+        states = init_states
+        step_outs: List[List[NDArray]] = []
+        out_tree = None
+        for i in range(int(n)):
+            slice_i = jax.tree.unflatten(
+                data_tree, [l[i] for l in data_leaves]
+            )
+            outs, states = body(slice_i, states)
+            new_state_leaves, _ = _flatten(states)
+            _check_state_match(state_leaves, new_state_leaves, "foreach")
+            out_leaves, out_tree = _flatten(outs)
+            step_outs.append(out_leaves)
+        stacked = [
+            _stack0([step[j] for step in step_outs])
+            for j in range(len(step_outs[0]))
+        ]
+        return jax.tree.unflatten(out_tree, stacked), states
+
+    from .. import autograd
+    from ..imperative import invoke_fn
+
+    meta = {}
+
+    def pure(*leaves):
+        d = leaves[: len(data_leaves)]
+        s = leaves[len(data_leaves):]
+
+        def step(carry, xs):
+            x_nd = _wrap(data_tree, xs)
+            s_nd = _wrap(state_tree, carry)
+            with autograd.pause():
+                outs, new_states = body(x_nd, s_nd)
+            out_leaves, meta["out_tree"] = _flatten(outs)
+            ns_leaves, _ = _flatten(new_states)
+            _check_state_match(s, ns_leaves, "foreach")
+            meta["n_out"] = len(out_leaves)
+            return (
+                tuple(_data(l) for l in ns_leaves),
+                tuple(_data(l) for l in out_leaves),
+            )
+
+        final, stacked = lax.scan(step, tuple(s), tuple(d))
+        return tuple(stacked) + tuple(final)
+
+    flat = invoke_fn(pure, *data_leaves, *state_leaves)
+    flat = flat if isinstance(flat, tuple) else (flat,)
+    outs = jax.tree.unflatten(meta["out_tree"], list(flat[: meta["n_out"]]))
+    states = jax.tree.unflatten(state_tree, list(flat[meta["n_out"]:]))
+    return outs, states
+
+
+# ---------------------------------------------------------------- while_loop
+def while_loop(
+    cond_fn: Callable,
+    func: Callable,
+    loop_vars,
+    max_iterations: Optional[int] = None,
+) -> Tuple[Any, Any]:
+    """``while cond_fn(*loop_vars): outputs, loop_vars = func(*loop_vars)``.
+
+    Returns ``(stacked_outputs, final_loop_vars)``. On the eager paths the
+    stacked outputs are trimmed to the realized step count (reference
+    imperative semantics); inside a jit trace they are padded to
+    ``max_iterations`` with zeros beyond the last active step (XLA needs
+    static shapes — the reference's symbolic ``while_loop`` pads identically).
+
+    ``max_iterations`` is required except on the eager recording path.
+    Reference: ``mx.nd.contrib.while_loop`` [unverified].
+    """
+    var_leaves, var_tree = _flatten(loop_vars)
+    if not var_leaves:
+        raise MXNetError("while_loop: loop_vars must contain at least one array")
+
+    if _recording_eager(var_leaves):
+        states = loop_vars
+        step_outs: List[List[NDArray]] = []
+        out_tree = None
+        steps = 0
+        while bool(_np.asarray(_data(cond_fn(*_as_args(states))))):
+            if max_iterations is not None and steps >= max_iterations:
+                break
+            outs, states = func(*_as_args(states))
+            new_leaves, _ = _flatten(states)
+            _check_state_match(var_leaves, new_leaves, "while_loop")
+            out_leaves, out_tree = _flatten(outs)
+            step_outs.append(out_leaves)
+            steps += 1
+        if not step_outs:
+            raise MXNetError("while_loop: condition was false on entry")
+        stacked = [
+            _stack0([step[j] for step in step_outs])
+            for j in range(len(step_outs[0]))
+        ]
+        return jax.tree.unflatten(out_tree, stacked), states
+
+    if max_iterations is None:
+        raise MXNetError(
+            "while_loop: max_iterations is required outside autograd.record() "
+            "(static shapes under XLA)"
+        )
+
+    from .. import autograd
+    from ..imperative import invoke_fn
+
+    meta = {}
+
+    def pure(*leaves):
+        def step(carry, _):
+            active, vars_ = carry
+            v_nd = _wrap(var_tree, vars_)
+            with autograd.pause():
+                pred = cond_fn(*_as_args(v_nd))
+                outs, new_vars = func(*_as_args(v_nd))
+            out_leaves, meta["out_tree"] = _flatten(outs)
+            nv_leaves, _ = _flatten(new_vars)
+            _check_state_match(vars_, nv_leaves, "while_loop")
+            meta["n_out"] = len(out_leaves)
+            act = jnp.logical_and(
+                active, jnp.reshape(_data(pred), ()).astype(bool)
+            )
+            kept = tuple(
+                jnp.where(act, _data(nv), v)
+                for nv, v in zip(nv_leaves, vars_)
+            )
+            emitted = tuple(
+                jnp.where(act, _data(o), jnp.zeros_like(_data(o)))
+                for o in out_leaves
+            )
+            return (act, kept), emitted + (act.astype(jnp.int32),)
+
+        (_, final), ys = lax.scan(
+            step, (jnp.asarray(True), tuple(leaves)), None,
+            length=max_iterations,
+        )
+        n_steps = jnp.sum(ys[-1])
+        return tuple(ys[:-1]) + tuple(final) + (n_steps,)
+
+    flat = invoke_fn(pure, *var_leaves)
+    flat = flat if isinstance(flat, tuple) else (flat,)
+    n_out = meta["n_out"]
+    outs_padded = list(flat[:n_out])
+    final_vars = jax.tree.unflatten(
+        var_tree, list(flat[n_out: n_out + len(var_leaves)])
+    )
+    n_steps = flat[-1]
+    if not isinstance(_data(n_steps), jax.core.Tracer):
+        k = int(_np.asarray(_data(n_steps)))
+        if k == 0:
+            # match the recording path: zero realized iterations is an error
+            # on the eager paths (traced programs return padded outputs)
+            raise MXNetError("while_loop: condition was false on entry")
+        outs_padded = [o[:k] for o in outs_padded]
+    outs = jax.tree.unflatten(meta["out_tree"], outs_padded)
+    return outs, final_vars
+
+
+def _as_args(tree):
+    """loop_vars may be a single NDArray or a list; func takes them splatted."""
+    return tuple(tree) if isinstance(tree, (list, tuple)) else (tree,)
+
+
+# ---------------------------------------------------------------------- cond
+def cond(pred, then_func: Callable, else_func: Callable):
+    """``then_func() if pred else else_func()``.
+
+    Eager (concrete pred): evaluates the predicate and runs the chosen branch
+    as ordinary imperative code (reference imperative semantics — recorded ops
+    in the branch participate in autograd, including closures). Inside a jit
+    trace: lowers to ``lax.cond`` over both branches; structures must match.
+
+    Reference: ``mx.nd.contrib.cond`` [unverified].
+    """
+    p = _data(pred)
+    if not isinstance(p, jax.core.Tracer):
+        branch = then_func if bool(_np.asarray(p)) else else_func
+        return branch()
+
+    from .. import autograd
+
+    meta = {}
+
+    def run(branch, slot):
+        def f(_):
+            with autograd.pause():
+                out = branch()
+            leaves, meta[slot] = _flatten(out)
+            return tuple(_data(l) for l in leaves)
+
+        return f
+
+    flat = lax.cond(
+        jnp.reshape(p, ()).astype(bool),
+        run(then_func, "then_tree"),
+        run(else_func, "else_tree"),
+        None,
+    )
+    if meta["then_tree"] != meta["else_tree"]:
+        raise MXNetError(
+            "cond: then_func and else_func returned different structures: "
+            f"{meta['then_tree']} vs {meta['else_tree']}"
+        )
+    return jax.tree.unflatten(meta["then_tree"], [NDArray(l) for l in flat])

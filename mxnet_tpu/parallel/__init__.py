@@ -130,7 +130,17 @@ def all_reduce_eager(arr):
 def init_process_group(coordinator_address: str, num_processes: int,
                        process_id: int, local_device_ids=None):
     """Join the cluster coordinator (reference analogue: ps-lite scheduler
-    rendezvous in ``ps::Postoffice::Start`` [unverified])."""
+    rendezvous in ``ps::Postoffice::Start`` [unverified]).
+
+    The XLA CPU client only forms a multi-node cluster when a cross-process
+    collectives implementation is selected (localhost multi-process testing,
+    the reference's nightly dist tests), so pick gloo before the backend is
+    instantiated — harmless for TPU runs, where the TPU client syncs through
+    the coordination service itself."""
+    try:
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:  # older jaxlib without gloo: single-node CPU fallback
+        pass
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

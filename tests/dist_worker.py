@@ -1,0 +1,52 @@
+"""Worker body for the multi-process KVStoreDist test (run via
+tools/launch.py local launcher; reference tested dist kvstore exactly this
+way — localhost multi-process, ``tests/nightly/dist_sync_kvstore.py``
+[unverified])."""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+# pin the CPU platform through the config API — the session's TPU-tunnel
+# plugin overrides the JAX_PLATFORMS env var (same trick as conftest.py)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("dist_sync")
+    rank, nworkers = kv.rank, kv.num_workers
+    assert nworkers >= 2, f"expected >=2 workers, got {nworkers}"
+
+    # init must be identical on all workers (reference requirement)
+    kv.init("0", nd.zeros((4, 3)))
+    kv.init("big", nd.ones((8,)) * 100)
+
+    # each worker pushes rank+1; dist_sync must deliver sum over workers
+    kv.push("0", nd.ones((4, 3)) * (rank + 1))
+    out = nd.zeros((4, 3))
+    kv.pull("0", out=out)
+    expect = sum(r + 1 for r in range(nworkers))
+    np.testing.assert_allclose(out.asnumpy(), np.full((4, 3), expect), rtol=1e-6)
+
+    # barrier then second round on another key to check repeated sync
+    kv.barrier()
+    kv.push("big", nd.ones((8,)) * rank)
+    out2 = nd.zeros((8,))
+    kv.pull("big", out=out2)
+    expect2 = sum(range(nworkers))
+    np.testing.assert_allclose(out2.asnumpy(), np.full((8,), expect2), rtol=1e-6)
+
+    print(f"worker {rank}/{nworkers}: dist kvstore OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,254 @@
+"""Control-flow ops: foreach / while_loop / cond across execution modes.
+
+Covers the three dispatch modes of ``mxnet_tpu/ops/control_flow.py``:
+eager inference (fused lax), eager recording (python loop, reference
+imperative semantics incl. closure gradients), and staged inside
+``hybridize()`` (lax primitive under the CachedOp jit).
+Reference behaviors: ``python/mxnet/ndarray/contrib.py`` [unverified].
+"""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu import gluon
+
+
+def _rng(*shape):
+    return np.random.RandomState(sum(shape) + 7).uniform(-1, 1, shape).astype(np.float32)
+
+
+# ------------------------------------------------------------------- foreach
+class TestForeach:
+    def test_cumsum_eager(self):
+        data = nd.array(_rng(5, 3))
+        init = nd.zeros((3,))
+        outs, final = nd.contrib.foreach(
+            lambda x, s: (x + s, x + s), data, init
+        )
+        expect = np.cumsum(data.asnumpy(), axis=0)
+        np.testing.assert_allclose(outs.asnumpy(), expect, rtol=1e-6)
+        np.testing.assert_allclose(final.asnumpy(), expect[-1], rtol=1e-6)
+
+    def test_multiple_states_and_outputs(self):
+        data = nd.array(_rng(4, 2))
+        s0, s1 = nd.ones((2,)), nd.zeros((2,))
+
+        def body(x, states):
+            a, b = states
+            return [x * a, x + b], [a + 1, b + x]
+
+        outs, (fa, fb) = nd.contrib.foreach(body, data, [s0, s1])
+        assert outs[0].shape == (4, 2) and outs[1].shape == (4, 2)
+        np.testing.assert_allclose(fa.asnumpy(), np.full((2,), 5.0), rtol=1e-6)
+        np.testing.assert_allclose(
+            fb.asnumpy(), data.asnumpy().sum(axis=0), rtol=1e-5
+        )
+
+    def test_grad_through_data_and_state(self):
+        data = nd.array(_rng(6, 3))
+        init = nd.array(_rng(3))
+        data.attach_grad()
+        init.attach_grad()
+        with autograd.record():
+            outs, final = nd.contrib.foreach(
+                lambda x, s: (x * s, s + x), data, init
+            )
+            loss = (outs.sum() + final.sum())
+        loss.backward()
+        # numeric check on init grad
+        eps = 1e-3
+        base = init.asnumpy().copy()
+
+        def f(v):
+            s = v.copy()
+            tot = 0.0
+            for i in range(6):
+                x = data.asnumpy()[i]
+                tot += (x * s).sum()
+                s = s + x
+            return tot + s.sum()
+
+        num = np.zeros(3, np.float32)
+        for j in range(3):
+            vp, vm = base.copy(), base.copy()
+            vp[j] += eps
+            vm[j] -= eps
+            num[j] = (f(vp) - f(vm)) / (2 * eps)
+        np.testing.assert_allclose(init.grad.asnumpy(), num, rtol=1e-2, atol=1e-2)
+
+    def test_grad_closure_weights(self):
+        """Recording path must see gradients for closed-over tracked arrays."""
+        w = nd.array(_rng(3, 3))
+        w.attach_grad()
+        data = nd.array(_rng(4, 3))
+        init = nd.zeros((3,))
+        with autograd.record():
+            outs, final = nd.contrib.foreach(
+                lambda x, s: (nd.dot(x, w) + s, s), data, init
+            )
+            outs.sum().backward()
+        expect = np.outer(data.asnumpy().sum(axis=0), np.ones(3))
+        np.testing.assert_allclose(w.grad.asnumpy(), expect, rtol=1e-5)
+
+    def test_inside_hybridize(self):
+        class Scanner(gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                outs, final = nd.contrib.foreach(
+                    lambda xi, s: (xi * 2, s + xi), x, nd.zeros((3,))
+                )
+                return outs, final
+
+        blk = Scanner()
+        blk.hybridize()
+        x = nd.array(_rng(5, 3))
+        outs, final = blk(x)
+        np.testing.assert_allclose(outs.asnumpy(), x.asnumpy() * 2, rtol=1e-6)
+        np.testing.assert_allclose(
+            final.asnumpy(), x.asnumpy().sum(axis=0), rtol=1e-5
+        )
+
+    def test_state_shape_mismatch_raises(self):
+        data = nd.array(_rng(3, 2))
+        init = nd.zeros((2,))
+        with pytest.raises(mx.base.MXNetError):
+            nd.contrib.foreach(
+                lambda x, s: (x, nd.zeros((4,))), data, init
+            )
+
+
+# ---------------------------------------------------------------- while_loop
+class TestWhileLoop:
+    def test_eager_fused_trims(self):
+        i = nd.array(np.array([0.0], np.float32))
+        acc = nd.array(np.array([0.0], np.float32))
+        outs, (fi, facc) = nd.contrib.while_loop(
+            lambda i, a: (i < 4).sum(),
+            lambda i, a: ([i * 10], [i + 1, a + i]),
+            [i, acc],
+            max_iterations=10,
+        )
+        assert outs[0].shape[0] == 4  # trimmed to realized steps
+        np.testing.assert_allclose(
+            outs[0].asnumpy()[:, 0], [0, 10, 20, 30], rtol=1e-6
+        )
+        np.testing.assert_allclose(facc.asnumpy(), [6.0], rtol=1e-6)
+
+    def test_recording_python_loop(self):
+        x = nd.array(np.array([2.0], np.float32))
+        x.attach_grad()
+        with autograd.record():
+            outs, (final,) = nd.contrib.while_loop(
+                lambda v: (v.sum() < 100).sum(),
+                lambda v: ([v], [v * 2]),
+                [x],
+            )
+            final.backward()
+        # 2 -> 4 -> 8 ... doubles until >=100: 2*2^6=128, 6 steps, d final/dx = 64
+        np.testing.assert_allclose(x.grad.asnumpy(), [64.0], rtol=1e-6)
+        assert outs[0].shape[0] == 6
+
+    def test_inside_hybridize_padded(self):
+        class Loop(gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                outs, (v,) = nd.contrib.while_loop(
+                    lambda v: (v.sum() < 10).sum(),
+                    lambda v: ([v], [v + 1]),
+                    [x],
+                    max_iterations=8,
+                )
+                return outs[0], v
+
+        blk = Loop()
+        blk.hybridize()
+        out, v = blk(nd.array(np.array([7.0], np.float32)))
+        assert out.shape == (8, 1)  # padded under jit
+        np.testing.assert_allclose(v.asnumpy(), [10.0], rtol=1e-6)
+        np.testing.assert_allclose(out.asnumpy()[:3, 0], [7, 8, 9], rtol=1e-6)
+        np.testing.assert_allclose(out.asnumpy()[3:, 0], np.zeros(5), atol=0)
+
+    def test_requires_max_iterations_outside_record(self):
+        x = nd.ones((1,))
+        with pytest.raises(mx.base.MXNetError):
+            nd.contrib.while_loop(
+                lambda v: (v.sum() < 3).sum(), lambda v: ([v], [v + 1]), [x]
+            )
+
+
+# ---------------------------------------------------------------------- cond
+class TestCond:
+    def test_eager_branches(self):
+        x = nd.array(np.array([3.0], np.float32))
+        out = nd.contrib.cond(
+            (x.sum() > 1).sum(), lambda: x * 2, lambda: x - 1
+        )
+        np.testing.assert_allclose(out.asnumpy(), [6.0], rtol=1e-6)
+        out = nd.contrib.cond(
+            (x.sum() > 5).sum(), lambda: x * 2, lambda: x - 1
+        )
+        np.testing.assert_allclose(out.asnumpy(), [2.0], rtol=1e-6)
+
+    def test_eager_grad_through_taken_branch(self):
+        x = nd.array(np.array([3.0], np.float32))
+        x.attach_grad()
+        with autograd.record():
+            out = nd.contrib.cond(
+                (x.sum() > 1).sum(), lambda: x * 5, lambda: x - 1
+            )
+            out.backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [5.0], rtol=1e-6)
+
+    def test_inside_hybridize(self):
+        class Branch(gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                return nd.contrib.cond(
+                    (x.sum() > 0).sum(), lambda: x * 2, lambda: -x
+                )
+
+        blk = Branch()
+        blk.hybridize()
+        np.testing.assert_allclose(
+            blk(nd.array(np.array([2.0], np.float32))).asnumpy(), [4.0]
+        )
+        np.testing.assert_allclose(
+            blk(nd.array(np.array([-2.0], np.float32))).asnumpy(), [2.0]
+        )
+
+
+# -------------------------------------------------------- review regressions
+class TestEdgeCases:
+    def test_foreach_zero_length_data(self):
+        data = nd.zeros((0, 3))
+        init = nd.ones((3,))
+        init.attach_grad()
+        with autograd.record():
+            outs, final = nd.contrib.foreach(
+                lambda x, s: (x * s, s + x), data, init
+            )
+        assert outs.shape == (0, 3)
+        np.testing.assert_allclose(final.asnumpy(), np.ones(3))
+
+    def test_while_loop_false_on_entry_eager_fused(self):
+        x = nd.array(np.array([100.0], np.float32))
+        with pytest.raises(mx.base.MXNetError):
+            nd.contrib.while_loop(
+                lambda v: (v.sum() < 4).sum(),
+                lambda v: ([v], [v + 1]),
+                [x],
+                max_iterations=4,
+            )
+
+    def test_cond_structure_mismatch_raises(self):
+        class Bad(gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                return nd.contrib.cond(
+                    (x.sum() > 0).sum(),
+                    lambda: {"a": x, "b": x * 2},
+                    lambda: [x, x * 3],
+                )
+
+        blk = Bad()
+        blk.hybridize()
+        with pytest.raises(mx.base.MXNetError):
+            blk(nd.ones((2,)))
