@@ -1,0 +1,106 @@
+"""Ablation profiler for the BERT pretrain step (BASELINE config 3).
+
+Times step variants to attribute the gap to the 45%-MFU ceiling:
+baseline / no-dropout / rbg-prng / no-vocab-head / dense-attention /
+batch-64. Run on the real chip: ``python -m benchmarks.profile_bert``.
+Writes a row per variant; use alongside ``jax.profiler`` traces.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def _time_step(step, ids, labels, warmup=3, iters=10):
+    for _ in range(warmup):
+        loss = step(ids, labels)
+    float(loss.asscalar())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(ids, labels)
+    float(loss.asscalar())
+    return (time.perf_counter() - t0) / iters
+
+
+def build_and_time(batch=32, seq=128, dropout=0.1, vocab_head=True,
+                   dense_attn=False, iters=10):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, optimizer as opt
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.parallel import TrainStep
+
+    if dense_attn:
+        import jax.numpy as jnp
+        from mxnet_tpu.ops import registry as _reg
+
+        def _dense(q, k, v, valid_length=None, causal=False, sm_scale=1.0, **kw):
+            s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * sm_scale
+            if valid_length is not None:
+                mask = jnp.arange(k.shape[2])[None, None, None, :] < \
+                    valid_length.astype(jnp.int32)[:, None, None, None]
+                s = jnp.where(mask, s, -1e30)
+            p = jnp.exp(s - s.max(axis=-1, keepdims=True))
+            p = p / p.sum(axis=-1, keepdims=True)
+            return jnp.einsum("bhqk,bhkd->bhqd", p, v).astype(q.dtype)
+
+        saved = _reg.get("flash_attention").fn
+        _reg.get("flash_attention").fn = _dense
+    try:
+        net = BERTModel(
+            vocab_size=30522, units=768, hidden_size=3072, num_layers=12,
+            num_heads=12, max_length=512, dropout=dropout,
+        )
+        net.initialize()
+        net._probe_shapes(mx.nd.zeros((2, 8), dtype="int32"))
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+        word_w = net.word_embed.weight
+
+        def loss_fn(seq_out, pooled, label):
+            if vocab_head:
+                w = word_w.data()
+                logits = seq_out.reshape(-1, seq_out.shape[-1]).dot(w.T)
+                return ce(logits, label.reshape(-1))
+            return (seq_out * seq_out).mean()
+
+        step = TrainStep(net, loss_fn, opt.AdamW(learning_rate=1e-4),
+                         compute_dtype="bfloat16", state_dtype="bfloat16")
+        rng = np.random.RandomState(0)
+        ids = mx.nd.array(rng.randint(0, 30522, (batch, seq)), dtype="int32")
+        labels = mx.nd.array(rng.randint(0, 30522, (batch, seq)), dtype="int32")
+        dt = _time_step(step, ids, labels, iters=iters)
+    finally:
+        if dense_attn:
+            _reg.get("flash_attention").fn = saved
+    return dt, batch * seq / dt
+
+
+VARIANTS = {
+    "baseline": {},
+    "no_dropout": {"dropout": 0.0},
+    "no_vocab_head": {"vocab_head": False},
+    "dense_attn": {"dense_attn": True},
+    "batch64": {"batch": 64},
+    "batch64_nodrop": {"batch": 64, "dropout": 0.0},
+}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--variants", nargs="*", default=list(VARIANTS))
+    ap.add_argument("--rbg", action="store_true", help="use rbg PRNG impl")
+    args = ap.parse_args()
+    if args.rbg:
+        import jax
+
+        jax.config.update("jax_default_prng_impl", "rbg")
+    for name in args.variants:
+        dt, tps = build_and_time(**VARIANTS[name])
+        print(f"{name:18s} step={dt*1e3:7.2f} ms  tokens/s={tps:10.0f}",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,60 @@
+// Example mxtpu operator extension library (ABI v1).
+//
+// TPU-native analogue of the reference's custom-op example
+// (example/extensions/lib_custom_op [unverified]): exports two float32
+// operators through the C ABI documented in mxnet_tpu/library.py:
+//   - my_relu6(x): min(max(x, 0), 6); with an exported backward
+//   - my_scaled_add(a, b): a + 0.5 * b; forward-only
+//
+// Build:
+//   g++ -O2 -shared -fPIC -o libcustom_ops.so custom_ops.cc
+// Use:
+//   import mxnet_tpu as mx
+//   mx.library.load("./libcustom_ops.so")
+//   mx.nd.my_relu6(mx.nd.array([-1., 3., 9.]))
+
+#include <algorithm>
+#include <cstdint>
+
+extern "C" {
+
+int mxtpu_abi_version() { return 1; }
+
+int mxtpu_op_count() { return 2; }
+
+const char* mxtpu_op_name(int op) {
+  switch (op) {
+    case 0: return "my_relu6";
+    case 1: return "my_scaled_add";
+    default: return "";
+  }
+}
+
+int mxtpu_op_num_inputs(int op) { return op == 1 ? 2 : 1; }
+
+void mxtpu_op_compute(int op, const float** ins, const long long* lens,
+                      int nin, float* out, long long out_len) {
+  if (op == 0) {
+    const float* x = ins[0];
+    for (long long i = 0; i < out_len; ++i)
+      out[i] = std::min(std::max(x[i], 0.0f), 6.0f);
+  } else if (op == 1) {
+    const float* a = ins[0];
+    const float* b = ins[1];
+    for (long long i = 0; i < out_len; ++i) out[i] = a[i] + 0.5f * b[i];
+  }
+}
+
+int mxtpu_op_has_backward(int op) { return op == 0 ? 1 : 0; }
+
+void mxtpu_op_backward(int op, const float* out_grad, const float** ins,
+                       const long long* lens, int nin, float* grad0,
+                       long long len) {
+  if (op == 0) {
+    const float* x = ins[0];
+    for (long long i = 0; i < len; ++i)
+      grad0[i] = (x[i] > 0.0f && x[i] < 6.0f) ? out_grad[i] : 0.0f;
+  }
+}
+
+}  // extern "C"

@@ -17,6 +17,24 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+import jax as _jax
+
+# TPU-native PRNG default: threefry key derivation burns measurable step
+# time in vector ops on TPU (profiled ~6ms/step of a 40ms BERT step just
+# for dropout masks); rbg uses the hardware RNG path and is the accepted
+# accelerator default. Semantics (splittable, deterministic per seed) are
+# unchanged — only the stream values differ. This is process-global and
+# affects co-resident jax code; set MXNET_TPU_PRNG=threefry (or any other
+# jax impl name, or "default") to opt out before import.
+_prng = _os.environ.get("MXNET_TPU_PRNG", "rbg")
+if _prng != "default":
+    try:
+        _jax.config.update("jax_default_prng_impl", _prng)
+    except Exception:  # pragma: no cover - ancient jax without the flag
+        pass
+
 from . import base
 from .base import MXNetError
 from . import context
@@ -55,6 +73,8 @@ for _mod, _aliases in [
     ("runtime", ()),
     ("test_utils", ()),
     ("checkpoint", ()),
+    ("callback", ()),
+    ("library", ()),
 ]:
     try:
         _m = _importlib.import_module(f".{_mod}", __name__)

@@ -1,0 +1,121 @@
+"""Training callbacks (reference: ``python/mxnet/callback.py`` [unverified]).
+
+The reference fed these to ``Module.fit``'s ``batch_end_callback`` /
+``epoch_end_callback``; the TPU build keeps the same callable contracts so
+training scripts port unchanged. ``Speedometer`` measures wall-clock
+between callback invocations, which under async TPU dispatch reports the
+dispatch-limited rate unless the training loop syncs per batch.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = [
+    "Speedometer", "ProgressBar", "do_checkpoint", "log_train_metric",
+    "LogValidationMetricsCallback", "module_checkpoint",
+]
+
+
+class Speedometer:
+    """Log training speed and metrics every ``frequent`` batches.
+
+    Reference semantics: with ``auto_reset`` the metric is reset after each
+    log line so values are per-window, not running means.
+    """
+
+    def __init__(self, batch_size, frequent=50, auto_reset=True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+        self._logger = logging.getLogger(__name__)
+
+    def __call__(self, param):
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False  # new epoch
+        self.last_count = count
+        if not self.init:
+            self.init = True
+            self.tic = time.time()
+            return
+        if count % self.frequent != 0:
+            return
+        elapsed = time.time() - self.tic
+        speed = self.frequent * self.batch_size / elapsed if elapsed > 0 \
+            else float("inf")
+        if param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            if self.auto_reset:
+                param.eval_metric.reset()
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec\t%s" % (
+                param.epoch, count, speed,
+                "\t".join(f"{n}={v:f}" for n, v in name_value),
+            )
+        else:
+            msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" % (
+                param.epoch, count, speed,
+            )
+        self._logger.info(msg)
+        self.tic = time.time()
+
+
+class ProgressBar:
+    """Text progress bar over total batch count (reference API)."""
+
+    def __init__(self, total, length=80):
+        self.total = total
+        self.length = length
+
+    def __call__(self, param):
+        count = param.nbatch
+        filled = int(round(self.length * count / float(self.total)))
+        pct = round(100.0 * count / float(self.total), 1)
+        bar = "=" * filled + "-" * (self.length - filled)
+        print(f"[{bar}] {pct}%", end="\r")
+
+
+def do_checkpoint(prefix, period=1):
+    """Epoch-end callback saving module params every ``period`` epochs."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            from .module.module import save_checkpoint as _save
+
+            _save(prefix, iter_no + 1, sym, arg, aux)
+
+    return _callback
+
+
+module_checkpoint = do_checkpoint
+
+
+def log_train_metric(period, auto_reset=False):
+    """Batch-end callback logging the metric every ``period`` batches."""
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            logging.info(
+                "Iter[%d] Batch[%d] Train-%s",
+                param.epoch, param.nbatch,
+                ["%s=%f" % nv for nv in name_value],
+            )
+            if auto_reset:
+                param.eval_metric.reset()
+
+    return _callback
+
+
+class LogValidationMetricsCallback:
+    def __call__(self, param):
+        if param.eval_metric is None:
+            return
+        for name, value in param.eval_metric.get_name_value():
+            logging.info("Epoch[%d] Validation-%s=%f", param.epoch, name,
+                         value)

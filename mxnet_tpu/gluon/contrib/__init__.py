@@ -1,5 +1,8 @@
-"""Gluon contrib (reference: ``python/mxnet/gluon/contrib/`` [unverified]).
+"""Gluon contrib (reference: ``python/mxnet/gluon/contrib/`` [unverified]):
+the estimator training facade and structural contrib layers."""
 
-Populated in a later milestone (estimator loop, contrib layers)."""
+from . import nn
+from . import estimator
+from .estimator import Estimator
 
-__all__ = []
+__all__ = ["nn", "estimator", "Estimator"]

@@ -1,0 +1,385 @@
+"""Gluon Estimator: high-level fit loop with event handlers.
+
+Reference: ``python/mxnet/gluon/contrib/estimator/`` [unverified] —
+``Estimator.fit`` drives train/val epochs and dispatches lifecycle events
+(TrainBegin/EpochBegin/BatchBegin/BatchEnd/EpochEnd/TrainEnd) to handler
+objects. The TPU build keeps the same handler contracts; the training step
+itself runs through the standard autograd + Trainer path (hybridize the net
+for the staged XLA step).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Sequence
+
+from ... import autograd, metric as _metric
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ..trainer import Trainer
+
+__all__ = [
+    "Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+    "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+    "ValidationHandler", "LoggingHandler", "CheckpointHandler",
+    "EarlyStoppingHandler",
+]
+
+
+# ------------------------------------------------------------ event mixins
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+# -------------------------------------------------------- builtin handlers
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Stop on max_epoch / max_batch (reference default handler)."""
+
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.stop_training = False
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            self.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            self.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    """Reset metrics at epoch begin, update at batch end."""
+
+    def __init__(self, metrics):
+        self.metrics = _as_list(metrics)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if isinstance(m, _metric.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class ValidationHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Run validation every ``epoch_period`` epochs (or batch_period)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1, batch_period=None,
+                 priority=-1000):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+        self.batch_period = batch_period
+        self.current_batch = 0
+        self.current_epoch = 0
+        self.priority = priority
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.batch_period and self.current_batch % self.batch_period == 0:
+            self.eval_fn(self.val_data)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.epoch_period and self.current_epoch % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochBegin, EpochEnd, BatchEnd):
+    """Periodic speed/metric logging (reference LOG_PER_EPOCH/LOG_PER_BATCH)."""
+
+    LOG_PER_EPOCH = 1
+    LOG_PER_BATCH = 2
+
+    def __init__(self, log_interval="epoch", metrics=None):
+        self.metrics = _as_list(metrics) if metrics else []
+        if log_interval == "epoch":
+            self.log_interval = self.LOG_PER_EPOCH
+        else:
+            self.log_interval = int(log_interval)
+        self.batch_index = 0
+        self.current_epoch = 0
+        self._logger = logging.getLogger(__name__)
+        self.processed_samples = 0
+        self.last_tic = 0.0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.last_tic = time.time()
+        self._logger.info("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        self._logger.info("Training end: %d epochs", self.current_epoch)
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        self.batch_index = 0
+        self.processed_samples = 0
+        self.last_tic = time.time()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.batch_index += 1
+        batch = kwargs.get("batch")
+        if batch is not None:
+            self.processed_samples += _batch_size(batch)
+        if self.log_interval != self.LOG_PER_EPOCH and \
+                self.batch_index % self.log_interval == 0:
+            self._log("Batch[%d]" % self.batch_index)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if self.log_interval == self.LOG_PER_EPOCH:
+            self._log("Epoch[%d]" % self.current_epoch)
+        self.current_epoch += 1
+
+    def _log(self, head):
+        elapsed = max(time.time() - self.last_tic, 1e-9)
+        parts = [f"{head} speed={self.processed_samples / elapsed:.1f} samples/s"]
+        for m in self.metrics:
+            name, value = m.get()
+            parts.append(f"{name}={value}")
+        self._logger.info(" ".join(str(p) for p in parts))
+        self.last_tic = time.time()
+
+
+class CheckpointHandler(TrainBegin, BatchEnd, EpochEnd):
+    """Save params every ``epoch_period`` epochs via net.save_parameters."""
+
+    def __init__(self, model_dir, model_prefix="model", epoch_period=1,
+                 max_checkpoints=5):
+        import os
+
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.epoch_period = epoch_period
+        self.max_checkpoints = max_checkpoints
+        self.current_epoch = 0
+        self.saved = []
+        os.makedirs(model_dir, exist_ok=True)
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        import os
+
+        self.current_epoch += 1
+        if self.current_epoch % self.epoch_period:
+            return
+        path = os.path.join(
+            self.model_dir,
+            f"{self.model_prefix}-epoch{self.current_epoch}.params",
+        )
+        estimator.net.save_parameters(path)
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+
+
+class EarlyStoppingHandler(TrainBegin, EpochEnd, TrainEnd):
+    """Stop when ``monitor`` stops improving (reference semantics: mode
+    auto-resolves from the metric name — 'acc'/'f1' max, losses min)."""
+
+    def __init__(self, monitor, min_delta=0, patience=0, mode="auto"):
+        self.monitor = monitor
+        self.min_delta = min_delta
+        self.patience = patience
+        if mode == "auto":
+            name = monitor.get()[0] if hasattr(monitor, "get") else str(monitor)
+            mode = "max" if any(k in name.lower()
+                                for k in ("acc", "f1", "score")) else "min"
+        self.mode = mode
+        self.best = None
+        self.wait = 0
+        self.stop_training = False
+        self.stopped_epoch = None
+        self.current_epoch = 0
+
+    def _improved(self, value):
+        if self.best is None:
+            return True
+        if self.mode == "max":
+            return value > self.best + self.min_delta
+        return value < self.best - self.min_delta
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        value = self.monitor.get()[1]
+        if self._improved(value):
+            self.best = value
+            self.wait = 0
+        else:
+            self.wait += 1
+            if self.wait > self.patience:
+                self.stop_training = True
+                self.stopped_epoch = self.current_epoch
+        self.current_epoch += 1
+
+    def train_end(self, estimator, *args, **kwargs):
+        if self.stopped_epoch is not None:
+            logging.getLogger(__name__).info(
+                "Early stopping at epoch %d (best %s=%s)",
+                self.stopped_epoch, self.monitor.get()[0], self.best,
+            )
+
+
+# ---------------------------------------------------------------- Estimator
+class Estimator:
+    """High-level training facade (reference: ``gluon.contrib.estimator``).
+
+    >>> est = Estimator(net, loss, train_metrics=mx.metric.Accuracy(),
+    ...                 trainer=trainer)
+    >>> est.fit(train_data, val_data, epochs=2)
+    """
+
+    def __init__(self, net, loss, train_metrics=None, val_metrics=None,
+                 trainer=None, context=None, evaluation_loss=None):
+        self.net = net
+        self.loss = loss
+        self.evaluation_loss = evaluation_loss or loss
+        self.train_metrics = _as_list(train_metrics) if train_metrics else []
+        self.val_metrics = _as_list(val_metrics) if val_metrics else \
+            [type(m)() for m in self.train_metrics]
+        self.train_loss_metric = _metric.Loss("train_loss")
+        self.trainer = trainer or Trainer(
+            net.collect_params(), "adam", {"learning_rate": 1e-3}
+        )
+        self.context = context
+        self.stop_training = False
+
+    # ------------------------------------------------------------- evaluate
+    def evaluate(self, val_data):
+        for m in self.val_metrics:
+            m.reset()
+        val_loss = _metric.Loss("val_loss")
+        for batch in val_data:
+            data, label = _split_batch(batch)
+            pred = self.net(data)
+            L = self.evaluation_loss(pred, label)
+            val_loss.update(0, L)
+            for m in self.val_metrics:
+                m.update(label, pred)
+        return [val_loss] + list(self.val_metrics)
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, train_data, val_data=None, epochs=None,
+            event_handlers=None, batches=None, batch_size=None):
+        if epochs is None and batches is None:
+            raise MXNetError("fit needs epochs or batches")
+        handlers = self._prepare_handlers(event_handlers, val_data, epochs,
+                                          batches)
+        self.stop_training = False
+
+        _dispatch(handlers, "train_begin", self)
+        while not self.stop_training:
+            _dispatch(handlers, "epoch_begin", self)
+            self.train_loss_metric.reset()
+            for batch in train_data:
+                data, label = _split_batch(batch)
+                _dispatch(handlers, "batch_begin", self, batch=batch)
+                with autograd.record():
+                    pred = self.net(data)
+                    L = self.loss(pred, label)
+                L.backward()
+                self.trainer.step(_batch_size(batch))
+                self.train_loss_metric.update(0, L)
+                _dispatch(handlers, "batch_end", self, batch=batch,
+                          pred=pred, label=label, loss=L)
+                self.stop_training = self.stop_training or any(
+                    getattr(h, "stop_training", False) for h in handlers
+                )
+                if self.stop_training:
+                    break
+            _dispatch(handlers, "epoch_end", self)
+            self.stop_training = self.stop_training or any(
+                getattr(h, "stop_training", False) for h in handlers
+            )
+            if hasattr(train_data, "reset"):
+                train_data.reset()
+        _dispatch(handlers, "train_end", self)
+        return self
+
+    def _prepare_handlers(self, event_handlers, val_data, epochs, batches):
+        handlers = list(_as_list(event_handlers) if event_handlers else [])
+        if not any(isinstance(h, StoppingHandler) for h in handlers):
+            handlers.append(StoppingHandler(max_epoch=epochs,
+                                            max_batch=batches))
+        if not any(isinstance(h, MetricHandler) for h in handlers):
+            handlers.append(
+                MetricHandler([self.train_loss_metric] + self.train_metrics)
+            )
+        if val_data is not None and not any(
+            isinstance(h, ValidationHandler) for h in handlers
+        ):
+            handlers.append(ValidationHandler(val_data, self.evaluate))
+        return handlers
+
+
+# ------------------------------------------------------------------ helpers
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _split_batch(batch):
+    if isinstance(batch, (list, tuple)) and len(batch) >= 2:
+        return batch[0], batch[1]
+    if hasattr(batch, "data") and hasattr(batch, "label"):
+        return batch.data[0], batch.label[0]
+    raise MXNetError("cannot split batch into (data, label)")
+
+
+def _batch_size(batch):
+    data, _ = _split_batch(batch)
+    if isinstance(data, NDArray):
+        return data.shape[0]
+    return len(data)
+
+
+def _dispatch(handlers, event, estimator, **kwargs):
+    for h in handlers:
+        fn = getattr(h, event, None)
+        if fn is not None and callable(fn):
+            fn(estimator, **kwargs)

@@ -1,0 +1,32 @@
+"""Contrib layers (reference: ``python/mxnet/gluon/contrib/nn/basic_layers.py``
+[unverified]): structural composition blocks used by model zoos.
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import HybridSequential
+
+__all__ = ["Concurrent", "HybridConcurrent", "Identity"]
+
+
+class HybridConcurrent(HybridSequential):
+    """Runs children on the same input and concatenates outputs on ``axis``
+    (reference: Inception-style branch merge)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [child(x) for child in self]
+        return F.concat(*out, dim=self.axis)
+
+
+class Concurrent(HybridConcurrent):
+    """Imperative alias (reference keeps both names)."""
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x

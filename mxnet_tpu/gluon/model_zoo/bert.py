@@ -60,9 +60,19 @@ class BERTEncoderLayer(HybridBlock):
 
 
 class BERTEncoder(HybridBlock):
+    """Transformer encoder stack.
+
+    ``remat=True`` wraps each layer in ``jax.checkpoint`` when traced:
+    the backward recomputes the layer forward instead of loading saved
+    pre-activations — the TPU-native activation-memory/bandwidth trade
+    (profiled: the FFN fusions are write-bound saving both the pre-GELU
+    and post-GELU (B,S,4H) tensors; remat trades those HBM writes for
+    MXU recompute, which this chip has headroom for)."""
+
     def __init__(self, num_layers, units, hidden_size, num_heads,
-                 dropout=0.1, **kwargs):
+                 dropout=0.1, remat=False, **kwargs):
         super().__init__(**kwargs)
+        self._remat = remat
         with self.name_scope():
             self.layers = HybridSequential(prefix="layers_")
             for _ in range(num_layers):
@@ -71,6 +81,36 @@ class BERTEncoder(HybridBlock):
                 )
 
     def hybrid_forward(self, F, x, valid_length=None):
+        import jax as _jax
+
+        if self._remat and isinstance(x.data, _jax.core.Tracer):
+            from ...ndarray.ndarray import NDArray as _ND
+            from ... import random as _random
+
+            # each layer gets its PRNG key as an explicit operand: the key
+            # supply must not be split inside the checkpointed trace (tracer
+            # leak), and the recompute must replay identical dropout masks.
+            # Outside a supply scope (e.g. the deferred-init shape probe) use
+            # a constant key — drawing from the global stateful stream inside
+            # a trace would shift unrelated draws (parameter init!)
+            supply = _random.current_key_supply()
+            for layer in self.layers:
+                key = supply.next() if supply is not None \
+                    else _jax.random.PRNGKey(0)
+                if valid_length is None:
+                    def f(a, k, _l=layer):
+                        with _random.key_supply(k):
+                            return _l(_ND(a)).data
+
+                    x = _ND(_jax.checkpoint(f)(x.data, key))
+                else:
+                    def f(a, k, vl, _l=layer):
+                        with _random.key_supply(k):
+                            return _l(_ND(a), _ND(vl)).data
+
+                    x = _ND(_jax.checkpoint(f)(x.data, key,
+                                               valid_length.data))
+            return x
         for layer in self.layers:
             x = layer(x, valid_length)
         return x
@@ -85,7 +125,7 @@ class BERTModel(HybridBlock):
 
     def __init__(self, vocab_size=30522, units=768, hidden_size=3072,
                  num_layers=12, num_heads=12, max_length=512,
-                 type_vocab_size=2, dropout=0.1, **kwargs):
+                 type_vocab_size=2, dropout=0.1, remat=False, **kwargs):
         super().__init__(**kwargs)
         self._units = units
         self._vocab_size = vocab_size
@@ -98,7 +138,7 @@ class BERTModel(HybridBlock):
             self.embed_drop = Dropout(dropout)
             self.encoder = BERTEncoder(
                 num_layers, units, hidden_size, num_heads, dropout,
-                prefix="enc_",
+                remat=remat, prefix="enc_",
             )
             self.pooler = Dense(units, activation="tanh", flatten=False,
                                 prefix="pooler_")

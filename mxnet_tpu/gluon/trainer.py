@@ -82,6 +82,8 @@ class Trainer:
 
                 kv = kvstore_mod.create(kvstore)
             self._kvstore = kv
+            if self._compression_params:
+                kv.set_gradient_compression(self._compression_params)
             if update_on_kvstore is None:
                 update_on_kvstore = kv.num_workers > 1
             if update_on_kvstore:

@@ -89,10 +89,18 @@ class KVStore(KVStoreBase):
             k = str(k)
             if k not in self._data:
                 raise MXNetError(f"key {k} not initialized in kvstore")
+            # per-replica compression before the reduce (reference: each
+            # worker compresses its own gradient; residual is per worker)
+            datas = [v.data for v in vals]
+            if self._compression is not None:
+                datas = [
+                    self._compression.compress((k, i), d)
+                    for i, d in enumerate(datas)
+                ]
             # reduce over device replicas (reference: Comm::Reduce / NCCL)
-            agg = vals[0].data
-            for v in vals[1:]:
-                agg = agg + v.data
+            agg = datas[0]
+            for v in datas[1:]:
+                agg = agg + v
             if self._updater is not None:
                 self._updater(int(k) if k.isdigit() else k, NDArray(agg),
                               self._data[k])
@@ -125,7 +133,9 @@ class KVStore(KVStoreBase):
         )
 
     def set_gradient_compression(self, compression_params):
-        self._compression = dict(compression_params)
+        from .compression import GradientCompression
+
+        self._compression = GradientCompression(compression_params)
 
     # ----------------------------------------------------- server optimizer
     def set_optimizer(self, optimizer):

@@ -67,9 +67,17 @@ class KVStoreDist(KVStore):
             k = str(k)
             if k not in self._data:
                 raise MXNetError(f"key {k} not initialized in kvstore")
-            agg = vals[0].data
-            for v in vals[1:]:
-                agg = agg + v.data
+            datas = [v.data for v in vals]
+            if self._compression is not None:
+                # worker-side compression before the wire (reference: the
+                # 2bit path compresses worker->server pushes)
+                datas = [
+                    self._compression.compress((k, i), d)
+                    for i, d in enumerate(datas)
+                ]
+            agg = datas[0]
+            for v in datas[1:]:
+                agg = agg + v
             agg = self._cross_host_sum(agg)
             if self._updater is not None:
                 self._updater(int(k) if k.isdigit() else k, NDArray(agg),

@@ -1,0 +1,206 @@
+"""Runtime-loaded C++ operator extensions.
+
+TPU-native analogue of the reference's custom-op extension ABI
+(``include/mxnet/lib_api.h`` + ``mx.library.load`` [unverified]). The
+reference dlopens a user .so exporting registration symbols and runs its
+FCompute on CPU. Here the contract is a small C ABI (below); loaded ops are
+registered in the SAME operator registry as built-ins, so they appear in
+``mx.nd.*`` and work with autograd:
+
+- host compute stays C++ (called through ctypes on numpy buffers);
+- under ``jit``/``hybridize`` tracing the call lowers to
+  ``jax.pure_callback`` (XLA host callback) wrapped in ``jax.custom_vjp``
+  when the library exports a backward — the staged-graph path of the
+  reference's CustomOp, XLA-style. (The tunneled axon TPU backend does not
+  implement host callbacks; traced custom ops require the CPU platform or a
+  real TPU runtime, and raise a clear error otherwise.)
+
+C ABI (version 1 — elementwise contract: output shape == input[0] shape):
+
+.. code-block:: c
+
+    int  mxtpu_abi_version(void);              // must return 1
+    int  mxtpu_op_count(void);
+    const char* mxtpu_op_name(int op);
+    int  mxtpu_op_num_inputs(int op);
+    void mxtpu_op_compute(int op, const float** ins, const long long* lens,
+                          int nin, float* out, long long out_len);
+    int  mxtpu_op_has_backward(int op);        // optional, default 0
+    // in-grad w.r.t. input 0 (reference CustomOp backward contract)
+    void mxtpu_op_backward(int op, const float* out_grad, const float** ins,
+                           const long long* lens, int nin, float* grad0,
+                           long long len);
+
+See ``examples/extensions/`` for a complete library + build line.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import List
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from .ops import registry as _registry
+
+__all__ = ["load"]
+
+_LOADED: List[ctypes.CDLL] = []
+
+
+def _compute_via_c(lib, op_id, nin):
+    def compute(*arrays):
+        ins = [
+            _np.ascontiguousarray(_np.asarray(a, dtype=_np.float32))
+            for a in arrays
+        ]
+        if len(ins) != nin:
+            raise MXNetError(
+                f"custom op expects {nin} inputs, got {len(ins)}"
+            )
+        out = _np.empty_like(ins[0])
+        in_ptrs = (ctypes.POINTER(ctypes.c_float) * nin)(
+            *[i.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for i in ins]
+        )
+        lens = (ctypes.c_longlong * nin)(*[i.size for i in ins])
+        lib.mxtpu_op_compute(
+            op_id, in_ptrs, lens, nin,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), out.size,
+        )
+        return out
+
+    return compute
+
+
+def _backward_via_c(lib, op_id, nin):
+    def backward(out_grad, *arrays):
+        og = _np.ascontiguousarray(_np.asarray(out_grad, _np.float32))
+        ins = [
+            _np.ascontiguousarray(_np.asarray(a, _np.float32))
+            for a in arrays
+        ]
+        grad0 = _np.empty_like(ins[0])
+        in_ptrs = (ctypes.POINTER(ctypes.c_float) * nin)(
+            *[i.ctypes.data_as(ctypes.POINTER(ctypes.c_float)) for i in ins]
+        )
+        lens = (ctypes.c_longlong * nin)(*[i.size for i in ins])
+        lib.mxtpu_op_backward(
+            op_id, og.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            in_ptrs, lens, nin,
+            grad0.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), grad0.size,
+        )
+        return grad0
+
+    return backward
+
+
+def _make_op_fn(name, compute, backward, nin):
+    """Build the registry-level fn: numpy fast path eagerly, pure_callback
+    (+ custom_vjp when backward exists) under tracing."""
+
+    def _host_call(*arrays):
+        out_aval = jax.ShapeDtypeStruct(
+            jnp.shape(arrays[0]), jnp.float32
+        )
+        return jax.pure_callback(
+            lambda *a: compute(*a), out_aval, *arrays, vmap_method="sequential"
+        )
+
+    if backward is not None:
+        traced = jax.custom_vjp(_host_call)
+
+        def fwd(*arrays):
+            return _host_call(*arrays), arrays
+
+        def bwd(res, ct):
+            g_aval = jax.ShapeDtypeStruct(jnp.shape(res[0]), jnp.float32)
+            g0 = jax.pure_callback(
+                lambda ctg, *a: backward(ctg, *a), g_aval, ct, *res,
+                vmap_method="sequential",
+            )
+            return (g0,) + tuple(None for _ in res[1:])
+
+        traced.defvjp(fwd, bwd)
+    else:
+        traced = _host_call
+
+    def fn(*arrays, **kw):
+        if any(isinstance(a, jax.core.Tracer) for a in arrays):
+            return traced(*arrays)
+        # eager: straight to C++ on host buffers (reference FCompute-on-CPU)
+        return jnp.asarray(compute(*[_np.asarray(a) for a in arrays]))
+
+    fn.__name__ = name
+    fn.__doc__ = f"Custom C++ operator ``{name}`` (loaded via mx.library.load)."
+    return fn
+
+
+def load(path, verbose=True):
+    """dlopen an extension library and register its operators
+    (reference: ``mx.library.load('libmyop.so')``)."""
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        raise MXNetError(f"cannot load extension library {path!r}: {e}")
+    for sym in ("mxtpu_abi_version", "mxtpu_op_count", "mxtpu_op_name",
+                "mxtpu_op_num_inputs", "mxtpu_op_compute"):
+        if not hasattr(lib, sym):
+            raise MXNetError(
+                f"{path}: missing required symbol {sym!r} (not an mxtpu "
+                "extension library)"
+            )
+    lib.mxtpu_abi_version.restype = ctypes.c_int
+    lib.mxtpu_op_count.restype = ctypes.c_int
+    lib.mxtpu_op_name.restype = ctypes.c_char_p
+    lib.mxtpu_op_name.argtypes = [ctypes.c_int]
+    lib.mxtpu_op_num_inputs.restype = ctypes.c_int
+    lib.mxtpu_op_num_inputs.argtypes = [ctypes.c_int]
+    lib.mxtpu_op_compute.argtypes = [
+        ctypes.c_int, ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+        ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+        ctypes.POINTER(ctypes.c_float), ctypes.c_longlong,
+    ]
+    abi = lib.mxtpu_abi_version()
+    if abi != 1:
+        raise MXNetError(f"{path}: unsupported mxtpu ABI version {abi}")
+    has_bwd_fn = getattr(lib, "mxtpu_op_has_backward", None)
+    if has_bwd_fn is not None:
+        has_bwd_fn.restype = ctypes.c_int
+        has_bwd_fn.argtypes = [ctypes.c_int]
+        lib.mxtpu_op_backward.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_float),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.c_longlong), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_longlong,
+        ]
+
+    names = []
+    for i in range(lib.mxtpu_op_count()):
+        name = lib.mxtpu_op_name(i).decode()
+        nin = lib.mxtpu_op_num_inputs(i)
+        compute = _compute_via_c(lib, i, nin)
+        backward = None
+        if has_bwd_fn is not None and has_bwd_fn(i):
+            backward = _backward_via_c(lib, i, nin)
+        fn = _make_op_fn(name, compute, backward, nin)
+        if _registry.maybe_get(name) is not None:
+            raise MXNetError(
+                f"{path}: operator {name!r} already registered"
+            )
+        _registry.register(
+            name, differentiable=backward is not None
+        )(fn)
+        names.append(name)
+    _LOADED.append(lib)  # keep the handle alive
+    # refresh generated namespaces so mx.nd.<name> appears
+    import sys
+
+    from .ndarray import register as _nd_register
+
+    _nd_register.populate_module(sys.modules["mxnet_tpu.ndarray"], "nd")
+    if verbose:
+        print(f"loaded library {path}: ops {names}")
+    return names

@@ -257,6 +257,18 @@ class Module:
         return mod
 
 
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params):
+    """Module-level checkpoint writer (reference:
+    ``mx.model.save_checkpoint``); used by ``callback.do_checkpoint``."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    from ..ndarray import save as nd_save
+
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd_save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
 class _BatchEndParam:
     def __init__(self, epoch, nbatch, eval_metric):
         self.epoch = epoch

@@ -155,17 +155,28 @@ class TrainStep:
                  data_spec: Optional[PartitionSpec] = None,
                  param_rules: Sequence[Tuple[str, PartitionSpec]] = (),
                  donate: bool = True, grad_accum: int = 1,
-                 compute_dtype=None):
+                 compute_dtype=None, state_dtype=None):
         self._net = net
         self._loss = loss_fn
         self._optimizer = optimizer
         self._mesh = mesh
         self._accum = int(grad_accum)
-        # AMP: cast float params/inputs to this dtype INSIDE the jitted step
-        # (f32 masters + optimizer state stay, grads flow back through the
-        # cast) — the reference's multi-precision fp16 scheme, bf16-first
+        # AMP: cast float params/inputs to this dtype INSIDE the jitted step.
+        # The step differentiates W.R.T. THE CAST COPIES, so gradients carry
+        # the compute dtype — the reference's multi-precision scheme exactly
+        # (fp16 weights+grads, f32 masters inside the optimizer,
+        # ``mp_sgd_update`` family in ``src/operator/optimizer_op.cc``
+        # [unverified]) — and the optimizer casts back up. On
+        # bandwidth-bound chips halving gradient bytes is a first-order win.
         self._compute_dtype = (
             jnp.dtype(compute_dtype) if compute_dtype is not None else None
+        )
+        # optionally store optimizer moments (m, v) in a narrow dtype; the
+        # update computes in f32 and casts state back down (bf16 shares
+        # f32's exponent range, so EMA magnitudes survive; mantissa noise
+        # is the accepted trade — like the 8-bit-optimizer line of work)
+        self._state_dtype = (
+            jnp.dtype(state_dtype) if state_dtype is not None else None
         )
         self._params = list(net.collect_params().items())
         for name, p in self._params:
@@ -206,8 +217,14 @@ class TrainStep:
             if self._param_sharding is not None:
                 v = jax.device_put(v, self._param_sharding(name))
             self._values[name] = v
+        def _mk_state(v):
+            st = self._init_state(v)
+            if self._state_dtype is not None:
+                st = tuple(s.astype(self._state_dtype) for s in st)
+            return st
+
         self._opt_state = {
-            n: self._init_state(self._values[n]) for n in self._train_names
+            n: _mk_state(self._values[n]) for n in self._train_names
         }
         if self._param_sharding is not None:
             self._opt_state = {
@@ -248,11 +265,13 @@ class TrainStep:
         from . import mesh_scope as _mesh_scope
         import contextlib as _ctx
 
-        def forward_loss(train_vals, frozen_vals, batch, label, key):
+        def forward_loss(cast_vals, frozen_vals, batch, label, key):
+            # cast_vals are already in compute dtype — they are the
+            # differentiated leaves, so gradients carry that dtype too
             mapping = {}
             for n, p in params:
-                v = train_vals[n] if n in train_vals else frozen_vals[n]
-                mapping[p] = NDArray(_cast(v))
+                v = cast_vals[n] if n in cast_vals else _cast(frozen_vals[n])
+                mapping[p] = NDArray(v)
             sink = {}
             # activate the mesh during tracing so mesh-aware layers (ring
             # attention) can resolve their axis from current_mesh()
@@ -273,10 +292,11 @@ class TrainStep:
                  lr, t, rescale):
             # batch: tuple of arrays; with accum > 1 each has a leading
             # microbatch dim of size `accum` scanned by lax.scan
+            cast_vals = {n: _cast(v) for n, v in train_vals.items()}
             if accum == 1:
                 (L, aux), grads = jax.value_and_grad(
                     forward_loss, has_aux=True
-                )(train_vals, frozen_vals, batch, label, key)
+                )(cast_vals, frozen_vals, batch, label, key)
             else:
                 def micro(carry, inp):
                     g_acc, k = carry
@@ -284,11 +304,16 @@ class TrainStep:
                     mb, ml = inp
                     (Lm, aux_m), g = jax.value_and_grad(
                         forward_loss, has_aux=True
-                    )(train_vals, frozen_vals, mb, ml, sub)
-                    g_acc = jax.tree.map(jnp.add, g_acc, g)
+                    )(cast_vals, frozen_vals, mb, ml, sub)
+                    # accumulate in f32 regardless of grad dtype
+                    g_acc = jax.tree.map(
+                        lambda a, b: a + b.astype(a.dtype), g_acc, g
+                    )
                     return (g_acc, k), (Lm, aux_m)
 
-                g0 = jax.tree.map(jnp.zeros_like, train_vals)
+                g0 = jax.tree.map(
+                    lambda v: jnp.zeros(v.shape, jnp.float32), train_vals
+                )
                 (grads, _), (Ls, auxs) = jax.lax.scan(
                     micro, (g0, key), (batch, label)
                 )
@@ -299,12 +324,20 @@ class TrainStep:
             new_opt = {}
             for n in sorted(train_vals):
                 w, g = train_vals[n], grads[n]
+                st = opt_state[n]
+                # narrow-state option: lift moments to f32 for the update
+                # math; XLA fuses the converts into the update kernel so
+                # only the narrow bytes move through HBM
+                st_f = tuple(s.astype(w.dtype) for s in st)
                 nw, ns = pure_update(
-                    w, g, opt_state[n], lr * lr_mult[n],
+                    w, g.astype(w.dtype), st_f, lr * lr_mult[n],
                     base_wd * wd_mult[n], t, rescale,
                 )
                 new_vals[n] = nw.astype(w.dtype)
-                new_opt[n] = ns
+                new_opt[n] = tuple(
+                    s_new.astype(s_old.dtype)
+                    for s_new, s_old in zip(ns, st)
+                )
             return L, new_vals, new_opt, aux
 
         donate_args = (0, 2) if donate else ()

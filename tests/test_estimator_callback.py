@@ -1,0 +1,145 @@
+"""gluon.contrib.Estimator + mx.callback + contrib layers.
+
+Reference surfaces: ``python/mxnet/gluon/contrib/estimator/``,
+``python/mxnet/callback.py``, ``gluon/contrib/nn`` [unverified].
+"""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.contrib import Estimator
+from mxnet_tpu.gluon.contrib.estimator import (
+    EarlyStoppingHandler, LoggingHandler, CheckpointHandler, StoppingHandler,
+)
+
+
+def _toy_data(n=64, d=8, classes=4, batch=16):
+    rng = np.random.RandomState(0)
+    X = rng.rand(n, d).astype(np.float32)
+    y = rng.randint(0, classes, n)
+    return [
+        (nd.array(X[i:i + batch]), nd.array(y[i:i + batch]))
+        for i in range(0, n, batch)
+    ]
+
+
+def _net(classes=4):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(classes))
+    net.initialize()
+    return net
+
+
+class TestEstimator:
+    def test_fit_runs_and_learns(self):
+        net = _net()
+        est = Estimator(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            train_metrics=mx.metric.Accuracy(),
+            trainer=gluon.Trainer(net.collect_params(), "adam",
+                                  {"learning_rate": 5e-3}),
+        )
+        data = _toy_data()
+        est.fit(data, epochs=3)
+        l0 = float(est.train_loss_metric.get()[1])
+        est.fit(data, epochs=10)
+        l1 = float(est.train_loss_metric.get()[1])
+        assert l1 < l0
+
+    def test_validation_handler(self):
+        net = _net()
+        est = Estimator(
+            net, gluon.loss.SoftmaxCrossEntropyLoss(),
+            train_metrics=mx.metric.Accuracy(),
+        )
+        val = est.evaluate(_toy_data(n=32))
+        names = [m.get()[0] for m in val]
+        assert "val_loss" in names and "accuracy" in names
+
+    def test_early_stopping(self):
+        net = _net()
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+        monitor = est.train_loss_metric
+
+        class _Worse(EarlyStoppingHandler):
+            def _improved(self, value):
+                return False  # never improves
+
+        h = _Worse(monitor, patience=1)
+        est.fit(_toy_data(), epochs=50, event_handlers=[h])
+        assert h.stop_training
+        assert h.current_epoch < 50
+
+    def test_checkpoint_handler(self, tmp_path):
+        net = _net()
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+        h = CheckpointHandler(str(tmp_path), epoch_period=1,
+                              max_checkpoints=2)
+        est.fit(_toy_data(), epochs=4, event_handlers=[h])
+        files = sorted(os.listdir(tmp_path))
+        assert len(files) == 2  # rolling window
+        assert files[-1].endswith("epoch4.params")
+
+    def test_batches_stop(self):
+        net = _net()
+        est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+        seen = []
+
+        class Counter(StoppingHandler):
+            def batch_end(self, estimator, *a, **kw):
+                seen.append(1)
+                super().batch_end(estimator, *a, **kw)
+
+        est.fit(_toy_data(), batches=3,
+                event_handlers=[Counter(max_batch=3)])
+        assert len(seen) == 3
+
+
+class TestCallbacks:
+    def test_speedometer_logs(self, caplog):
+        sp = mx.callback.Speedometer(batch_size=16, frequent=2)
+        m = mx.metric.Accuracy()
+        m.update(nd.array([1, 1]), nd.array([[0.1, 0.9], [0.8, 0.2]]))
+
+        class P:
+            pass
+
+        with caplog.at_level(logging.INFO, logger="mxnet_tpu.callback"):
+            for nbatch in range(5):
+                p = P()
+                p.epoch, p.nbatch, p.eval_metric = 0, nbatch, m
+                sp(p)
+        assert any("samples/sec" in r.message for r in caplog.records)
+
+    def test_do_checkpoint(self, tmp_path):
+        from mxnet_tpu import symbol as sym
+
+        x = sym.var("data")
+        y = sym.FullyConnected(x, num_hidden=2, name="fc")
+        cb = mx.callback.do_checkpoint(str(tmp_path / "m"))
+        arg = {"fc_weight": nd.ones((2, 3)), "fc_bias": nd.zeros((2,))}
+        cb(0, y, arg, {})
+        assert os.path.exists(str(tmp_path / "m-symbol.json"))
+        assert os.path.exists(str(tmp_path / "m-0001.params"))
+
+
+class TestContribNN:
+    def test_hybrid_concurrent(self):
+        from mxnet_tpu.gluon.contrib.nn import HybridConcurrent, Identity
+
+        blk = HybridConcurrent(axis=-1)
+        blk.add(nn.Dense(3), nn.Dense(5), Identity())
+        blk.initialize()
+        x = nd.array(np.random.RandomState(0).rand(4, 7).astype(np.float32))
+        out = blk(x)
+        assert out.shape == (4, 3 + 5 + 7)
+        blk.hybridize()
+        out2 = blk(x)
+        np.testing.assert_allclose(out.asnumpy(), out2.asnumpy(), rtol=2e-3,
+                                   atol=1e-5)

@@ -1,0 +1,99 @@
+"""2-bit gradient compression (reference:
+``src/kvstore/gradient_compression.cc`` + ``tests/python/unittest/
+test_kvstore.py`` compression cases [unverified])."""
+
+import numpy as np
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.kvstore.compression import (
+    GradientCompression, pack_2bit, quantize_2bit, unpack_2bit,
+)
+
+
+class TestQuantize:
+    def test_threshold_semantics(self):
+        g = jnp.asarray([-2.0, -0.5, -0.1, 0.0, 0.3, 0.5, 3.0])
+        q, r = quantize_2bit(g, 0.5)
+        np.testing.assert_allclose(
+            np.asarray(q), [-0.5, -0.5, 0, 0, 0, 0.5, 0.5]
+        )
+        np.testing.assert_allclose(np.asarray(q + r), np.asarray(g), rtol=1e-6)
+
+    def test_pack_unpack_roundtrip(self):
+        rng = np.random.RandomState(0)
+        g = jnp.asarray(rng.randn(37).astype(np.float32))  # non-multiple of 4
+        q, _ = quantize_2bit(g, 0.7)
+        packed, n = pack_2bit(q, 0.7)
+        assert packed.dtype == jnp.uint8 and packed.shape[0] == (37 + 3) // 4
+        out = unpack_2bit(packed, n, 0.7)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(q), rtol=1e-6)
+
+    def test_error_feedback_accumulates(self):
+        gc = GradientCompression({"type": "2bit", "threshold": 1.0})
+        # constant small gradient 0.4 < threshold: quantizes to 0 at first,
+        # residual builds until it crosses the threshold
+        sent = [np.asarray(gc.compress("k", jnp.full((4,), 0.4)))
+                for _ in range(5)]
+        total = sum(s.sum() for s in sent)
+        # after 5 pushes of 0.4 (=2.0 total per element), ~2.0/1.0 quanta
+        # per element should have flowed (error feedback conserves mass)
+        np.testing.assert_allclose(total, 4 * 2.0, atol=4 * 0.5)
+        assert sent[0].sum() == 0.0  # first push below threshold
+
+
+class TestKVStoreCompression:
+    def test_push_applies_compression(self):
+        kv = mx.kv.create("local")
+        kv.init("w", nd.zeros((6,)))
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.push("w", nd.array(np.array([2.0, -2.0, 0.1, 0, 0.6, -0.3],
+                                       np.float32)))
+        out = nd.zeros((6,))
+        kv.pull("w", out=out)
+        np.testing.assert_allclose(
+            out.asnumpy(), [0.5, -0.5, 0.0, 0.0, 0.5, 0.0], rtol=1e-6
+        )
+
+    def test_multi_device_residuals_independent(self):
+        kv = mx.kv.create("device")
+        kv.init("0", nd.zeros((2,)))
+        kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+        # replica 0 pushes 0.6, replica 1 pushes 0.6 -> both below threshold
+        kv.push("0", [nd.array(np.array([0.6, 0.6], np.float32)),
+                      nd.array(np.array([0.6, 0.6], np.float32))])
+        out = nd.zeros((2,))
+        kv.pull("0", out=out)
+        np.testing.assert_allclose(out.asnumpy(), [0.0, 0.0])
+        # second push: residual 0.6 + 0.6 = 1.2 >= 1.0 on each replica
+        kv.push("0", [nd.array(np.array([0.6, 0.6], np.float32)),
+                      nd.array(np.array([0.6, 0.6], np.float32))])
+        kv.pull("0", out=out)
+        np.testing.assert_allclose(out.asnumpy(), [2.0, 2.0])  # 1.0 x 2 replicas
+
+    def test_unsupported_type_raises(self):
+        kv = mx.kv.create("local")
+        try:
+            kv.set_gradient_compression({"type": "1bit"})
+            assert False
+        except mx.base.MXNetError:
+            pass
+
+
+def test_trainer_forwards_compression_params():
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.kvstore.compression import GradientCompression
+
+    net = nn.Dense(2)
+    net.initialize()
+    net(nd.ones((2, 3)))
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1}, kvstore="device",
+                       compression_params={"type": "2bit", "threshold": 0.5})
+    with autograd.record():
+        loss = (net(nd.ones((2, 3))) ** 2).sum()
+    loss.backward()
+    tr.step(2)
+    assert isinstance(tr._kvstore._compression, GradientCompression)

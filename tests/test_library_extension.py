@@ -1,0 +1,70 @@
+"""C++ operator extension loading (reference: ``mx.library.load`` over
+``lib_api.h`` custom ops [unverified]). Compiles the shipped example
+extension with g++ and drives it through nd / autograd / hybridize."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
+                    "extensions", "custom_ops.cc")
+
+
+@pytest.fixture(scope="module")
+def ext_lib(tmp_path_factory):
+    so = str(tmp_path_factory.mktemp("ext") / "libcustom_ops.so")
+    subprocess.run(
+        ["g++", "-O2", "-shared", "-fPIC", "-o", so, _SRC], check=True
+    )
+    names = mx.library.load(so, verbose=False)
+    assert set(names) == {"my_relu6", "my_scaled_add"}
+    return so
+
+
+class TestExtension:
+    def test_eager_compute(self, ext_lib):
+        out = nd.my_relu6(nd.array(np.array([-1.0, 3.0, 9.0], np.float32)))
+        np.testing.assert_allclose(out.asnumpy(), [0.0, 3.0, 6.0])
+        out2 = nd.my_scaled_add(nd.ones((3,)), nd.ones((3,)) * 4)
+        np.testing.assert_allclose(out2.asnumpy(), [3.0, 3.0, 3.0])
+
+    def test_autograd_backward(self, ext_lib):
+        x = nd.array(np.array([-1.0, 3.0, 9.0], np.float32))
+        x.attach_grad()
+        with autograd.record():
+            y = nd.my_relu6(x)
+            y.sum().backward()
+        np.testing.assert_allclose(x.grad.asnumpy(), [0.0, 1.0, 0.0])
+
+    def test_inside_hybridize(self, ext_lib):
+        class Net(gluon.HybridBlock):
+            def hybrid_forward(self, F, x):
+                return F.my_relu6(x * 2)
+
+        net = Net()
+        net.hybridize()
+        out = net(nd.array(np.array([-1.0, 2.0, 5.0], np.float32)))
+        np.testing.assert_allclose(out.asnumpy(), [0.0, 4.0, 6.0])
+
+    def test_bad_library_rejected(self, tmp_path):
+        bad = tmp_path / "notalib.so"
+        bad.write_bytes(b"not a shared object")
+        with pytest.raises(mx.base.MXNetError):
+            mx.library.load(str(bad))
+
+    def test_missing_symbols_rejected(self, tmp_path):
+        src = tmp_path / "empty.cc"
+        src.write_text("extern \"C\" int unrelated() { return 0; }\n")
+        so = str(tmp_path / "libempty.so")
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-o", so, str(src)],
+            check=True,
+        )
+        with pytest.raises(mx.base.MXNetError):
+            mx.library.load(so)

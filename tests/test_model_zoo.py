@@ -118,3 +118,43 @@ def test_transformer_tiny_causal():
         logits.asnumpy()[:, :-1], logits2.asnumpy()[:, :-1], rtol=2e-4,
         atol=1e-5,
     )
+
+
+def test_bert_remat_matches_plain():
+    """remat=True must be numerically identical (dropout off) in loss and
+    gradients — it only changes what the backward rematerializes."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, optimizer as opt
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.parallel import TrainStep
+
+    def run(remat):
+        mx.random.seed(5)
+        net = BERTModel(vocab_size=50, units=16, hidden_size=32,
+                        num_layers=2, num_heads=2, max_length=32,
+                        dropout=0.0, remat=remat)
+        net.initialize()
+        net._probe_shapes(mx.nd.zeros((2, 8), dtype="int32"))
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+        def loss_fn(seq_out, pooled, label):
+            return ce(seq_out.reshape(-1, seq_out.shape[-1]), label.reshape(-1))
+
+        step = TrainStep(net, loss_fn, opt.SGD(learning_rate=0.1))
+        rng = np.random.RandomState(0)
+        ids = mx.nd.array(rng.randint(0, 50, (4, 8)), dtype="int32")
+        labels = mx.nd.array(rng.randint(0, 16, (4, 8)), dtype="int32")
+        losses = [float(step(ids, labels).asscalar()) for _ in range(3)]
+        step.sync_params()
+        return losses, {k: v.data().asnumpy()
+                        for k, v in net.collect_params().items()}
+
+    la, pa = run(False)
+    lb, pb = run(True)
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+    ka = {k.split("_", 1)[-1]: v for k, v in pa.items()}
+    kb = {k.split("_", 1)[-1]: v for k, v in pb.items()}
+    for k in ka:
+        np.testing.assert_allclose(ka[k], kb[k], rtol=1e-4, atol=1e-6,
+                                   err_msg=k)
