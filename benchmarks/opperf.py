@@ -1,0 +1,150 @@
+"""Operator micro-benchmark harness (reference: ``benchmark/opperf/``
+[unverified]).
+
+Times registered operators one by one — eager dispatch and jit-compiled —
+and prints per-op rows plus a JSON summary. The op set covers the
+reference harness's categories (unary/binary math, reductions, NN core,
+contrib detection ops); ``--ops`` selects a subset.
+
+    python -m benchmarks.opperf --runs 50
+    python -m benchmarks.opperf --ops dot relu softmax
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _inputs(shapes, dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    import jax.numpy as jnp
+
+    return [jnp.asarray(rng.rand(*s).astype(dtype) + 0.1) for s in shapes]
+
+
+# op name -> (input shapes, static params)
+DEFAULT_SPECS = {
+    # unary / binary tensor math
+    "relu": ([(256, 256)], {}),
+    "sigmoid": ([(256, 256)], {}),
+    "exp": ([(256, 256)], {}),
+    "log": ([(256, 256)], {}),
+    "sqrt": ([(256, 256)], {}),
+    "broadcast_add": ([(256, 256), (1, 256)], {}),
+    "broadcast_mul": ([(256, 256), (1, 256)], {}),
+    "elemwise_add": ([(256, 256), (256, 256)], {}),
+    # reductions / linalg
+    "sum": ([(256, 256)], {}),
+    "mean": ([(256, 256)], {}),
+    "max": ([(256, 256)], {}),
+    "dot": ([(256, 256), (256, 256)], {}),
+    "batch_dot": ([(16, 64, 64), (16, 64, 64)], {}),
+    # shape ops
+    "transpose": ([(256, 256)], {}),
+    "Reshape": ([(256, 256)], {"shape": (64, 1024)}),
+    "Concat": ([(64, 128), (64, 128)], {"dim": 1}),
+    # NN core
+    "softmax": ([(128, 1000)], {}),
+    "log_softmax": ([(128, 1000)], {}),
+    "FullyConnected": ([(64, 512), (256, 512), (256,)],
+                       {"num_hidden": 256}),
+    "Convolution": ([(8, 16, 32, 32), (32, 16, 3, 3), (32,)],
+                    {"kernel": (3, 3), "num_filter": 32, "pad": (1, 1)}),
+    "Pooling": ([(8, 16, 32, 32)],
+                {"kernel": (2, 2), "stride": (2, 2), "pool_type": "max"}),
+    "BatchNorm": ([(32, 64, 16, 16), (64,), (64,), (64,), (64,)], {}),
+    "LayerNorm": ([(64, 512), (512,), (512,)], {}),
+    "Dropout": ([(256, 256)], {"p": 0.5}),
+    "Activation": ([(256, 256)], {"act_type": "tanh"}),
+    # contrib detection ops
+    "_contrib_box_iou": ([(1, 64, 4), (1, 64, 4)], {}),
+    "_contrib_box_nms": ([(1, 128, 6)], {}),
+    "_contrib_ROIAlign": ([(1, 32, 32, 32), (8, 5)],
+                          {"pooled_size": (7, 7), "spatial_scale": 1.0}),
+}
+
+
+def bench_op(name, shapes, params, warmup=2, runs=20):
+    import jax
+
+    from mxnet_tpu.ops import registry
+
+    op = registry.maybe_get(name)
+    if op is None:
+        return None
+    args = _inputs(shapes)
+    import functools
+
+    fn = functools.partial(op.fn, **params) if params else op.fn
+
+    def _sync(o):
+        leaves = jax.tree.leaves(o)
+        np.asarray(jax.device_get(leaves[0]).reshape(-1)[:1])
+
+    # eager
+    try:
+        for _ in range(warmup):
+            out = fn(*args)
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            out = fn(*args)
+        _sync(out)
+        eager_us = (time.perf_counter() - t0) / runs * 1e6
+    except Exception as e:  # noqa: BLE001
+        return {"op": name, "error": f"{type(e).__name__}: {e}"[:120]}
+    # jitted
+    jfn = jax.jit(fn)
+    try:
+        for _ in range(warmup):
+            out = jfn(*args)
+        _sync(out)
+        t0 = time.perf_counter()
+        for _ in range(runs):
+            out = jfn(*args)
+        _sync(out)
+        jit_us = (time.perf_counter() - t0) / runs * 1e6
+    except Exception as e:  # noqa: BLE001
+        jit_us = None
+    return {"op": name, "eager_us": round(eager_us, 1),
+            "jit_us": round(jit_us, 1) if jit_us is not None else None}
+
+
+def run(ops=None, warmup=2, runs=20):
+    specs = DEFAULT_SPECS if not ops else {
+        k: v for k, v in DEFAULT_SPECS.items()
+        if k in ops or k.removeprefix("_contrib_") in ops
+    }
+    rows = []
+    for name, (shapes, params) in specs.items():
+        row = bench_op(name, shapes, params, warmup, runs)
+        if row is None:
+            continue
+        rows.append(row)
+        if "error" in row:
+            print(f"{name:24s} ERROR {row['error']}")
+        else:
+            j = f"{row['jit_us']:10.1f}" if row["jit_us"] is not None else "       n/a"
+            print(f"{name:24s} eager {row['eager_us']:10.1f} us   jit {j} us")
+    return rows
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", nargs="*", default=None)
+    ap.add_argument("--runs", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--json", action="store_true",
+                    help="print one JSON line with all rows")
+    args = ap.parse_args()
+    rows = run(args.ops, args.warmup, args.runs)
+    if args.json:
+        print(json.dumps({"opperf": rows}))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Data/tensor-parallel training with the fused sharded TrainStep
+(reference workload: ``example/distributed_training*`` + KVStore sync
+[unverified]; TPU-native: GSPMD mesh instead of ps-lite).
+
+Single-process multi-device (the default here, virtual CPU mesh for
+demonstration):
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+    JAX_PLATFORMS=cpu python examples/distributed_train.py
+
+Multi-host: launch one process per host via tools/launch.py; the
+MXNET_TPU_* env vars drive ``parallel.init_process_group`` rendezvous:
+
+    python tools/launch.py -n 2 -- python examples/distributed_train.py
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch-size", type=int, default=32)
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree (mesh 'model' axis)")
+    ap.add_argument("--force-cpu", action="store_true",
+                    help="use the virtual CPU mesh (set XLA_FLAGS="
+                         "--xla_force_host_platform_device_count=N too)")
+    args = ap.parse_args()
+
+    if args.force_cpu:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    # join the coordinator when launched via tools/launch.py
+    coord = os.environ.get("MXNET_TPU_COORDINATOR")
+    if coord:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        from mxnet_tpu.parallel import init_process_group
+
+        init_process_group(coord, int(os.environ["MXNET_TPU_NUM_PROCS"]),
+                           int(os.environ["MXNET_TPU_PROC_ID"]))
+
+    import jax
+
+    import mxnet_tpu as mx  # noqa: E402
+    from mxnet_tpu import gluon, optimizer as opt, parallel
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import PartitionSpec as P
+
+    n_dev = len(jax.devices())
+    dp = n_dev // args.tp
+    mesh = parallel.make_mesh({"data": dp, "model": args.tp}) \
+        if args.tp > 1 else parallel.make_mesh({"data": n_dev})
+    print(f"devices={n_dev} mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(256, activation="relu", prefix="up_"),
+            nn.Dense(10, prefix="head_"))
+    net.initialize()
+    net(mx.nd.ones((2, 64)))
+
+    rules = [("up_weight$", P("model", None))] if args.tp > 1 else []
+    step = parallel.TrainStep(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(),
+        opt.Adam(learning_rate=1e-3), mesh=mesh, data_spec=P("data"),
+        param_rules=rules, compute_dtype="bfloat16",
+    )
+
+    rng = np.random.RandomState(jax.process_index())
+    for i in range(args.steps):
+        x = mx.nd.array(rng.rand(args.batch_size, 64).astype(np.float32))
+        y = mx.nd.array(rng.randint(0, 10, args.batch_size))
+        loss = step(x, y)
+        if i % 5 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss={float(loss.asscalar()):.4f}")
+    print("done")
+
+
+if __name__ == "__main__":
+    main()

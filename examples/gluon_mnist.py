@@ -1,0 +1,87 @@
+"""Train a small CNN classifier with the Gluon API (reference:
+``example/gluon/mnist.py`` [unverified]).
+
+Runs on synthetic MNIST-shaped data (no network access in this
+environment). Demonstrates: HybridBlock, hybridize, Trainer, autograd,
+metric tracking, and parameter checkpointing.
+
+    python examples/gluon_mnist.py --epochs 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import autograd, gluon, nd  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def build_net():
+    net = nn.HybridSequential()
+    net.add(
+        nn.Conv2D(16, kernel_size=5, activation="relu"),
+        nn.MaxPool2D(pool_size=2, strides=2),
+        nn.Conv2D(32, kernel_size=5, activation="relu"),
+        nn.MaxPool2D(pool_size=2, strides=2),
+        nn.Flatten(),
+        nn.Dense(128, activation="relu"),
+        nn.Dense(10),
+    )
+    return net
+
+
+def synthetic_batches(batch_size, num_batches, seed=0):
+    rng = np.random.RandomState(seed)
+    for _ in range(num_batches):
+        x = rng.rand(batch_size, 1, 28, 28).astype(np.float32)
+        y = rng.randint(0, 10, batch_size)
+        yield nd.array(x), nd.array(y)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--batches-per-epoch", type=int, default=20)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--save", default=None, help="param checkpoint path")
+    args = ap.parse_args()
+
+    net = build_net()
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+
+    for epoch in range(args.epochs):
+        metric.reset()
+        total_loss = 0.0
+        for x, y in synthetic_batches(args.batch_size,
+                                      args.batches_per_epoch, seed=epoch):
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            total_loss += float(loss.mean().asscalar())
+            metric.update(y, out)
+        name, acc = metric.get()
+        print(f"epoch {epoch}: loss={total_loss / args.batches_per_epoch:.4f} "
+              f"{name}={acc:.3f}")
+
+    if args.save:
+        net.save_parameters(args.save)
+        print(f"saved parameters to {args.save}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Train LeNet through the legacy Symbol/Module API (reference:
+``example/image-classification/train_mnist.py`` [unverified]).
+
+Demonstrates: mx.sym graph construction, Module.fit with Speedometer and
+checkpoint callbacks, score().
+
+    python examples/module_lenet.py --epochs 2
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import mxnet_tpu as mx  # noqa: E402
+
+
+def lenet_symbol():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, kernel=(5, 5), num_filter=8, name="c1")
+    a1 = mx.sym.Activation(c1, act_type="relu")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    c2 = mx.sym.Convolution(p1, kernel=(5, 5), num_filter=16, name="c2")
+    a2 = mx.sym.Activation(c2, act_type="relu")
+    p2 = mx.sym.Pooling(a2, kernel=(2, 2), stride=(2, 2), pool_type="max")
+    f = mx.sym.Flatten(p2)
+    fc1 = mx.sym.FullyConnected(f, num_hidden=64, name="fc1")
+    a3 = mx.sym.Activation(fc1, act_type="relu")
+    fc2 = mx.sym.FullyConnected(a3, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-examples", type=int, default=640)
+    ap.add_argument("--prefix", default=None, help="checkpoint prefix")
+    args = ap.parse_args()
+
+    rng = np.random.RandomState(0)
+    X = rng.rand(args.num_examples, 1, 28, 28).astype(np.float32)
+    y = rng.randint(0, 10, args.num_examples).astype(np.float32)
+    train = mx.io.NDArrayIter(X, y, args.batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(X[:128], y[:128], args.batch_size,
+                            label_name="softmax_label")
+
+    mod = mx.module.Module(lenet_symbol(), data_names=("data",),
+                           label_names=("softmax_label",))
+    callbacks = [mx.callback.Speedometer(args.batch_size, frequent=5)]
+    epoch_cbs = []
+    if args.prefix:
+        epoch_cbs.append(mx.callback.do_checkpoint(args.prefix))
+    mod.fit(
+        train, eval_data=val, num_epoch=args.epochs,
+        optimizer="adam", optimizer_params={"learning_rate": 1e-3},
+        batch_end_callback=callbacks,
+        epoch_end_callback=epoch_cbs or None,
+    )
+    print("validation:", mod.score(val, "acc"))
+
+
+if __name__ == "__main__":
+    main()

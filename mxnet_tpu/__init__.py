@@ -75,6 +75,9 @@ for _mod, _aliases in [
     ("checkpoint", ()),
     ("callback", ()),
     ("library", ()),
+    ("contrib", ()),
+    ("onnx", ()),
+    ("debug", ()),
 ]:
     try:
         _m = _importlib.import_module(f".{_mod}", __name__)

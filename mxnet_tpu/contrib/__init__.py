@@ -1,0 +1,6 @@
+"""``mx.contrib``: quantization and other contrib subsystems
+(reference: ``python/mxnet/contrib/`` [unverified])."""
+
+from . import quantization
+
+__all__ = ["quantization"]

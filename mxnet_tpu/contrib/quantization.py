@@ -1,0 +1,240 @@
+"""INT8 quantization (reference: ``python/mxnet/contrib/quantization.py`` +
+``src/operator/quantization/`` [unverified]).
+
+The reference flow: calibrate activation ranges on sample data (min/max or
+entropy), rewrite the graph with quantize/dequantize + INT8 kernels. The
+TPU-native rewrite: per-tensor symmetric INT8 with the matmul issued as an
+int8xint8->int32 ``lax.dot_general`` (the MXU's native low-precision path;
+``preferred_element_type=int32`` keeps the accumulator wide), dequantized by
+the product of the two scales. Calibration is layer-wise min/max over
+forwarded batches, like the reference's 'naive' calib mode.
+
+APIs:
+- ops ``_contrib_quantize_v2`` / ``_contrib_dequantize`` in the registry
+- ``QuantizedDense``: drop-in gluon block holding int8 weights
+- ``quantize_net(net, calib_data)``: rewrite Dense layers after calibration
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from ..base import MXNetError
+from ..ops.registry import register, maybe_get
+
+__all__ = ["quantize_v2", "dequantize", "quantize_net", "QuantizedDense",
+           "calib_ranges"]
+
+
+def _scale_from_range(min_val, max_val):
+    # symmetric per-tensor: scale maps [-amax, amax] -> [-127, 127]
+    amax = jnp.maximum(jnp.abs(min_val), jnp.abs(max_val))
+    return jnp.maximum(amax, 1e-8) / 127.0
+
+
+if maybe_get("_contrib_quantize_v2") is None:
+    @register("_contrib_quantize_v2", aliases=["quantize_v2"],
+              num_outputs=3, differentiable=False)
+    def quantize_v2(data, min_calib_range=None, max_calib_range=None, **kw):
+        """float -> (int8, min, max). Symmetric; calib range optional
+        (defaults to the tensor's own range, reference 'auto' mode)."""
+        mn = jnp.asarray(
+            min_calib_range if min_calib_range is not None else data.min(),
+            jnp.float32,
+        )
+        mx_ = jnp.asarray(
+            max_calib_range if max_calib_range is not None else data.max(),
+            jnp.float32,
+        )
+        scale = _scale_from_range(mn, mx_)
+        q = jnp.clip(jnp.round(data / scale), -127, 127).astype(jnp.int8)
+        return q, mn, mx_
+
+    @register("_contrib_dequantize", aliases=["dequantize"],
+              differentiable=False)
+    def dequantize(data, min_range, max_range, **kw):
+        scale = _scale_from_range(jnp.asarray(min_range),
+                                  jnp.asarray(max_range))
+        return data.astype(jnp.float32) * scale
+else:  # pragma: no cover - double import guard
+    quantize_v2 = maybe_get("_contrib_quantize_v2").fn
+    dequantize = maybe_get("_contrib_dequantize").fn
+
+
+def _int8_matmul(x_q, w_q_t, x_scale, w_scale):
+    """(M,K)i8 @ (K,N)i8 -> f32, accumulating in int32 on the MXU."""
+    acc = jax.lax.dot_general(
+        x_q, w_q_t, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return acc.astype(jnp.float32) * (x_scale * w_scale)
+
+
+class QuantizedDense:
+    """INT8 replacement for a trained ``gluon.nn.Dense``.
+
+    Weights are quantized once at conversion; activations are quantized
+    per-call with the calibrated range (static scale -> no data-dependent
+    recompilation under jit)."""
+
+    def __init__(self, dense, act_min, act_max):
+        from ..gluon.nn import Dense
+
+        if not isinstance(dense, Dense):
+            raise MXNetError("QuantizedDense wraps a gluon Dense layer")
+        w = dense.weight.data().data  # (units, in)
+        self._w_scale = float(_np.asarray(
+            jnp.maximum(jnp.abs(w).max(), 1e-8) / 127.0
+        ))
+        self._w_q_t = jnp.clip(
+            jnp.round(w / self._w_scale), -127, 127
+        ).astype(jnp.int8).T  # (in, units)
+        self._bias = dense.bias.data().data if dense.bias is not None else None
+        self._act_scale = float(_np.asarray(
+            _scale_from_range(jnp.asarray(act_min), jnp.asarray(act_max))
+        ))
+        self._act = dense.act
+        self._flatten = getattr(dense, "_flatten", True)
+
+    def __call__(self, x):
+        from ..imperative import invoke_fn
+
+        def fwd(xd):
+            shape = xd.shape
+            if self._flatten and xd.ndim > 2:
+                xd = xd.reshape(shape[0], -1)
+            elif xd.ndim > 2:
+                xd = xd.reshape(-1, shape[-1])
+            x_q = jnp.clip(
+                jnp.round(xd / self._act_scale), -127, 127
+            ).astype(jnp.int8)
+            out = _int8_matmul(x_q, self._w_q_t, self._act_scale,
+                               self._w_scale)
+            if self._bias is not None:
+                out = out + self._bias
+            if not self._flatten and len(shape) > 2:
+                out = out.reshape(shape[:-1] + (out.shape[-1],))
+            return out
+
+        out = invoke_fn(fwd, x)
+        if self._act is not None:
+            out = self._act(out)
+        return out
+
+
+def calib_ranges(net, calib_data, layers) -> Dict[int, tuple]:
+    """Min/max of each target layer's INPUT over the calibration batches
+    (reference 'naive' calibration). ``layers``: list of Dense blocks."""
+    ranges: Dict[int, List[float]] = {}
+    hooks = []
+
+    def make_hook(key):
+        def hook(block, inputs):
+            x = inputs[0]
+            arr = _np.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+            lo, hi = float(arr.min()), float(arr.max())
+            if key in ranges:
+                ranges[key][0] = min(ranges[key][0], lo)
+                ranges[key][1] = max(ranges[key][1], hi)
+            else:
+                ranges[key] = [lo, hi]
+
+        return hook
+
+    for layer in layers:
+        hooks.append(layer.register_forward_pre_hook(make_hook(id(layer))))
+    try:
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            net(x)
+    finally:
+        for h in hooks:
+            h.detach()
+    return {k: tuple(v) for k, v in ranges.items()}
+
+
+def quantize_net(net, calib_data=None, exclude=()):
+    """Replace every calibrated ``Dense`` child with ``QuantizedDense``
+    in-place; returns the rewritten net (reference: ``quantize_model``'s
+    graph rewrite, gluon-style). Runs ``calib_data`` through the net for
+    activation ranges (required)."""
+    from ..gluon.nn import Dense
+
+    dense_layers = []
+
+    def collect(block):
+        for child in block._children.values():
+            if isinstance(child, Dense) and child not in exclude:
+                dense_layers.append(child)
+            collect(child)
+
+    collect(net)
+    if not dense_layers:
+        raise MXNetError("quantize_net: no Dense layers found to quantize")
+    if calib_data is None:
+        raise MXNetError("quantize_net needs calibration data")
+    ranges = calib_ranges(net, calib_data, dense_layers)
+
+    def rewrite(block):
+        for name, child in list(block._children.items()):
+            if isinstance(child, Dense) and id(child) in ranges:
+                lo, hi = ranges[id(child)]
+                newb = _QuantizedDenseBlock(QuantizedDense(child, lo, hi))
+                block._children[name] = newb
+                # attribute-style blocks (self.fc = Dense(...)) call the
+                # child through the instance attribute, not _children —
+                # swap every attribute referencing the old layer too
+                for attr, val in list(vars(block).items()):
+                    if val is child:
+                        object.__setattr__(block, attr, newb)
+            else:
+                rewrite(child)
+
+    rewrite(net)
+    if hasattr(net, "_clear_cached_op"):
+        net._clear_cached_op()
+    return net
+
+
+# the ops above registered after mx.nd was generated at package import:
+# refresh the generated namespaces so nd._contrib_quantize_v2 etc. appear
+def _refresh_namespaces():
+    import sys
+
+    nd_mod = sys.modules.get("mxnet_tpu.ndarray")
+    if nd_mod is not None:
+        from ..ndarray import register as _nd_register
+
+        _nd_register.populate_module(nd_mod, "nd")
+    ndc = sys.modules.get("mxnet_tpu.ndarray.contrib")
+    if ndc is not None:
+        ndc._populate()
+
+
+_refresh_namespaces()
+
+
+def _quantized_dense_block_cls():
+    from ..gluon.block import Block
+
+    class _QDB(Block):
+        """Block adapter holding a QuantizedDense (a real Block subclass,
+        so save_parameters/apply/initialize traversals keep working —
+        it simply owns no Parameters; weights are baked-in int8)."""
+
+        def __init__(self, q):
+            super().__init__(prefix="quantized_", params=None)
+            self._q = q
+
+        def forward(self, x, *args):
+            return self._q(x)
+
+    return _QDB
+
+
+def _QuantizedDenseBlock(q):
+    return _quantized_dense_block_cls()(q)

@@ -1,0 +1,58 @@
+"""Numeric-debug modes (SURVEY aux subsystems: race detection / debug).
+
+The reference's debugging levers were the naive (synchronous) engine mode
+and NaN checks inside ops; the TPU-native equivalents:
+
+- ``set_nan_check(True)``: flip ``jax_debug_nans`` — XLA re-runs any
+  computation producing a NaN un-jitted and raises at the exact primitive
+  (stronger than the reference's per-op output scan).
+- ``nan_guard()``: context-manager form for one training section.
+- ``check_nan(arr)``: explicit assertion on an NDArray/array (the
+  reference's ``MXNET_NAN_CHECK``-style spot check).
+- synchronous execution: ``MXNET_ENGINE_TYPE=NaiveEngine`` (see
+  ``mxnet_tpu.engine``) — kept there, referenced here for discoverability.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["set_nan_check", "nan_guard", "check_nan"]
+
+
+def set_nan_check(enabled: bool):
+    """Enable/disable global NaN detection (jax_debug_nans)."""
+    jax.config.update("jax_debug_nans", bool(enabled))
+
+
+@contextlib.contextmanager
+def nan_guard():
+    """Scope with NaN detection active."""
+    prev = jax.config.jax_debug_nans
+    jax.config.update("jax_debug_nans", True)
+    try:
+        yield
+    finally:
+        jax.config.update("jax_debug_nans", prev)
+
+
+def check_nan(arr, name="array"):
+    """Raise MXNetError if ``arr`` contains NaN/Inf (host sync point)."""
+    a = arr.asnumpy() if isinstance(arr, NDArray) else _np.asarray(
+        jax.device_get(arr)
+    )
+    if not _np.isfinite(a).all():
+        n_nan = int(_np.isnan(a).sum())
+        n_inf = int(_np.isinf(a).sum())
+        raise MXNetError(
+            f"{name} contains {n_nan} NaN and {n_inf} Inf values "
+            f"(shape {a.shape})"
+        )
+    return arr

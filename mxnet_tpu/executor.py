@@ -4,7 +4,13 @@
 ``simple_bind``'s whole pipeline — InferShape, PlanMemory, AttachOpExecs,
 pointwise fusion — is one ``jax.jit`` here: the graph evaluates as a single
 XLA executable; backward is its vjp. Buffer sharing/liveness is XLA's
-problem (it does the reference's PlanMemory job during buffer assignment)."""
+problem (it does the reference's PlanMemory job during buffer assignment).
+
+Auxiliary states (BatchNorm moving_mean/moving_var) follow reference
+semantics: allocated by simple_bind from their ``__init__`` hints, fed to
+the forward, excluded from gradients, and — in ``is_train`` mode — updated
+by the forward pass itself via the momentum moving average (the nnvm
+FMutateInputs role)."""
 
 from __future__ import annotations
 
@@ -18,39 +24,69 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["Executor"]
 
+_INITS = {"zeros": jnp.zeros, "ones": jnp.ones}
+
 
 class Executor:
     def __init__(self, symbol, ctx=None, shapes=None, grad_req="write",
-                 args=None, args_grad=None):
+                 args=None, args_grad=None, aux_states=None):
         self._symbol = symbol
         self._ctx = ctx
         self._grad_req = grad_req
         self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        var_attrs = symbol._var_attrs()
         self.arg_dict: Dict[str, NDArray] = {}
         self.grad_dict: Dict[str, NDArray] = {}
         self.aux_dict: Dict[str, NDArray] = {}
+
+        inferred = None
+        if shapes:
+            needed = [n for n in self._arg_names + self._aux_names
+                      if n not in shapes]
+            if needed:
+                # fill parameter/aux shapes from data shapes (nnvm
+                # InferShape role — Symbol._infer_all_shapes)
+                inferred = symbol._infer_all_shapes(
+                    {k: tuple(v) for k, v in shapes.items()}
+                )
+            else:
+                inferred = {k: tuple(v) for k, v in shapes.items()}
+
         if args is not None:
             if isinstance(args, dict):
                 self.arg_dict = dict(args)
             else:
                 self.arg_dict = dict(zip(self._arg_names, args))
-        elif shapes:
-            missing = [n for n in self._arg_names if n not in shapes]
-            if missing:
-                # infer parameter shapes from the data shapes (the nnvm
-                # InferShape role — see Symbol._infer_all_shapes)
-                shapes = symbol._infer_all_shapes(
-                    {k: tuple(v) for k, v in shapes.items()}
-                )
+        elif inferred is not None:
             for name in self._arg_names:
-                if name in shapes:
+                if name in inferred:
                     self.arg_dict[name] = NDArray(
-                        jnp.zeros(shapes[name], jnp.float32)
+                        jnp.zeros(inferred[name], jnp.float32)
                     )
                 else:
                     raise MXNetError(
                         f"simple_bind needs a shape for argument {name}"
                     )
+
+        if aux_states is not None:
+            if isinstance(aux_states, dict):
+                self.aux_dict = dict(aux_states)
+            else:
+                self.aux_dict = dict(zip(self._aux_names, aux_states))
+        elif inferred is not None:
+            for name in self._aux_names:
+                if name not in inferred:
+                    raise MXNetError(
+                        f"simple_bind cannot infer aux state {name}"
+                    )
+                init = _INITS[
+                    var_attrs.get(name, {}).get("__init__", "zeros")
+                ]
+                self.aux_dict[name] = NDArray(
+                    init(inferred[name], jnp.float32)
+                )
+
         if args_grad is not None:
             if isinstance(args_grad, dict):
                 self.grad_dict = dict(args_grad)
@@ -62,12 +98,48 @@ class Executor:
                 for n, a in self.arg_dict.items()
             }
         self.outputs: List[NDArray] = []
-        self._fwd = jax.jit(self._run)
+
+        # BatchNorm nodes: (node, moving_mean name, moving_var name,
+        # momentum) for the forward-side aux update in train mode
+        self._bn_nodes = []
+        aux_set = set(self._aux_names)
+        for node in symbol.get_internals()._inputs:
+            if node._op == "BatchNorm" and len(node._inputs) >= 5:
+                mm, mv = node._inputs[3], node._inputs[4]
+                # only AUX-marked moving stats get the forward-side update;
+                # explicit argument-style moving_mean/var (the 5-positional
+                # construction) stay plain arguments the user manages
+                if (mm._is_var() and mv._is_var()
+                        and mm._name in aux_set and mv._name in aux_set):
+                    self._bn_nodes.append(
+                        (node, mm._name, mv._name,
+                         float(node._attrs.get("momentum", 0.9)))
+                    )
+
+        self._fwd = jax.jit(lambda v: self._run(v, False))
+        self._fwd_train = jax.jit(lambda v: self._run(v, True))
         self._vjp_fn = None
 
-    def _run(self, values):
-        out = self._symbol._eval(dict(values), {})
-        return out if isinstance(out, tuple) else (out,)
+    def _run(self, values, training):
+        from .symbol.symbol import train_mode_scope
+
+        cache: Dict[int, object] = {}
+        with train_mode_scope(training):
+            out = self._symbol._eval(dict(values), cache)
+        outs = out if isinstance(out, tuple) else (out,)
+        # a multi-output op as the bound head (e.g. BatchNorm's internal
+        # (out, mean, var)) exposes only its declared output count —
+        # otherwise backward() would feed ones-cotangents into the extras
+        if self._symbol._op is not None and self._symbol._out_index is None:
+            outs = outs[: self._symbol._num_outputs]
+        # batch stats of every BatchNorm node (outputs 1, 2) for the aux
+        # moving update; nodes are in the cache after evaluation
+        stats = tuple(
+            (cache[id(node)][1], cache[id(node)][2])
+            for node, _, _, _ in self._bn_nodes
+            if id(node) in cache
+        )
+        return outs, stats
 
     @property
     def arg_arrays(self):
@@ -76,6 +148,10 @@ class Executor:
     @property
     def grad_arrays(self):
         return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
 
     def forward(self, is_train=False, **kwargs):
         for k, v in kwargs.items():
@@ -88,11 +164,29 @@ class Executor:
                     jnp.asarray(v)
                 )
         values = {n: a.data for n, a in self.arg_dict.items()}
+        values.update({n: a.data for n, a in self.aux_dict.items()})
         if is_train and self._grad_req != "null":
-            outs, self._vjp_fn = jax.vjp(self._run, values)
-        else:
-            outs = self._fwd(values)
+            # batch stats ride along as vjp aux (not differentiated)
+            outs, self._vjp_fn, stats = jax.vjp(
+                lambda v: self._run(v, True), values, has_aux=True
+            )
+        elif is_train:
+            outs, stats = self._fwd_train(values)
             self._vjp_fn = None
+        else:
+            outs, stats = self._fwd(values)
+            self._vjp_fn = None
+        if is_train and stats:
+            # reference aux update: moving = m*moving + (1-m)*batch
+            for (node, mm, mv, momentum), (bmean, bvar) in zip(
+                self._bn_nodes, stats
+            ):
+                self.aux_dict[mm]._rebind(
+                    momentum * self.aux_dict[mm].data + (1 - momentum) * bmean
+                )
+                self.aux_dict[mv]._rebind(
+                    momentum * self.aux_dict[mv].data + (1 - momentum) * bvar
+                )
         self.outputs = [NDArray(o) for o in outs]
         return self.outputs
 
@@ -111,7 +205,7 @@ class Executor:
         (grads,) = self._vjp_fn(cts)
         for name, g in grads.items():
             if name not in self.grad_dict or self.grad_dict[name] is None:
-                continue
+                continue  # aux states and null-grad args take no gradient
             if self._grad_req == "add":
                 self.grad_dict[name]._rebind(self.grad_dict[name].data + g)
             elif self._grad_req == "write":
@@ -127,6 +221,15 @@ class Executor:
                 )
             elif not allow_extra_params:
                 raise MXNetError(f"extra parameter {name}")
+        if aux_params:
+            for name, arr in aux_params.items():
+                if name in self.aux_dict:
+                    self.aux_dict[name]._rebind(
+                        arr.data if isinstance(arr, NDArray)
+                        else jnp.asarray(arr)
+                    )
+                elif not allow_extra_params:
+                    raise MXNetError(f"extra aux state {name}")
 
     def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
         shapes = {n: tuple(a.shape) for n, a in self.arg_dict.items()}

@@ -97,6 +97,11 @@ class Module:
                 )
             else:
                 initializer(_init.InitDesc(name), arr)
+        if aux_params:
+            # trained BN moving stats etc. (reference: set_params copies
+            # aux states into the executor alongside args)
+            self._exec.copy_params_from({}, aux_params,
+                                        allow_extra_params=allow_extra)
         self.params_initialized = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",

@@ -54,7 +54,8 @@ class RowSparseNDArray(BaseSparseNDArray):
         return NDArray(jnp.take(self.data, self.indices.data.astype(jnp.int32), axis=0))
 
     def retain(self, indices) -> "RowSparseNDArray":
-        keep = jnp.zeros((self.shape[0],), bool).at[_unwrap(indices).astype(jnp.int32)].set(True)
+        idx = jnp.asarray(_unwrap(indices)).astype(jnp.int32)
+        keep = jnp.zeros((self.shape[0],), bool).at[idx].set(True)
         out = jnp.where(keep.reshape((-1,) + (1,) * (self.ndim - 1)), self.data, 0)
         return RowSparseNDArray(out)
 

@@ -9,11 +9,54 @@ jit-traceable) and keyword args as static parameters.
 from __future__ import annotations
 
 
+def _is_arraylike(a):
+    # NDArray / jax / numpy arrays and numpy scalars — things autograd can
+    # track or jax can differentiate; plain python ints/tuples (axis, shape,
+    # split points) must stay STATIC or they get vjp-traced under record()
+    return hasattr(a, "shape") and hasattr(a, "dtype")
+
+
 def make_wrapper(jfn, prefix: str):
     def fn(*args, **kwargs):
         from ..imperative import invoke_fn
 
-        return invoke_fn(lambda *xs: jfn(*xs, **kwargs), *args)
+        # expand one level of list/tuple-of-arrays (stack, concatenate,
+        # vstack, ...) so each element dispatches as its own operand —
+        # autograd then records/propagates per element; non-array
+        # positionals (axis ints etc.) are closed over statically
+        spec = []
+        flat = []
+        statics = []
+        for a in args:
+            if isinstance(a, (list, tuple)) and a and all(
+                _is_arraylike(x) for x in a
+            ):
+                spec.append(len(a))
+                flat.extend(a)
+            elif _is_arraylike(a):
+                spec.append("arr")
+                flat.append(a)
+            else:
+                # python scalars, axis ints, shape tuples, None, strings:
+                # closed over statically (they never carry gradients, and
+                # a traced positional axis breaks jnp under record())
+                spec.append(None)
+                statics.append(a)
+
+        def call(*xs):
+            it = iter(xs)
+            st = iter(statics)
+            rebuilt = []
+            for s in spec:
+                if s is None:
+                    rebuilt.append(next(st))
+                elif s == "arr":
+                    rebuilt.append(next(it))
+                else:
+                    rebuilt.append([next(it) for _ in range(s)])
+            return jfn(*rebuilt, **kwargs)
+
+        return invoke_fn(call, *flat)
 
     fn.__name__ = jfn.__name__
     fn.__qualname__ = jfn.__name__

@@ -1,0 +1,47 @@
+"""ONNX interchange (reference: ``python/mxnet/onnx`` mx2onnx converters
+[unverified]).
+
+Availability-gated: this environment ships no ``onnx`` package (zero
+egress), so converters cannot build or validate real ModelProto graphs and
+are NOT shipped half-written. The deployment-interchange role the
+reference filled with ONNX is served first-class by the StableHLO export
+path (``HybridBlock.export`` -> ``SymbolBlock.imports`` over
+``jax.export``), which round-trips compiled graphs without Python model
+code and without an intermediate op-by-op converter layer.
+
+API surface matches the reference entry points so callers get a precise
+error (with the supported alternative) rather than an AttributeError.
+"""
+
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["export_model", "import_model", "is_available"]
+
+_GATE_MSG = (
+    "the 'onnx' package is not installed in this environment, so ONNX "
+    "{what} is unavailable; for compiled-graph deployment use "
+    "HybridBlock.export (StableHLO via jax.export), which "
+    "SymbolBlock.imports reloads"
+)
+
+
+def is_available() -> bool:
+    try:
+        import onnx  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def export_model(sym, params, input_shapes=None, input_types=None,
+                 onnx_file_path="model.onnx", **kwargs):
+    """Reference: ``mx.onnx.export_model`` — gated on the onnx package."""
+    raise MXNetError(_GATE_MSG.format(what="export"))
+
+
+def import_model(onnx_file_path):
+    """Reference: ``mx.onnx.import_model`` — gated on the onnx package."""
+    raise MXNetError(_GATE_MSG.format(what="import"))

@@ -175,8 +175,35 @@ class MXIndexedRecordIO(MXRecordIO):
         self.record.seek(self.idx[idx])
 
     def read_idx(self, idx):
+        if self.flag == "r":
+            self._check_pid()  # fork guard before touching self.record
+            # native fast path: C++ framing scan + direct copy (reference:
+            # dmlc RecordIOReader); falls back to the Python reader
+            nr = self._native_reader()
+            if nr is not None:
+                try:
+                    buf, end = nr.read_at(self.idx[idx])
+                    # keep read_idx == seek+read semantics: position the
+                    # Python handle after the record for subsequent read()
+                    self.record.seek(end)
+                    return buf
+                except (KeyError, RuntimeError):
+                    pass
         self.seek(idx)
         return self.read()
+
+    def _native_reader(self):
+        if getattr(self, "_nr_pid", None) != os.getpid():
+            self._nr = None
+            self._nr_pid = os.getpid()
+            from . import _native
+
+            if _native.available():
+                try:
+                    self._nr = _native.NativeRecordReader(self.uri)
+                except RuntimeError:
+                    self._nr = None
+        return self._nr
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)
@@ -257,6 +284,16 @@ def _encode_image(img, quality, img_fmt):
 
 
 def _decode_image(img_bytes, iscolor=-1):
+    if iscolor == 1:
+        # native libjpeg path for force-color decodes (reference: the C++
+        # image pipeline over libjpeg-turbo); BGR like cv2, None on
+        # non-JPEG. iscolor=-1 ("unchanged") must preserve grayscale as
+        # 2-D, which the native path does not — fall through for it.
+        from . import _native
+
+        img = _native.jpeg_decode(bytes(img_bytes))
+        if img is not None:
+            return img
     try:
         import cv2
 

@@ -5,10 +5,46 @@ frontend (SURVEY.md §1 key fact)."""
 from __future__ import annotations
 
 from ..ops import registry as _registry
-from .symbol import Symbol
+from .symbol import Symbol, Variable, _next_name
+
+# Parameter inputs auto-created as hidden variables named
+# ``{opname}_{suffix}`` when the caller passes data only — reference
+# behavior (nnvm FListInputNames + the Python name manager [unverified]);
+# ``Module`` relies on it to discover arg names like ``c1_weight``.
+_AUTO_PARAMS = {
+    "FullyConnected": ("weight", "bias"),
+    "Convolution": ("weight", "bias"),
+    "Deconvolution": ("weight", "bias"),
+    "BatchNorm": ("gamma", "beta", "moving_mean", "moving_var"),
+    "InstanceNorm": ("gamma", "beta"),
+    "GroupNorm": ("gamma", "beta"),
+    "LayerNorm": ("gamma", "beta"),
+    "Embedding": ("weight",),
+    "SoftmaxOutput": ("label",),
+}
+
+# suffixes that are AUXILIARY STATES, not trainable arguments (reference:
+# nnvm FMutateInputs — updated by forward, no gradients); the attr carries
+# the simple_bind initialization
+_AUX_ATTRS = {
+    "moving_mean": {"__aux__": True, "__init__": "zeros"},
+    "moving_var": {"__aux__": True, "__init__": "ones"},
+}
+
+
+def _no_bias_default(op):
+    import inspect
+
+    try:
+        p = inspect.signature(op.fn).parameters.get("no_bias")
+        return bool(p.default) if p is not None else False
+    except (TypeError, ValueError):  # pragma: no cover
+        return False
 
 
 def _make_sym_func(op):
+    no_bias_default = _no_bias_default(op)
+
     def sym_func(*args, name=None, **kwargs):
         inputs = [a for a in args if isinstance(a, Symbol)]
         if len(inputs) != len(args):
@@ -16,6 +52,20 @@ def _make_sym_func(op):
                 f"sym.{op.name} expects Symbol inputs; got "
                 f"{[type(a).__name__ for a in args]}"
             )
+        suffixes = _AUTO_PARAMS.get(op.name)
+        if suffixes is not None:
+            want = list(suffixes)
+            if kwargs.get("no_bias", no_bias_default) and "bias" in want:
+                want.remove("bias")
+            expected = 1 + len(want)
+            if 0 < len(inputs) < expected:
+                if name is None:
+                    name = _next_name(op.name.lower())
+                for suffix in want[len(inputs) - 1:]:
+                    v = Variable(f"{name}_{suffix}")
+                    if suffix in _AUX_ATTRS:
+                        v._attrs.update(_AUX_ATTRS[suffix])
+                    inputs.append(v)
         return Symbol(op.name, inputs, attrs=kwargs, name=name,
                       num_outputs=op.num_outputs or 1)
 

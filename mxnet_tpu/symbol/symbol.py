@@ -11,6 +11,7 @@ without maintaining a second IR."""
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 import json
 from typing import Dict, List, Optional, Sequence
 
@@ -24,6 +25,31 @@ from ..ops import registry as _registry
 __all__ = ["Symbol", "Variable", "var", "Group", "load", "load_json"]
 
 _UID = [0]
+
+
+# nnvm semantics: a multi-output node fed to a consumer without explicit
+# indexing contributes its FIRST output (reference: NodeEntry default)
+def _first_output(sym, value):
+    if isinstance(value, tuple) and sym._op is not None \
+            and sym._out_index is None:
+        return value[0]
+    return value
+
+
+# ops whose kernels switch on train/predict mode (reference: ops reading
+# ``ctx.is_train``); the executor sets the mode around evaluation
+_MODE_OPS = {"BatchNorm", "Dropout"}
+_TRAIN_MODE = [False]
+
+
+@_contextlib.contextmanager
+def train_mode_scope(flag: bool):
+    prev = _TRAIN_MODE[0]
+    _TRAIN_MODE[0] = bool(flag)
+    try:
+        yield
+    finally:
+        _TRAIN_MODE[0] = prev
 
 
 def _next_name(hint):
@@ -58,7 +84,7 @@ class Symbol:
     def _is_var(self):
         return self._op is None and not self._inputs
 
-    def list_arguments(self) -> List[str]:
+    def _walk_vars(self, predicate) -> List[str]:
         seen, order = set(), []
 
         def walk(s):
@@ -67,11 +93,26 @@ class Symbol:
             seen.add(id(s))
             for i in s._inputs:
                 walk(i)
-            if s._is_var():
+            if s._is_var() and predicate(s):
                 order.append(s._name)
 
         walk(self)
         return order
+
+    def list_arguments(self) -> List[str]:
+        return self._walk_vars(lambda s: not s._attrs.get("__aux__"))
+
+    def list_auxiliary_states(self) -> List[str]:
+        """Aux-state variables (reference: BatchNorm moving_mean/var —
+        updated by forward, excluded from gradients)."""
+        return self._walk_vars(lambda s: bool(s._attrs.get("__aux__")))
+
+    def _var_attrs(self) -> Dict[str, dict]:
+        return {
+            s._name: s._attrs
+            for s in self.get_internals()._inputs
+            if s._is_var()
+        }
 
     def list_outputs(self) -> List[str]:
         if self._op is None and self._inputs:  # group
@@ -129,13 +170,22 @@ class Symbol:
                 raise MXNetError(f"missing value for argument {self._name}")
             out = values[self._name]
             cache[id(self)] = out
-        elif self._op is None:  # group
-            out = tuple(i._eval(values, cache) for i in self._inputs)
+        elif self._op is None:  # group: members contribute first outputs
+            out = tuple(
+                _first_output(i, i._eval(values, cache))
+                for i in self._inputs
+            )
             cache[id(self)] = out
         else:
             op = _registry.get(self._op)
-            args = [i._eval(values, cache) for i in self._inputs]
-            out = op.fn(*args, **self._attrs)
+            args = [_first_output(i, i._eval(values, cache))
+                    for i in self._inputs]
+            attrs = self._attrs
+            if self._op in _MODE_OPS and "training" not in attrs:
+                # executor-driven train/predict mode (reference: is_train on
+                # the graph executor; nnvm ops read the mode, not an attr)
+                attrs = dict(attrs, training=_TRAIN_MODE[0])
+            out = op.fn(*args, **attrs)
             cache[id(self)] = out
         if self._out_index is not None:
             return out[self._out_index]
@@ -151,6 +201,10 @@ class Symbol:
         }
         out = self._eval(values, {})
         outs = out if isinstance(out, tuple) else (out,)
+        # a multi-output op head exposes only its declared output count
+        # (internal extras like BatchNorm batch stats stay internal)
+        if self._op is not None and self._out_index is None:
+            outs = outs[: self._num_outputs]
         return [NDArray(o) for o in outs]
 
     # ----------------------------------------------------------- shape/type
@@ -205,7 +259,10 @@ class Symbol:
             else:
                 in_specs = []
                 rule = _PARAM_SHAPE_RULES.get(s._op)
-                first = out_shape(s._inputs[0]) if s._inputs else None
+                first = None
+                if s._inputs:
+                    first = _first_output(s._inputs[0],
+                                          out_shape(s._inputs[0]))
                 for pos, inp in enumerate(s._inputs):
                     if (inp._is_var() and inp._name not in shapes
                             and rule is not None and pos > 0):
@@ -216,7 +273,7 @@ class Symbol:
                                 f"(input {pos} of {s._op})"
                             )
                         shapes[inp._name] = inferred
-                    in_specs.append(out_shape(inp))
+                    in_specs.append(_first_output(inp, out_shape(inp)))
                 op = _registry.get(s._op)
                 try:
                     res = jax.eval_shape(
@@ -243,7 +300,7 @@ class Symbol:
         from ..executor import Executor
 
         return Executor(self, ctx, None, grad_req, args=args,
-                        args_grad=args_grad)
+                        args_grad=args_grad, aux_states=aux_states)
 
     # ---------------------------------------------------------- arithmetic
     def _binop(self, other, opname, reverse=False):
@@ -463,6 +520,13 @@ def _embed_rule(pos, data_shape, attrs):
     return None
 
 
+def _softmax_output_rule(pos, data_shape, attrs):
+    # label: one class index per row (enables label-less inference binds)
+    if pos == 1:
+        return tuple(data_shape[:-1])
+    return None
+
+
 # pos -> expected shape given the first input's shape and op attrs
 # (reference: per-op FInferShape attrs on the nnvm registry [unverified])
 _PARAM_SHAPE_RULES = {
@@ -474,4 +538,5 @@ _PARAM_SHAPE_RULES = {
     "GroupNorm": _bn_rule,
     "LayerNorm": _ln_rule,
     "Embedding": _embed_rule,
+    "SoftmaxOutput": _softmax_output_rule,
 }

@@ -92,8 +92,8 @@ def rand_shape_nd(num_dim, dim=10):
 
 def rand_ndarray(shape, stype="default", density=None, dtype=None,
                  distribution="uniform"):
-    if stype != "default":
-        raise MXNetError("sparse rand_ndarray is not supported (dense build)")
+    """Random array incl. sparse stypes (reference: test_utils.rand_ndarray;
+    sparse here is the dense-backed facade with real sparsity pattern)."""
     if distribution == "uniform":
         data = _np.random.uniform(-1, 1, size=shape)
     elif distribution == "normal":
@@ -102,7 +102,29 @@ def rand_ndarray(shape, stype="default", density=None, dtype=None,
         data = _np.random.pareto(2.0, size=shape)
     else:
         raise MXNetError(f"unknown distribution {distribution}")
-    return nd_array(data.astype(dtype or "float32"))
+    data = data.astype(dtype or "float32")
+    if stype == "default":
+        return nd_array(data)
+    density = 0.5 if density is None else float(density)
+    if stype == "row_sparse":
+        from .ndarray.sparse import RowSparseNDArray
+
+        keep = _np.random.uniform(size=shape[0]) < density
+        data[~keep] = 0
+        return RowSparseNDArray(_jnp_asarray(data))
+    if stype == "csr":
+        from .ndarray.sparse import CSRNDArray
+
+        mask = _np.random.uniform(size=shape) < density
+        data = data * mask
+        return CSRNDArray(_jnp_asarray(data))
+    raise MXNetError(f"unknown stype {stype}")
+
+
+def _jnp_asarray(a):
+    import jax.numpy as jnp
+
+    return jnp.asarray(a)
 
 
 def same(a, b):

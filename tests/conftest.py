@@ -16,6 +16,10 @@ os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
 ).strip()
 _platform = os.environ.get("MXTPU_TEST_PLATFORM", "cpu")
+if _platform != "cpu":
+    # keep the host backend registered alongside the accelerator so
+    # ctx=mx.cpu() placement (reference semantics) stays real on TPU runs
+    _platform = f"{_platform},cpu"
 os.environ["JAX_PLATFORMS"] = _platform
 
 import jax

@@ -1,0 +1,41 @@
+"""Smoke-run the shipped examples (reference kept examples runnable in CI
+via small synthetic configs [unverified])."""
+
+import os
+import subprocess
+import sys
+
+_EX = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(script, *args):
+    env = dict(os.environ)
+    r = subprocess.run(
+        [sys.executable, os.path.join(_EX, script), *args],
+        capture_output=True, text=True, env=env, timeout=420,
+    )
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    return r.stdout
+
+
+def test_gluon_mnist():
+    out = _run("gluon_mnist.py", "--epochs", "1", "--batches-per-epoch", "3",
+               "--batch-size", "8")
+    assert "epoch 0" in out
+
+
+def test_module_lenet():
+    out = _run("module_lenet.py", "--epochs", "1", "--num-examples", "64",
+               "--batch-size", "32")
+    assert "validation" in out
+
+
+def test_distributed_train():
+    out = _run("distributed_train.py", "--steps", "6", "--batch-size", "8")
+    assert "done" in out
+
+
+def test_distributed_train_tp():
+    out = _run("distributed_train.py", "--steps", "4", "--batch-size", "8",
+               "--tp", "2", "--force-cpu")
+    assert "done" in out

@@ -1,0 +1,68 @@
+"""tools/im2rec.py end-to-end: images dir -> .lst/.rec/.idx -> ImageIter.
+
+Reference flow: ``tools/im2rec.py`` then ``mx.image.ImageIter`` over the
+.rec (the reference's standard data-prep path [unverified])."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import im2rec  # noqa: E402
+
+
+@pytest.fixture()
+def image_tree(tmp_path):
+    from PIL import Image
+
+    rng = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / "imgs" / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            arr = (rng.rand(40, 48, 3) * 255).astype(np.uint8)
+            Image.fromarray(arr).save(d / f"{cls}{i}.jpg")
+    return str(tmp_path / "imgs"), str(tmp_path / "data")
+
+
+def test_list_generation(image_tree):
+    root, prefix = image_tree
+    assert im2rec.main([prefix, root, "--list"]) == 0
+    lines = open(prefix + ".lst").read().strip().splitlines()
+    assert len(lines) == 6
+    labels = {float(l.split("\t")[1]) for l in lines}
+    assert labels == {0.0, 1.0}  # cat=0, dog=1
+
+
+def test_pack_and_read_back(image_tree):
+    root, prefix = image_tree
+    im2rec.main([prefix, root, "--list"])
+    assert im2rec.main([prefix, root, "--resize", "32"]) == 0
+    assert os.path.exists(prefix + ".rec")
+    assert os.path.exists(prefix + ".idx")
+
+    from mxnet_tpu import recordio
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    header, img = recordio.unpack_img(rec.read_idx(0))
+    assert header.label in (0.0, 1.0)
+    assert img.ndim == 3 and min(img.shape[:2]) == 32
+    rec.close()
+
+
+def test_imageiter_over_rec(image_tree):
+    root, prefix = image_tree
+    im2rec.main([prefix, root, "--list"])
+    im2rec.main([prefix, root, "--resize", "36"])
+
+    from mxnet_tpu import image as mx_image
+
+    it = mx_image.ImageIter(
+        batch_size=2, data_shape=(3, 32, 32), path_imgrec=prefix + ".rec",
+        path_imgidx=prefix + ".idx", rand_crop=False, shuffle=False,
+    )
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    assert batch.label[0].shape == (2,)

@@ -1,0 +1,108 @@
+"""INT8 quantization (reference: ``python/mxnet/contrib/quantization.py``
+naive-calibration flow [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization as q
+from mxnet_tpu.gluon import nn
+
+
+def _r(*shape, seed=0):
+    return np.random.RandomState(seed).randn(*shape).astype(np.float32)
+
+
+class TestOps:
+    def test_quantize_dequantize_roundtrip(self):
+        x = nd.array(_r(16, 16))
+        qx, mn, mx_ = nd._contrib_quantize_v2(x)
+        assert qx.asnumpy().dtype == np.int8
+        back = nd._contrib_dequantize(qx, mn, mx_)
+        # int8 symmetric: error bounded by one quantum
+        quantum = max(abs(float(mn.asnumpy())), abs(float(mx_.asnumpy()))) / 127
+        assert np.abs(back.asnumpy() - x.asnumpy()).max() <= quantum + 1e-6
+
+    def test_calib_range_clips(self):
+        # 0.6 avoids the .5 rounding boundary (TPU f32 division lands a
+        # hair below 63.5 and rounds differently than host)
+        x = nd.array(np.array([[-10.0, 0.6, 10.0]], np.float32))
+        qx, mn, mx_ = nd._contrib_quantize_v2(
+            x, min_calib_range=-1.0, max_calib_range=1.0
+        )
+        np.testing.assert_array_equal(
+            qx.asnumpy(), np.array([[-127, 76, 127]], np.int8)
+        )
+
+
+class TestQuantizeNet:
+    def _net(self):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
+        net.initialize()
+        return net
+
+    def test_quantized_forward_close_to_float(self):
+        net = self._net()
+        calib = [nd.array(_r(16, 12, seed=s)) for s in range(4)]
+        ref = net(calib[0]).asnumpy()
+        q.quantize_net(net, calib_data=[(c,) for c in calib])
+        out = net(calib[0]).asnumpy()
+        # int8 per-tensor keeps ~1% relative error on random data
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+    def test_quantized_weights_are_int8(self):
+        net = self._net()
+        calib = [nd.array(_r(8, 12))]
+        q.quantize_net(net, calib_data=[(c,) for c in calib])
+        qd = list(net._children.values())[0]._q
+        assert np.asarray(qd._w_q_t).dtype == np.int8
+
+    def test_requires_calib_data(self):
+        net = self._net()
+        net(nd.array(_r(2, 12)))
+        with pytest.raises(mx.base.MXNetError):
+            q.quantize_net(net)
+
+    def test_no_dense_raises(self):
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(4, kernel_size=1))
+        net.initialize()
+        with pytest.raises(mx.base.MXNetError):
+            q.quantize_net(net, calib_data=[])
+
+
+class TestReviewRegressions:
+    def test_attribute_style_block_quantized(self):
+        """Blocks calling children via attributes (self.fc) must actually
+        run the quantized layer after quantize_net."""
+        from mxnet_tpu import gluon
+
+        class Net(gluon.Block):
+            def __init__(self):
+                super().__init__()
+                with self.name_scope():
+                    self.fc = nn.Dense(8)
+
+            def forward(self, x):
+                return self.fc(x)
+
+        net = Net()
+        net.initialize()
+        calib = [nd.array(_r(16, 4, seed=s) * 3) for s in range(2)]
+        ref = net(calib[0]).asnumpy()
+        q.quantize_net(net, calib_data=[(c,) for c in calib])
+        out = net(calib[0]).asnumpy()
+        assert not np.array_equal(out, ref)  # int8 path actually ran
+        err = np.abs(out - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert err < 0.05, err
+
+    def test_save_parameters_after_quantize(self, tmp_path):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+        net.initialize()
+        calib = [nd.array(_r(8, 4))]
+        q.quantize_net(net, calib_data=[(c,) for c in calib])
+        net.save_parameters(str(tmp_path / "q.params"))  # must not raise

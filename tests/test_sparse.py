@@ -1,0 +1,134 @@
+"""Sparse NDArray facade (VERDICT weak #6): row_sparse/csr creation,
+metadata, conversion, retain, sparse dot, kvstore interplay, and the
+sparse-embedding training path (dense scatter-add on TPU replacing the
+reference's row_sparse gradient machinery,
+``src/operator/tensor/dot.cc`` + embedding sparse-grad [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.test_utils import rand_ndarray
+
+
+class TestCreation:
+    def test_row_sparse_from_values_indices(self):
+        vals = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        rs = sparse.row_sparse_array((vals, [1, 3]), shape=(5, 2))
+        assert rs.stype == "row_sparse"
+        assert rs.shape == (5, 2)
+        dense = rs.asnumpy()
+        np.testing.assert_allclose(dense[1], [1.0, 2.0])
+        np.testing.assert_allclose(dense[3], [3.0, 4.0])
+        np.testing.assert_allclose(dense[0], [0.0, 0.0])
+        np.testing.assert_array_equal(rs.indices.asnumpy(), [1, 3])
+        np.testing.assert_allclose(rs.values.asnumpy(), vals)
+
+    def test_csr_metadata(self):
+        dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 0]], np.float32)
+        csr = sparse.CSRNDArray(mx.nd.array(dense).data)
+        np.testing.assert_array_equal(csr.indptr.asnumpy(), [0, 1, 3, 3])
+        np.testing.assert_array_equal(csr.indices.asnumpy(), [1, 0, 2])
+        np.testing.assert_allclose(csr.values.asnumpy(), [1, 2, 3])
+
+    def test_tostype_round_trip(self):
+        rs = rand_ndarray((6, 3), "row_sparse", density=0.5)
+        d = rs.tostype("default")
+        np.testing.assert_allclose(d.asnumpy(), rs.asnumpy())
+        with pytest.raises(mx.base.MXNetError):
+            rs.tostype("csr")
+
+    def test_rand_ndarray_sparse(self):
+        rs = rand_ndarray((50, 4), "row_sparse", density=0.3)
+        frac = (np.abs(rs.asnumpy()).sum(axis=1) > 0).mean()
+        assert 0.05 < frac < 0.65
+        csr = rand_ndarray((20, 20), "csr", density=0.2)
+        nnz_frac = (csr.asnumpy() != 0).mean()
+        assert 0.05 < nnz_frac < 0.4
+
+
+class TestOpsOverSparse:
+    def test_retain(self):
+        rs = sparse.row_sparse_array(
+            (np.ones((3, 2), np.float32), [0, 2, 4]), shape=(5, 2)
+        )
+        kept = rs.retain([0, 4])
+        out = kept.asnumpy()
+        np.testing.assert_allclose(out[0], [1, 1])
+        np.testing.assert_allclose(out[2], [0, 0])  # dropped
+        np.testing.assert_allclose(out[4], [1, 1])
+
+    def test_dense_dot_with_csr(self):
+        csr = rand_ndarray((8, 5), "csr", density=0.4)
+        w = rand_ndarray((5, 3))
+        out = nd.dot(csr, w)
+        np.testing.assert_allclose(
+            out.asnumpy(), csr.asnumpy() @ w.asnumpy(), rtol=1e-5
+        )
+
+    def test_kvstore_push_sparse_facade(self):
+        kv = mx.kv.create("local")
+        kv.init("e", nd.zeros((6, 2)))
+        g = sparse.row_sparse_array(
+            (np.ones((2, 2), np.float32), [1, 4]), shape=(6, 2)
+        )
+        kv.push("e", g)
+        out = nd.zeros((6, 2))
+        kv.pull("e", out=out)
+        np.testing.assert_allclose(out.asnumpy()[1], [1, 1])
+        np.testing.assert_allclose(out.asnumpy()[0], [0, 0])
+
+
+class TestSparseEmbeddingTraining:
+    def test_embedding_grad_is_scatter(self):
+        """The reference's row_sparse embedding gradient == our dense
+        scatter-add: only looked-up rows receive gradient."""
+        emb = gluon.nn.Embedding(10, 4)
+        emb.initialize()
+        ids = nd.array(np.array([1, 3, 3], np.int32), dtype="int32")
+        emb.weight.data()  # materialize
+        trainer = gluon.Trainer(emb.collect_params(), "sgd",
+                                {"learning_rate": 1.0})
+        before = emb.weight.data().asnumpy().copy()
+        with autograd.record():
+            out = emb(ids)
+            out.sum().backward()
+        g = emb.weight.grad().asnumpy()
+        assert np.all(g[1] == 1.0)
+        assert np.all(g[3] == 2.0)  # id 3 appears twice: accumulated
+        untouched = [i for i in range(10) if i not in (1, 3)]
+        assert np.all(g[untouched] == 0.0)
+        trainer.step(1)
+        after = emb.weight.data().asnumpy()
+        np.testing.assert_allclose(after[untouched], before[untouched])
+        assert not np.allclose(after[1], before[1])
+
+
+class TestDebugAndOnnx:
+    def test_check_nan(self):
+        from mxnet_tpu import debug
+
+        debug.check_nan(nd.ones((2, 2)))  # clean passes
+        bad = nd.array(np.array([1.0, np.nan], np.float32))
+        with pytest.raises(mx.base.MXNetError):
+            debug.check_nan(bad, name="loss")
+
+    def test_nan_guard_restores_flag(self):
+        import jax
+        from mxnet_tpu import debug
+
+        prev = jax.config.jax_debug_nans
+        with debug.nan_guard():
+            assert jax.config.jax_debug_nans
+        assert jax.config.jax_debug_nans == prev
+
+    def test_onnx_gated_with_guidance(self):
+        from mxnet_tpu import onnx as mxonnx
+
+        assert not mxonnx.is_available()
+        with pytest.raises(mx.base.MXNetError, match="StableHLO"):
+            mxonnx.export_model(None, {})
+        with pytest.raises(mx.base.MXNetError, match="StableHLO"):
+            mxonnx.import_model("x.onnx")

@@ -47,7 +47,8 @@ def test_symbol_neg_pow():
     a = sym.var("a")
     expr = -(a ** 2.0)
     (out,) = expr.eval(a=nd.array(np.array([2.0, 3.0], np.float32)))
-    np.testing.assert_allclose(out.asnumpy(), [-4.0, -9.0], rtol=1e-6)
+    # rtol covers the TPU's f32 pow approximation (9.000011 on v5e)
+    np.testing.assert_allclose(out.asnumpy(), [-4.0, -9.0], rtol=1e-5)
 
 
 def test_symbol_op_namespace_eval():
@@ -468,3 +469,152 @@ def test_variable_attrs_json_round_trip():
     v2 = sym.load_json(v.tojson())
     assert v2.attr("lr_mult") == 2
     assert tuple(v2.attr("__shape__")) == (2, 3)
+
+
+class TestBatchNormAux:
+    """BatchNorm moving stats are aux states (reference FMutateInputs
+    semantics), not trainable arguments."""
+
+    def test_aux_excluded_from_arguments(self):
+        import mxnet_tpu as mx
+        x = sym.var("data")
+        y = sym.Activation(sym.BatchNorm(x, name="bn"), act_type="relu")
+        assert "bn_moving_mean" not in y.list_arguments()
+        assert y.list_auxiliary_states() == ["bn_moving_mean",
+                                             "bn_moving_var"]
+
+    def test_simple_bind_inits_and_updates_aux(self):
+        x = sym.var("data")
+        y = sym.BatchNorm(x, name="bn", momentum=0.5)
+        ex = y.simple_bind(data=(8, 3))
+        np.testing.assert_allclose(ex.aux_dict["bn_moving_var"].asnumpy(),
+                                   np.ones(3))
+        np.testing.assert_allclose(ex.aux_dict["bn_moving_mean"].asnumpy(),
+                                   np.zeros(3))
+        rng = np.random.RandomState(0)
+        data = (rng.rand(8, 3) * 4 + 2).astype(np.float32)
+        ex.forward(is_train=True, data=nd.array(data))
+        # moving = 0.5*init + 0.5*batch
+        np.testing.assert_allclose(
+            ex.aux_dict["bn_moving_mean"].asnumpy(),
+            0.5 * data.mean(axis=0), rtol=1e-4,
+        )
+        np.testing.assert_allclose(
+            ex.aux_dict["bn_moving_var"].asnumpy(),
+            0.5 * 1.0 + 0.5 * data.var(axis=0), rtol=1e-4,
+        )
+
+    def test_train_uses_batch_stats_predict_uses_moving(self):
+        x = sym.var("data")
+        y = sym.BatchNorm(x, name="bn")
+        ex = y.simple_bind(data=(16, 4))
+        rng = np.random.RandomState(1)
+        data = (rng.rand(16, 4) * 10).astype(np.float32)
+        out_train = ex.forward(is_train=True, data=nd.array(data))[0].asnumpy()
+        # train mode normalizes with batch stats -> ~zero mean, unit var
+        np.testing.assert_allclose(out_train.mean(axis=0), np.zeros(4),
+                                   atol=1e-4)
+        ex2 = y.simple_bind(data=(16, 4))
+        out_pred = ex2.forward(is_train=False,
+                               data=nd.array(data))[0].asnumpy()
+        # predict mode uses moving stats (mean 0, var 1) -> output ~ data
+        np.testing.assert_allclose(out_pred, data, rtol=1e-2, atol=2e-2)
+
+    def test_no_grad_on_aux(self):
+        x = sym.var("data")
+        y = sym.BatchNorm(sym.FullyConnected(x, num_hidden=4, name="fc"),
+                          name="bn")
+        ex = y.simple_bind(data=(8, 3))
+        ex.forward(is_train=True, data=nd.array(_rand(8, 3)))
+        ex.backward()
+        assert "bn_moving_mean" not in ex.grad_dict
+
+    def test_inference_bind_without_label(self):
+        x = sym.var("data")
+        out = sym.SoftmaxOutput(sym.FullyConnected(x, num_hidden=4,
+                                                   name="fc"),
+                                name="softmax")
+        ex = out.simple_bind(grad_req="null", data=(2, 8))
+        res = ex.forward(is_train=False, data=nd.array(_rand(2, 8)))
+        np.testing.assert_allclose(res[0].asnumpy().sum(axis=1),
+                                   np.ones(2), rtol=1e-5)
+
+    def test_deconvolution_no_phantom_bias(self):
+        d = sym.Deconvolution(sym.var("data"), kernel=(2, 2), num_filter=4,
+                              name="dc")
+        assert "dc_bias" not in d.list_arguments()
+
+
+def _rand(*shape):
+    return np.random.RandomState(sum(shape)).rand(*shape).astype(np.float32)
+
+
+class TestAuxReviewRegressions:
+    def test_bind_forwards_aux_states(self):
+        x = sym.var("data")
+        y = sym.BatchNorm(x, name="bn2")
+        aux = {"bn2_moving_mean": nd.array(np.array([1.0, 2.0, 3.0], np.float32)),
+               "bn2_moving_var": nd.ones((3,))}
+        args = {"data": nd.array(_rand(4, 3)),
+                "bn2_gamma": nd.ones((3,)), "bn2_beta": nd.zeros((3,))}
+        ex = y.bind(args=args, aux_states=aux, grad_req="null")
+        (out,) = ex.forward(is_train=False)
+        expect = (args["data"].asnumpy() - np.array([1, 2, 3], np.float32)) \
+            / np.sqrt(1.0 + 1e-3)
+        np.testing.assert_allclose(out.asnumpy(), expect, rtol=1e-4)
+
+    def test_module_set_params_loads_aux(self):
+        x = sym.var("data")
+        net = sym.SoftmaxOutput(sym.BatchNorm(
+            sym.FullyConnected(x, num_hidden=4, name="fc"), name="bn3"),
+            name="softmax")
+        mod = Module(net, data_names=("data",), label_names=("softmax_label",))
+        mod.bind(data_shapes=[("data", (2, 3))],
+                 label_shapes=[("softmax_label", (2,))])
+        aux = {"bn3_moving_mean": nd.ones((4,)) * 5,
+               "bn3_moving_var": nd.ones((4,)) * 2}
+        mod.init_params(aux_params=aux, allow_missing=True)
+        np.testing.assert_allclose(
+            mod._exec.aux_dict["bn3_moving_mean"].asnumpy(), np.full(4, 5.0)
+        )
+
+    def test_explicit_moving_stats_are_plain_args(self):
+        mm, mv = sym.var("mm"), sym.var("mv")
+        g, b = sym.var("g"), sym.var("b")
+        y = sym.BatchNorm(sym.var("data"), g, b, mm, mv, name="bn4")
+        assert "mm" in y.list_arguments()
+        assert y.list_auxiliary_states() == []
+        ex = y.simple_bind(data=(2, 3), g=(3,), b=(3,), mm=(3,), mv=(3,))
+        ex.forward(is_train=True, data=nd.array(_rand(2, 3)),
+                   g=nd.ones((3,)), b=nd.zeros((3,)),
+                   mm=nd.zeros((3,)), mv=nd.ones((3,)))  # must not KeyError
+
+    def test_multi_output_head_backward_single_cotangent(self):
+        x = sym.var("data")
+        y = sym.BatchNorm(sym.FullyConnected(x, num_hidden=2, name="fc5"),
+                          name="bn5")
+        ex = y.simple_bind(data=(4, 3))
+        outs = ex.forward(is_train=True, data=nd.array(_rand(4, 3)))
+        assert len(outs) == 1  # only the declared output surfaces
+        ex.backward()  # ones cotangent for ONE output; no mean/var leakage
+
+
+def test_group_with_batchnorm_member():
+    """Group members with multi-output ops contribute first outputs only."""
+    x = sym.var("data")
+    g = sym.Group([sym.BatchNorm(x, name="bn6"),
+                   sym.FullyConnected(x, num_hidden=2, name="fc6")])
+    ex = g.simple_bind(data=(4, 3), grad_req="null")
+    outs = ex.forward(is_train=False, data=nd.array(_rand(4, 3)))
+    assert len(outs) == 2
+    assert outs[0].shape == (4, 3) and outs[1].shape == (4, 2)
+
+
+def test_batchnorm_head_eval_single_output():
+    x = sym.var("data")
+    y = sym.BatchNorm(x, name="bn7")
+    outs = y.eval(data=nd.array(_rand(2, 3)),
+                  bn7_gamma=nd.ones((3,)), bn7_beta=nd.zeros((3,)),
+                  bn7_moving_mean=nd.zeros((3,)),
+                  bn7_moving_var=nd.ones((3,)))
+    assert len(outs) == 1  # matches list_outputs()

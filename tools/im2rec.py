@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Pack an image directory into RecordIO (reference: ``tools/im2rec.py``
+[unverified]).
+
+Two phases, same CLI shape as the reference:
+
+1. ``--list``: walk ``root``, assign integer labels per subdirectory
+   (sorted), write ``prefix.lst`` lines ``index\\tlabel\\trelpath``.
+2. default: read ``prefix.lst`` (or generate in-memory if absent), encode
+   each image (resize/quality options) and write ``prefix.rec`` +
+   ``prefix.idx`` via MXIndexedRecordIO with IRHeader(label).
+
+Usage:
+    python tools/im2rec.py data/train images/ --list
+    python tools/im2rec.py data/train images/ --resize 256 --quality 90
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+_EXTS = {".jpg", ".jpeg", ".png", ".bmp"}
+
+
+def find_images(root):
+    """[(relpath, label)] with labels assigned per sorted subdirectory."""
+    classes = sorted(
+        d for d in os.listdir(root)
+        if os.path.isdir(os.path.join(root, d))
+    )
+    out = []
+    if classes:
+        for label, cls in enumerate(classes):
+            for dirpath, _, files in os.walk(os.path.join(root, cls)):
+                for f in sorted(files):
+                    if os.path.splitext(f)[1].lower() in _EXTS:
+                        rel = os.path.relpath(os.path.join(dirpath, f), root)
+                        out.append((rel, float(label)))
+    else:  # flat directory: label 0
+        for f in sorted(os.listdir(root)):
+            if os.path.splitext(f)[1].lower() in _EXTS:
+                out.append((f, 0.0))
+    return out
+
+
+def write_list(prefix, items, shuffle=False):
+    if shuffle:
+        random.shuffle(items)
+    path = prefix + ".lst"
+    with open(path, "w") as f:
+        for i, (rel, label) in enumerate(items):
+            f.write(f"{i}\t{label}\t{rel}\n")
+    return path
+
+
+def read_list(path):
+    items = []
+    with open(path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[2]
+            items.append((idx, rel, label))
+    return items
+
+
+def pack_records(prefix, root, items, resize=0, quality=95, img_fmt=".jpg"):
+    from mxnet_tpu import recordio
+    import numpy as np
+    from PIL import Image
+
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    for idx, rel, label in items:
+        path = os.path.join(root, rel)
+        try:
+            img = Image.open(path).convert("RGB")
+        except Exception as e:  # noqa: BLE001
+            print(f"skip {rel}: {e}", file=sys.stderr)
+            continue
+        if resize:
+            w, h = img.size
+            scale = resize / min(w, h)
+            img = img.resize((max(1, int(w * scale)),
+                              max(1, int(h * scale))))
+        arr = np.asarray(img)[..., ::-1]  # RGB -> BGR (cv2 wire convention)
+        header = recordio.IRHeader(0, label, idx, 0)
+        packed = recordio.pack_img(header, arr, quality=quality,
+                                   img_fmt=img_fmt)
+        rec.write_idx(idx, packed)
+        n += 1
+    rec.close()
+    return n
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (prefix.lst/.rec/.idx)")
+    ap.add_argument("root", help="image directory root")
+    ap.add_argument("--list", action="store_true",
+                    help="generate prefix.lst only")
+    ap.add_argument("--shuffle", action="store_true")
+    ap.add_argument("--resize", type=int, default=0,
+                    help="resize shorter side to this many pixels")
+    ap.add_argument("--quality", type=int, default=95)
+    ap.add_argument("--encoding", default=".jpg", choices=[".jpg", ".png"])
+    args = ap.parse_args(argv)
+
+    if args.list:
+        items = find_images(args.root)
+        path = write_list(args.prefix, items, shuffle=args.shuffle)
+        print(f"wrote {len(items)} entries to {path}")
+        return 0
+
+    lst = args.prefix + ".lst"
+    if os.path.exists(lst):
+        items = read_list(lst)
+    else:
+        items = [(i, rel, lab)
+                 for i, (rel, lab) in enumerate(find_images(args.root))]
+    n = pack_records(args.prefix, args.root, items, resize=args.resize,
+                     quality=args.quality, img_fmt=args.encoding)
+    print(f"packed {n} images into {args.prefix}.rec")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
