@@ -34,21 +34,26 @@ class MultiHeadAttention(HybridBlock):
 
     def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
                  self_attention=True, causal=False, flatten=False,
-                 ring_axis=None, **kwargs):
+                 ring_axis=None, seq_mode="ring", **kwargs):
         super().__init__(**kwargs)
         if units % num_heads != 0:
             raise MXNetError(
                 f"units {units} not divisible by num_heads {num_heads}"
             )
+        if seq_mode not in ("ring", "ulysses"):
+            raise MXNetError(f"unknown seq_mode {seq_mode!r}")
         self._units = units
         self._num_heads = num_heads
         self._head_dim = units // num_heads
         self._causal = causal
         self._self_attention = self_attention
         # sequence/context parallelism: name of the mesh axis the sequence
-        # dim is sharded over (ring attention); resolved against
-        # parallel.current_mesh() at forward time
+        # dim is sharded over; resolved against parallel.current_mesh() at
+        # forward time. seq_mode picks the collective pattern: 'ring'
+        # (K/V ppermute rotation) or 'ulysses' (head<->seq all_to_all,
+        # needs num_heads % axis_size == 0)
         self._ring_axis = ring_axis
+        self._seq_mode = seq_mode
         with self.name_scope():
             if self_attention:
                 self.qkv_proj = Dense(3 * units, use_bias=use_bias,
@@ -115,13 +120,22 @@ class MultiHeadAttention(HybridBlock):
         if use_ring:
             if valid_length is not None:
                 raise MXNetError(
-                    "valid_length is not supported with ring attention yet; "
-                    "pad to full length or use the single-chip kernel"
+                    "valid_length is not supported with sequence-parallel "
+                    "attention yet; pad to full length or use the "
+                    "single-chip kernel"
                 )
-            out = ring_flash_attention(
-                q, k, v, mesh, self._ring_axis, causal=self._causal,
-                sm_scale=1.0 / math.sqrt(self._head_dim),
-            )
+            if self._seq_mode == "ulysses":
+                from ...parallel.ulysses import ulysses_attention
+
+                out = ulysses_attention(
+                    q, k, v, mesh, self._ring_axis, causal=self._causal,
+                    sm_scale=1.0 / math.sqrt(self._head_dim),
+                )
+            else:
+                out = ring_flash_attention(
+                    q, k, v, mesh, self._ring_axis, causal=self._causal,
+                    sm_scale=1.0 / math.sqrt(self._head_dim),
+                )
         else:
             out = F.flash_attention(
                 q, k, v, valid_length, causal=self._causal,

@@ -322,7 +322,19 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
 
 @register("LayerNorm")
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **kw):
-    """Reference: ``src/operator/nn/layer_norm.cc`` [unverified]."""
+    """Reference: ``src/operator/nn/layer_norm.cc`` [unverified].
+
+    Last-axis norms with lane-aligned channels go through the fused Pallas
+    kernel (single pass fwd, single pass bwd — see ``pallas/layer_norm``);
+    everything else uses the jnp composition XLA fuses itself."""
+    from .pallas import layer_norm as _pln
+
+    if not output_mean_var and _pln.supports(data, axis):
+        C = data.shape[-1]
+        out2d = _pln.layer_norm_fused(
+            data.reshape(-1, C), gamma, beta, float(eps)
+        )
+        return out2d.reshape(data.shape)
     mean = jnp.mean(data, axis=axis, keepdims=True)
     var = jnp.var(data, axis=axis, keepdims=True)
     inv = jax.lax.rsqrt(var + eps)

@@ -170,31 +170,39 @@ def _ring_bwd_rule(axis_name, causal, sm_scale, res, g):
 ring_flash_attention_shard.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
-def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = "seq",
-                         causal=False, sm_scale=None, batch_axis="data"):
-    """Sequence-parallel attention over ``mesh`` axis ``axis``.
-
-    q/k/v (B, H, S, D) with S divisible by the axis size; the wrapper
-    shard_maps them over the sequence dimension — and over ``batch_axis``
-    on the batch dimension when the mesh has that axis, so data parallelism
-    is preserved inside the manual region (replicating B over 'data' would
-    silently double attention FLOPs per device). Composes under jit (e.g.
-    inside TrainStep) — GSPMD sees an opaque manually-sharded region whose
-    collectives are the ring ppermutes.
-    """
+def _seq_parallel_call(shard_fn, q, k, v, mesh, axis, causal, sm_scale,
+                       batch_axis, precheck=None):
+    """Shared wrapper for sequence-parallel attention variants: NDArray
+    unwrap/rewrap, batch-axis resolution (shard B over ``batch_axis`` when
+    the mesh has it — replicating B over 'data' would silently double
+    attention FLOPs per device), and the shard_map plumbing. Composes
+    under jit — GSPMD sees an opaque manually-sharded region."""
     from ..ndarray.ndarray import NDArray
 
     unwrap = lambda x: x.data if isinstance(x, NDArray) else x  # noqa: E731
     wrapped = isinstance(q, NDArray)
     q, k, v = unwrap(q), unwrap(k), unwrap(v)
+    if precheck is not None:
+        precheck(q)
     b_ax = batch_axis if (batch_axis in mesh.axis_names
                           and batch_axis != axis) else None
     spec = PartitionSpec(b_ax, None, axis, None)
     fn = shard_map(
-        functools.partial(ring_flash_attention_shard, axis_name=axis,
-                          causal=causal, sm_scale=sm_scale),
+        functools.partial(shard_fn, axis_name=axis, causal=causal,
+                          sm_scale=sm_scale),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False,  # pallas_call out_shapes carry no vma info
     )
     out = fn(q, k, v)
     return NDArray(out) if wrapped else out
+
+
+def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                         causal=False, sm_scale=None, batch_axis="data"):
+    """Sequence-parallel attention over ``mesh`` axis ``axis``.
+
+    q/k/v (B, H, S, D) with S divisible by the axis size; K/V chunks
+    rotate around the ring via ppermute (see module docstring). See also
+    ``parallel.ulysses`` for the all-to-all variant."""
+    return _seq_parallel_call(ring_flash_attention_shard, q, k, v, mesh,
+                              axis, causal, sm_scale, batch_axis)

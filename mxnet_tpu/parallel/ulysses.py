@@ -1,0 +1,71 @@
+"""Ulysses attention: sequence parallelism via head↔sequence all-to-all.
+
+Beyond-reference capability (SURVEY.md §2.3 lists it as the optional
+complement to ring attention): instead of rotating K/V chunks around a
+ring, each device trades its sequence shard for a head shard with ONE
+``all_to_all`` before attention and the inverse after (DeepSpeed-Ulysses
+recipe, public; reimplemented on this repo's flash kernel). Where ring
+attention's communication scales with n-1 neighbor hops of K/V, Ulysses
+moves each activation exactly twice — cheaper when the head count divides
+well over the axis, while ring wins when heads are scarce or sequence
+lengths dwarf HBM. Both compose with data parallelism inside TrainStep.
+
+Constraint: num_heads % axis_size == 0.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+from jax.sharding import Mesh
+
+from ..base import MXNetError
+from ..ops.pallas.flash_attention import flash_attention
+
+__all__ = ["ulysses_attention", "ulysses_attention_shard"]
+
+
+def ulysses_attention_shard(q, k, v, axis_name, causal=False, sm_scale=None):
+    """Inside shard_map: q/k/v local chunks (B, H, S_local, D) sharded on
+    the sequence dim; returns the same layout."""
+
+    def swap_in(x):
+        # (B, H, S/n, D) -> (B, H/n, S, D): scatter heads, gather sequence
+        return jax.lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+
+    def swap_out(x):
+        return jax.lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                                  tiled=True)
+
+    qh, kh, vh = swap_in(q), swap_in(k), swap_in(v)
+    scale = float(sm_scale) if sm_scale is not None else 1.0 / math.sqrt(
+        q.shape[-1]
+    )
+    # full-sequence attention over the local head subset: exact, so causal
+    # masking needs no cross-device bookkeeping (unlike the ring)
+    out = flash_attention(qh, kh, vh, None, causal=causal, sm_scale=scale)
+    return swap_out(out)
+
+
+def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "seq", causal=False,
+                      sm_scale=None, batch_axis="data"):
+    """Sequence-parallel attention over ``mesh`` axis ``axis`` with one
+    all-to-all pair. q/k/v (B, H, S, D), S divisible by the axis size,
+    H divisible by the axis size."""
+    from .ring_attention import _seq_parallel_call
+
+    def check(qd):
+        n = mesh.shape[axis]
+        if qd.shape[1] % n:
+            raise MXNetError(
+                f"ulysses_attention needs num_heads ({qd.shape[1]}) "
+                f"divisible by the '{axis}' axis size ({n}); use ring "
+                "attention otherwise"
+            )
+
+    return _seq_parallel_call(
+        ulysses_attention_shard, q, k, v, mesh, axis, causal, sm_scale,
+        batch_axis, precheck=check,
+    )
