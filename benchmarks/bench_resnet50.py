@@ -31,7 +31,7 @@ def main():
 
     step_fn = TrainStep(net, _Loss(),
                         opt.SGD(learning_rate=0.1, momentum=0.9),
-                        compute_dtype="bfloat16")
+                        compute_dtype="bfloat16", state_dtype="bfloat16")
     rng = np.random.RandomState(0)
     x = nd.array(rng.rand(BATCH, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, BATCH).astype(np.float32))

@@ -33,7 +33,7 @@ def main():
             return ce(logits.reshape(-1, VOCAB), label.reshape(-1))
 
     step_fn = TrainStep(net, _Loss(), opt.AdamW(learning_rate=1e-4),
-                        compute_dtype="bfloat16")
+                        compute_dtype="bfloat16", state_dtype="bfloat16")
     rng = np.random.RandomState(0)
     src = nd.array(rng.randint(0, VOCAB, (BATCH, SRC_LEN)), dtype="int32")
     tgt = nd.array(rng.randint(0, VOCAB, (BATCH, TGT_LEN)), dtype="int32")

@@ -193,10 +193,248 @@ def _fwd_rule(q, k, v, valid_length, causal, sm_scale, block_q, block_k):
     return out, (q, k, v, valid_length, out, lse)
 
 
+def _s_p_block(q_blk, k_blk, lse_blk, k_pos, vl, iq, block_q, causal,
+               sm_scale):
+    """Recompute the (bq, bk) probability tile from saved lse."""
+    s = jax.lax.dot_general(
+        q_blk, k_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * sm_scale
+    mask = k_pos < vl
+    if causal:
+        q_pos = iq * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, 1), 0
+        )
+        mask = jnp.logical_and(mask, k_pos <= q_pos)
+    # explicit zero outside the mask: a fully-masked row has lse ~ -inf
+    # too, where exp(s - lse) would wrongly give 1
+    return jnp.where(mask, jnp.exp(s - lse_blk), 0.0)
+
+
+def _bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                     vl_ref, dk_ref, dv_ref, *, sm_scale, block_q, block_k,
+                     kv_len, causal):
+    """Grid (B*H, Sk/block_k): one K/V block per step, stream Q blocks.
+    Write-once outputs — the canonical two-kernel flash backward's first
+    half (dq comes from its own kernel with the transposed streaming)."""
+    jk = pl.program_id(1)
+    k_blk = k_ref[0].astype(jnp.float32)  # (bk, D)
+    v_blk = v_ref[0].astype(jnp.float32)
+    vl = jnp.minimum(vl_ref[pl.program_id(0), 0], kv_len)
+    k_pos = jk * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (1, block_k), 1
+    )
+    nq = q_ref.shape[1] // block_q
+
+    def body(iq, carry):
+        dk_acc, dv_acc = carry
+        q_blk = q_ref[0, pl.ds(iq * block_q, block_q), :].astype(jnp.float32)
+        do_blk = do_ref[0, pl.ds(iq * block_q, block_q), :].astype(
+            jnp.float32
+        )
+        lse_blk = lse_ref[0, pl.ds(iq * block_q, block_q), :]
+        dl_blk = delta_ref[0, pl.ds(iq * block_q, block_q), :]
+        p = _s_p_block(q_blk, k_blk, lse_blk, k_pos, vl, iq, block_q,
+                       causal, sm_scale)
+        dv_acc = dv_acc + jax.lax.dot_general(
+            p, do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dl_blk) * sm_scale
+        dk_acc = dk_acc + jax.lax.dot_general(
+            ds, q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk_acc, dv_acc
+
+    # causal: Q blocks strictly above this K block's diagonal are fully
+    # masked — start past them (traced bound, like the forward's nk_eff)
+    start = (jk * block_k) // block_q if causal else 0
+    dk_acc = jnp.zeros((block_k, k_ref.shape[2]), jnp.float32)
+    dv_acc = jnp.zeros((block_k, v_ref.shape[2]), jnp.float32)
+    dk_acc, dv_acc = jax.lax.fori_loop(start, nq, body, (dk_acc, dv_acc))
+    dk_ref[0] = dk_acc.astype(dk_ref.dtype)
+    dv_ref[0] = dv_acc.astype(dv_ref.dtype)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, vl_ref,
+                   dq_ref, *, sm_scale, block_q, block_k, kv_len, causal):
+    """Grid (B*H, Sq/block_q): one Q block per step, stream K/V blocks."""
+    iq = pl.program_id(1)
+    q_blk = q_ref[0].astype(jnp.float32)  # (bq, D)
+    do_blk = do_ref[0].astype(jnp.float32)
+    lse_blk = lse_ref[0]
+    dl_blk = delta_ref[0]
+    vl = jnp.minimum(vl_ref[pl.program_id(0), 0], kv_len)
+    nk = k_ref.shape[1] // block_k
+
+    def body(jk, dq_acc):
+        k_blk = k_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(jk * block_k, block_k), :].astype(jnp.float32)
+        k_pos = jk * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (1, block_k), 1
+        )
+        p = _s_p_block(q_blk, k_blk, lse_blk, k_pos, vl, iq, block_q,
+                       causal, sm_scale)
+        dp = jax.lax.dot_general(
+            do_blk, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - dl_blk) * sm_scale
+        return dq_acc + jax.lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    # skip K blocks past the valid length / above the causal diagonal
+    # (traced bounds, mirroring the forward kernel's nk_eff)
+    nk_eff = jnp.minimum(nk, pl.cdiv(vl, block_k))
+    if causal:
+        nk_eff = jnp.minimum(nk_eff, pl.cdiv((iq + 1) * block_q, block_k))
+    dq_acc = jnp.zeros((block_q, q_ref.shape[2]), jnp.float32)
+    dq_ref[0] = jax.lax.fori_loop(0, nk_eff, body, dq_acc).astype(
+        dq_ref.dtype
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k")
+)
+def _flash_bwd_pallas(q, k, v, vl, out, lse, do, causal, sm_scale,
+                      block_q=128, block_k=128):
+    """Pallas backward: P/dS tiles never leave VMEM (the XLA-scan fallback
+    below materializes (B,H,Sq,block) probability tensors in HBM)."""
+    B, H, Sq, D = q.shape
+    Sk = k.shape[2]
+    bq = min(block_q, max(Sq, 8))
+    bk = min(block_k, max(Sk, 8))
+    qp = _pad_to(q, 2, bq)
+    dop = _pad_to(do, 2, bq)
+    kp = _pad_to(k, 2, bk)
+    vp = _pad_to(v, 2, bk)
+    Sq_p, Sk_p = qp.shape[2], kp.shape[2]
+    delta = jnp.sum(
+        do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # (B,H,Sq)
+    # padded q rows: lse stays 0 -> p = exp(0-0) = 1 would pollute dk/dv;
+    # push their lse to +inf so p underflows to exactly 0
+    lse_p = _pad_to(
+        lse.reshape(B * H, Sq, 1), 1, bq
+    )
+    if Sq_p != Sq:
+        pad_rows = jax.lax.broadcasted_iota(
+            jnp.int32, (B * H, Sq_p, 1), 1
+        ) >= Sq
+        lse_p = jnp.where(pad_rows, jnp.float32(-_NEG_INF), lse_p)
+    delta_p = _pad_to(delta.reshape(B * H, Sq, 1), 1, bq)
+    # vl is always a concrete (B,) array here — _bwd_rule and the ring
+    # backward materialize full-length vectors when no mask is in play
+    q3 = qp.reshape(B * H, Sq_p, D)
+    k3 = kp.reshape(B * H, Sk_p, D)
+    v3 = vp.reshape(B * H, Sk_p, D)
+    do3 = dop.reshape(B * H, Sq_p, D)
+    vl_op = jnp.repeat(vl.astype(jnp.int32), H).reshape(B * H, 1)
+    vl_spec = lambda: pl.BlockSpec((B * H, 1), lambda b, j: (0, 0))  # noqa: E731
+    common = dict(sm_scale=sm_scale, block_q=bq, block_k=bk, kv_len=Sk,
+                  causal=causal)
+
+    # kernel 1: dk/dv — grid over K blocks, stream Q
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkdv_kernel, **common),
+        grid=(B * H, Sk_p // bk),
+        in_specs=[
+            pl.BlockSpec((1, Sq_p, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, Sq_p, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq_p, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Sq_p, 1), lambda b, j: (b, 0, 0)),
+            vl_spec(),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, Sk_p, D), k.dtype),
+            jax.ShapeDtypeStruct((B * H, Sk_p, D), v.dtype),
+        ],
+        interpret=_use_interpret(),
+    )(q3, k3, v3, do3, lse_p, delta_p, vl_op)
+
+    # kernel 2: dq — grid over Q blocks, stream K/V
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, **common),
+        grid=(B * H, Sq_p // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Sk_p, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            vl_spec(),
+        ],
+        out_specs=pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, Sq_p, D), q.dtype),
+        interpret=_use_interpret(),
+    )(q3, k3, v3, do3, lse_p, delta_p, vl_op)
+
+    dq = dq.reshape(B, H, Sq_p, D)[:, :, :Sq]
+    dk = dk.reshape(B, H, Sk_p, D)[:, :, :Sk]
+    dv = dv.reshape(B, H, Sk_p, D)[:, :, :Sk]
+    return dq, dk, dv
+
+
+# backward implementation choice; initialized from MXTPU_FLASH_BWD at
+# import. Change at runtime through set_flash_backward() — NOT by mutating
+# the env var: the choice is baked into traced programs, so the setter
+# clears jax's compilation caches.
+import os as _os  # noqa: E402
+
+_BWD_IMPL = _os.environ.get("MXTPU_FLASH_BWD", "xla")
+
+
+def set_flash_backward(impl: str):
+    """Select the flash-attention backward: 'xla' (default) or 'pallas'.
+
+    Clears jax's jit caches so already-compiled train steps pick up the
+    change (the choice is a trace-time constant)."""
+    global _BWD_IMPL
+    if impl not in ("xla", "pallas"):
+        raise ValueError(f"unknown flash backward {impl!r}")
+    _BWD_IMPL = impl
+    jax.clear_caches()
+
+
+def _flash_bwd_impl(q, k, v, vl, out, lse, do, causal, sm_scale, block_k,
+                    block_q=128):
+    """Backward dispatcher.
+
+    Two implementations, same math (parity-tested):
+    - XLA blockwise-recompute scan (default): measured FASTER on v5e-lite
+      (13.4 vs 15.4 ms at S=2048, 60.9 vs 73.8 ms at S=8192, fwd+bwd,
+      B4 H8 D64 bf16) — XLA pipelines the recompute einsums well here.
+    - hand-written two-kernel Pallas backward
+      (``set_flash_backward('pallas')`` or env MXTPU_FLASH_BWD at import):
+      P/dS tiles never leave VMEM; kept for hardware where the scan's HBM
+      traffic binds, and as the tuning baseline.
+    """
+    if _BWD_IMPL == "pallas":
+        return _flash_bwd_pallas(q, k, v, vl, out, lse, do, causal,
+                                 sm_scale, block_q, block_k)
+    return _flash_bwd_xla(q, k, v, vl, out, lse, do, causal, sm_scale,
+                          block_k)
+
+
 @functools.partial(
     jax.jit, static_argnames=("causal", "sm_scale", "block_k")
 )
-def _flash_bwd_impl(q, k, v, vl, out, lse, do, causal, sm_scale, block_k):
+def _flash_bwd_xla(q, k, v, vl, out, lse, do, causal, sm_scale, block_k):
     """Blockwise recompute backward (scan over K blocks, O(S·block) memory)."""
     B, H, Sq, D = q.shape
     Sk = k.shape[2]
@@ -247,7 +485,8 @@ def _bwd_rule(causal, sm_scale, block_q, block_k, res, g):
     vl = (jnp.full((q.shape[0],), Sk, jnp.int32) if valid_length is None
           else valid_length.astype(jnp.int32))
     dq, dk, dv = _flash_bwd_impl(
-        q, k, v, vl, out, lse, g, causal, float(sm_scale), block_k
+        q, k, v, vl, out, lse, g, causal, float(sm_scale), block_k,
+        block_q=block_q,
     )
     if valid_length is None:
         dvl = None
