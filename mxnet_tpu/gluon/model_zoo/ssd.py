@@ -1,0 +1,122 @@
+"""SSD single-shot detector (reference workload: GluonCV SSD over the
+``MultiBoxPrior/Target/Detection`` contrib ops,
+``src/operator/contrib/multibox_*.cc`` [unverified]; BASELINE config 5's
+model family).
+
+TPU-first shape discipline: every stage emits a static number of anchors,
+targets are dense (N anchors, no dynamic gather), and detection ends in
+the mask-based ``box_nms`` — the whole train step stages into one XLA
+program under ``hybridize()``/TrainStep.
+"""
+
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..nn import BatchNorm, Conv2D, HybridSequential, MaxPool2D
+
+__all__ = ["SSD", "ssd_tiny", "SSDTargetGenerator"]
+
+
+def _down_block(channels):
+    blk = HybridSequential()
+    for _ in range(2):
+        blk.add(Conv2D(channels, kernel_size=3, padding=1),
+                BatchNorm(in_channels=channels),)
+    blk.add(MaxPool2D(pool_size=2, strides=2))
+    return blk
+
+
+class _ClassBoxHeads(HybridBlock):
+    """Per-scale 3x3 conv heads for class scores and box offsets."""
+
+    def __init__(self, num_anchors, num_classes, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.cls = Conv2D(num_anchors * (num_classes + 1),
+                              kernel_size=3, padding=1, prefix="cls_")
+            self.box = Conv2D(num_anchors * 4, kernel_size=3, padding=1,
+                              prefix="box_")
+
+    def hybrid_forward(self, F, x):
+        return self.cls(x), self.box(x)
+
+
+class SSD(HybridBlock):
+    """Configurable SSD.
+
+    forward(images (B, 3, S, S)) ->
+        anchors (1, N, 4), cls_preds (B, N, num_classes+1),
+        box_preds (B, N*4)
+    """
+
+    def __init__(self, num_classes, channels=(16, 32, 64),
+                 sizes=((0.2, 0.272), (0.37, 0.447), (0.54, 0.619)),
+                 ratios=((1, 2, 0.5),) * 3, **kwargs):
+        super().__init__(**kwargs)
+        if not (len(channels) == len(sizes) == len(ratios)):
+            raise MXNetError("channels/sizes/ratios length mismatch")
+        self._num_classes = num_classes
+        self._sizes = tuple(tuple(s) for s in sizes)
+        self._ratios = tuple(tuple(r) for r in ratios)
+        with self.name_scope():
+            self.stages = HybridSequential(prefix="stages_")
+            for c in channels:
+                self.stages.add(_down_block(c))
+            self.heads = HybridSequential(prefix="heads_")
+            for i in range(len(channels)):
+                na = len(self._sizes[i]) + len(self._ratios[i]) - 1
+                self.heads.add(_ClassBoxHeads(na, num_classes))
+
+    def hybrid_forward(self, F, x):
+        anchors, cls_list, box_list = [], [], []
+        for stage, head in zip(self.stages, self.heads):
+            x = stage(x)
+            i = len(anchors)
+            anchors.append(F.MultiBoxPrior(
+                x, sizes=self._sizes[i], ratios=self._ratios[i]
+            ))
+            c, b = head(x)
+            # (B, A*(C+1), H, W) -> (B, H*W*A, C+1)
+            B = c.shape[0]
+            cls_list.append(
+                c.transpose(0, 2, 3, 1).reshape(B, -1, self._num_classes + 1)
+            )
+            box_list.append(b.transpose(0, 2, 3, 1).reshape(B, -1))
+        anchors_all = F.concat(*anchors, dim=1)
+        cls_all = F.concat(*cls_list, dim=1)
+        box_all = F.concat(*box_list, dim=1)
+        return anchors_all, cls_all, box_all
+
+    # --------------------------------------------------------------- detect
+    def detect(self, x, threshold=0.01, nms_threshold=0.45, nms_topk=100):
+        """Inference: (B, N, 6) rows [cls_id, score, corner box]."""
+        from ... import ndarray as nd
+
+        anchors, cls_preds, box_preds = self(x)
+        probs = nd.softmax(cls_preds, axis=-1).transpose(0, 2, 1)
+        return nd.MultiBoxDetection(
+            probs, box_preds, anchors, threshold=threshold,
+            nms_threshold=nms_threshold, nms_topk=nms_topk,
+        )
+
+
+class SSDTargetGenerator:
+    """Training-target helper pairing the net with MultiBoxTarget
+    (reference training loop composition)."""
+
+    def __init__(self, overlap_threshold=0.5):
+        self._thresh = overlap_threshold
+
+    def __call__(self, anchors, labels, cls_preds):
+        from ... import ndarray as nd
+
+        return nd.MultiBoxTarget(
+            anchors, labels, cls_preds.transpose(0, 2, 1),
+            overlap_threshold=self._thresh,
+        )
+
+
+def ssd_tiny(num_classes=2, **kwargs):
+    """Small SSD for tests/examples (three 2x-downsampling stages)."""
+    return SSD(num_classes, **kwargs)

@@ -15,6 +15,8 @@ code ports unchanged.
 
 from __future__ import annotations
 
+import math
+
 import numpy as _np
 import jax
 import jax.numpy as jnp
@@ -348,3 +350,168 @@ def _flash_attention_op(query, key, value, valid_length=None, causal=False,
         valid_length = valid_length.data
     return _fa(query, key, value, valid_length, bool(causal), sm_scale,
                int(block_q), int(block_k))
+
+
+# ------------------------------------------------------------------ multibox
+# SSD op trio (reference: ``src/operator/contrib/multibox_prior.cc``,
+# ``multibox_target.cc``, ``multibox_detection.cc`` [unverified]). All pure
+# jax: anchor generation is iota math, target assignment is an argmax
+# bipartite match + optional hard negative mining, detection reuses
+# box_decode + box_nms — each jit/vmap friendly.
+
+@register("_contrib_MultiBoxPrior", aliases=["MultiBoxPrior"],
+          differentiable=False)
+def multibox_prior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                   steps=(-1.0, -1.0), offsets=(0.5, 0.5), **kw):
+    """Anchor boxes for one feature map. data (B, C, H, W) ->
+    (1, H*W*(len(sizes)+len(ratios)-1), 4) corner boxes, normalized.
+
+    Reference conventions: ``steps``/``offsets`` are (y, x); anchor k at
+    each pixel uses (size_k, ratio_0) for k < len(sizes), else
+    (size_0, ratio_{k-len(sizes)+1}); widths carry the H/W aspect factor
+    so a size-s ratio-1 anchor is square in image pixels."""
+    H, W = data.shape[2], data.shape[3]
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+    step_y = steps[0] if steps[0] > 0 else 1.0 / H
+    step_x = steps[1] if steps[1] > 0 else 1.0 / W
+    cy = (jnp.arange(H, dtype=jnp.float32) + offsets[0]) * step_y
+    cx = (jnp.arange(W, dtype=jnp.float32) + offsets[1]) * step_x
+    cxg, cyg = jnp.meshgrid(cx, cy)  # (H, W)
+
+    aspect = H / W  # size-s ratio-1 anchors stay square in pixel space
+    ws, hs = [], []
+    for k in range(len(sizes)):
+        s, r = sizes[k], ratios[0]
+        ws.append(s * aspect * math.sqrt(r))
+        hs.append(s / math.sqrt(r))
+    for j in range(1, len(ratios)):
+        s, r = sizes[0], ratios[j]
+        ws.append(s * aspect * math.sqrt(r))
+        hs.append(s / math.sqrt(r))
+    ws = jnp.asarray(ws, jnp.float32)  # (A,)
+    hs = jnp.asarray(hs, jnp.float32)
+
+    cxg = cxg[..., None]  # (H, W, 1)
+    cyg = cyg[..., None]
+    boxes = jnp.stack(
+        [
+            cxg - ws / 2, cyg - hs / 2, cxg + ws / 2, cyg + hs / 2,
+        ],
+        axis=-1,
+    )  # (H, W, A, 4)
+    out = boxes.reshape(1, -1, 4)
+    if clip:
+        out = jnp.clip(out, 0.0, 1.0)
+    return out
+
+
+_VARIANCES = (0.1, 0.1, 0.2, 0.2)
+
+
+@register("_contrib_MultiBoxTarget", aliases=["MultiBoxTarget"],
+          num_outputs=3, differentiable=False)
+def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
+                    ignore_label=-1.0, negative_mining_ratio=-1.0,
+                    variances=_VARIANCES, **kw):
+    """Training targets. anchors (1, N, 4) corner; labels (B, M, 5)
+    [cls, xmin, ymin, xmax, ymax] padded with cls=-1; cls_preds
+    (B, num_cls+1, N).
+
+    -> (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N) with
+    0 = background, c+1 = object class c). Reference semantics: each
+    ground truth claims its best anchor; other anchors match their best
+    gt when IoU >= overlap_threshold. With ``negative_mining_ratio > 0``
+    only the hardest ratio*num_pos negatives stay background; the rest
+    get ``ignore_label`` (reference hard negative mining — ties at the
+    confidence cutoff may keep a few extra negatives)."""
+    anchors = anchors.reshape(-1, 4)
+    N = anchors.shape[0]
+
+    def per_image(lab, cp):
+        cls = lab[:, 0]
+        valid = cls >= 0  # (M,)
+        M = lab.shape[0]
+        gt = lab[:, 1:5]
+        iou = box_iou(anchors[None], gt[None])[0]  # (N, M)
+        iou = jnp.where(valid[None, :], iou, -1.0)
+        best_gt = jnp.argmax(iou, axis=1)  # (N,)
+        best_iou = jnp.max(iou, axis=1)
+        matched = jnp.logical_and(best_iou >= overlap_threshold,
+                                  best_iou > 0)
+        # each valid gt claims its best anchor (bipartite guarantee);
+        # padded rows scatter out of bounds and are dropped
+        best_anchor = jnp.where(valid, jnp.argmax(iou, axis=0), N)  # (M,)
+        forced = jnp.zeros((N,), bool).at[best_anchor].set(
+            True, mode="drop"
+        )
+        gt_of_forced = jnp.zeros((N,), jnp.int32).at[best_anchor].set(
+            jnp.arange(M, dtype=jnp.int32), mode="drop"
+        )
+        assign = jnp.where(forced, gt_of_forced, best_gt)
+        pos = jnp.logical_or(matched, forced)
+
+        # encode via the shared box_encode kernel (batch of 1)
+        targets, mask = box_encode(
+            pos[None].astype(jnp.float32), assign[None], anchors[None],
+            gt[None], stds=tuple(variances),
+        )
+        bt = targets[0].reshape(-1)
+        bm = mask[0].reshape(-1)
+        ct = jnp.where(pos, cls[assign].astype(jnp.int32) + 1, 0)
+        ct = ct.astype(jnp.float32)
+        if negative_mining_ratio > 0:
+            probs = jax.nn.softmax(cp, axis=0)  # (num_cls+1, N)
+            neg_conf = jnp.where(pos, -jnp.inf, 1.0 - probs[0])
+            k = (negative_mining_ratio * jnp.sum(pos)).astype(jnp.int32)
+            k = jnp.clip(k, 0, N - 1)
+            thresh = jnp.sort(neg_conf)[::-1][jnp.maximum(k - 1, 0)]
+            keep_neg = jnp.logical_and(
+                jnp.logical_and(~pos, neg_conf >= thresh), k > 0
+            )
+            ct = jnp.where(jnp.logical_or(pos, keep_neg), ct,
+                           jnp.float32(ignore_label))
+        return bt, bm, ct
+
+    bt, bm, ct = jax.vmap(per_image)(labels, cls_preds)
+    return bt, bm, ct
+
+
+@register("_contrib_MultiBoxDetection", aliases=["MultiBoxDetection"],
+          differentiable=False)
+def multibox_detection(cls_probs, loc_preds, anchors, clip=True,
+                       threshold=0.01, nms_threshold=0.5, force_suppress=False,
+                       nms_topk=-1, variances=_VARIANCES, **kw):
+    """Decode + NMS. cls_probs (B, num_cls+1, N) softmaxed (class 0 =
+    background); loc_preds (B, N*4); anchors (1, N, 4) ->
+    (B, N, 6) rows [cls_id, score, xmin, ymin, xmax, ymax], suppressed
+    rows get cls_id -1 (reference output convention)."""
+    anchors = anchors.reshape(-1, 4)
+    N = anchors.shape[0]
+    v = tuple(variances)
+
+    def per_image(probs, locs):
+        # best foreground class per anchor
+        fg = probs[1:]  # (num_cls, N)
+        cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+        score = jnp.max(fg, axis=0)
+        keep = score > threshold
+        cls_id = jnp.where(keep, cls_id, -1.0)
+        boxes = box_decode(
+            locs.reshape(1, N, 4), anchors[None], std0=v[0], std1=v[1],
+            std2=v[2], std3=v[3], clip=10.0,
+        )[0]
+        if clip:
+            boxes = jnp.clip(boxes, 0.0, 1.0)
+        det = jnp.concatenate(
+            [cls_id[:, None], score[:, None], boxes], axis=-1
+        )  # (N, 6)
+        out = box_nms(det[None], overlap_thresh=nms_threshold,
+                      valid_thresh=threshold, topk=nms_topk, coord_start=2,
+                      score_index=1, id_index=0,
+                      force_suppress=force_suppress)[0]
+        # box_nms flags suppression by score=-1; the reference's detection
+        # output convention is cls_id=-1 for invalid rows
+        return out.at[:, 0].set(jnp.where(out[:, 1] < 0, -1.0, out[:, 0]))
+
+    return jax.vmap(per_image)(cls_probs, loc_preds)

@@ -1,0 +1,249 @@
+"""MultiBox ops + SSD model family (reference:
+``src/operator/contrib/multibox_*.cc`` + GluonCV SSD [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon.model_zoo.ssd import SSDTargetGenerator, ssd_tiny
+
+
+class TestMultiBoxPrior:
+    def test_anchor_count_and_values(self):
+        x = nd.zeros((1, 3, 2, 2))
+        anchors = nd.MultiBoxPrior(x, sizes=(0.5, 0.25), ratios=(1, 2))
+        # A = len(sizes) + len(ratios) - 1 = 3 per pixel
+        assert anchors.shape == (1, 2 * 2 * 3, 4)
+        a = anchors.asnumpy().reshape(2, 2, 3, 4)
+        # pixel (0,0): center (0.25, 0.25), first anchor size 0.5 ratio 1
+        np.testing.assert_allclose(
+            a[0, 0, 0], [0.25 - 0.25, 0.25 - 0.25, 0.25 + 0.25, 0.5],
+            atol=1e-6,
+        )
+        # second anchor: size 0.25 ratio 1 -> half-width 0.125
+        np.testing.assert_allclose(
+            a[0, 0, 1], [0.125, 0.125, 0.375, 0.375], atol=1e-6
+        )
+        # third: size 0.5 ratio 2 -> w = 0.5*sqrt(2), h = 0.5/sqrt(2)
+        w, h = 0.5 * np.sqrt(2), 0.5 / np.sqrt(2)
+        np.testing.assert_allclose(
+            a[0, 0, 2],
+            [0.25 - w / 2, 0.25 - h / 2, 0.25 + w / 2, 0.25 + h / 2],
+            atol=1e-6,
+        )
+
+    def test_clip(self):
+        x = nd.zeros((1, 1, 1, 1))
+        anchors = nd.MultiBoxPrior(x, sizes=(1.5,), ratios=(1,), clip=True)
+        a = anchors.asnumpy()
+        assert a.min() >= 0.0 and a.max() <= 1.0
+
+
+class TestMultiBoxTarget:
+    def test_assignment_and_encoding(self):
+        # one anchor exactly on the gt, one far away
+        anchors = nd.array(np.array(
+            [[[0.1, 0.1, 0.3, 0.3], [0.7, 0.7, 0.9, 0.9]]], np.float32
+        ))
+        labels = nd.array(np.array(
+            [[[1.0, 0.1, 0.1, 0.3, 0.3]]], np.float32
+        ))  # class 1 at the first anchor
+        cls_preds = nd.zeros((1, 3, 2))  # (B, num_cls+1, N)
+        bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds)
+        ct = ct.asnumpy()
+        assert ct.shape == (1, 2)
+        assert ct[0, 0] == 2.0  # class 1 -> target 2 (bg=0)
+        assert ct[0, 1] == 0.0  # background
+        bm = bm.asnumpy().reshape(1, 2, 4)
+        assert bm[0, 0].sum() == 4.0 and bm[0, 1].sum() == 0.0
+        bt = bt.asnumpy().reshape(1, 2, 4)
+        np.testing.assert_allclose(bt[0, 0], np.zeros(4), atol=1e-5)
+
+    def test_forced_match_below_threshold(self):
+        """Every valid gt claims its best anchor even under the IoU
+        threshold (reference bipartite stage)."""
+        anchors = nd.array(np.array(
+            [[[0.0, 0.0, 0.2, 0.2], [0.5, 0.5, 1.0, 1.0]]], np.float32
+        ))
+        # gt overlaps anchor 1 only slightly, still must be assigned
+        labels = nd.array(np.array(
+            [[[0.0, 0.45, 0.45, 0.6, 0.6]]], np.float32
+        ))
+        cls_preds = nd.zeros((1, 2, 2))
+        bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds,
+                                       overlap_threshold=0.9)
+        assert ct.asnumpy()[0, 1] == 1.0  # class 0 -> 1
+
+    def test_padded_labels_ignored(self):
+        anchors = nd.array(np.array([[[0.1, 0.1, 0.3, 0.3]]], np.float32))
+        labels = nd.array(np.array(
+            [[[-1.0, 0, 0, 0, 0], [-1.0, 0, 0, 0, 0]]], np.float32
+        ))
+        cls_preds = nd.zeros((1, 2, 1))
+        bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds)
+        assert ct.asnumpy()[0, 0] == 0.0
+        assert bm.asnumpy().sum() == 0.0
+
+
+class TestMultiBoxDetection:
+    def test_decode_identity_and_nms(self):
+        anchors = nd.array(np.array(
+            [[[0.1, 0.1, 0.3, 0.3], [0.11, 0.11, 0.31, 0.31],
+              [0.6, 0.6, 0.8, 0.8]]], np.float32
+        ))
+        # zero offsets -> boxes == anchors
+        loc = nd.zeros((1, 12))
+        probs = nd.array(np.array(  # (B, num_cls+1, N)
+            [[[0.1, 0.2, 0.8], [0.9, 0.8, 0.2]]], np.float32
+        ))
+        out = nd.MultiBoxDetection(probs, loc, anchors, threshold=0.3,
+                                   nms_threshold=0.5).asnumpy()[0]
+        kept = out[out[:, 0] >= 0]
+        # anchors 0 and 1 overlap heavily -> one suppressed; anchor 2's
+        # foreground prob 0.2 falls under the 0.3 score threshold
+        assert kept.shape[0] == 1
+        np.testing.assert_allclose(kept[0, 2:], [0.1, 0.1, 0.3, 0.3],
+                                   atol=1e-5)
+        assert kept[0, 0] == 0.0 and abs(kept[0, 1] - 0.9) < 1e-5
+
+
+class TestSSDModel:
+    def test_shapes_consistent(self):
+        net = ssd_tiny(num_classes=2)
+        net.initialize()
+        x = nd.zeros((2, 3, 32, 32))
+        anchors, cls_preds, box_preds = net(x)
+        N = anchors.shape[1]
+        assert cls_preds.shape == (2, N, 3)
+        assert box_preds.shape == (2, N * 4)
+        # 32->16->8->4 fmaps, 4 anchors each per pixel
+        assert N == (16 * 16 + 8 * 8 + 4 * 4) * 4
+        # stages into one XLA program too
+        net.hybridize()
+        a2, c2, b2 = net(x)
+        np.testing.assert_allclose(a2.asnumpy(), anchors.asnumpy(),
+                                   rtol=1e-6)
+        np.testing.assert_allclose(c2.asnumpy(), cls_preds.asnumpy(),
+                                   rtol=2e-3, atol=2e-4)
+
+    def test_train_step_decreases_loss(self):
+        mx.random.seed(0)
+        net = ssd_tiny(num_classes=1)
+        net.initialize()
+        tgen = SSDTargetGenerator()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 5e-3})
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+        l1 = gluon.loss.L1Loss()
+        rng = np.random.RandomState(0)
+        x = nd.array(rng.rand(2, 3, 32, 32).astype(np.float32))
+        labels = nd.array(np.array(
+            [[[0.0, 0.2, 0.2, 0.5, 0.5]], [[0.0, 0.4, 0.4, 0.8, 0.8]]],
+            np.float32,
+        ))
+        losses = []
+        for _ in range(12):
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                bt, bm, ct = tgen(anchors, labels, cls_preds)
+                L = ce(cls_preds.reshape(-1, 2), ct.reshape(-1)).mean() + \
+                    l1(box_preds * bm, bt * bm).mean()
+            L.backward()
+            trainer.step(2)
+            losses.append(float(L.asscalar()))
+        assert losses[-1] < losses[0] * 0.8
+
+    def test_detect_finds_planted_object(self):
+        """After overfitting on one image, detect() returns a box near the
+        planted ground truth."""
+        mx.random.seed(1)
+        net = ssd_tiny(num_classes=1)
+        net.initialize()
+        tgen = SSDTargetGenerator()
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 1e-2})
+        ce = gluon.loss.SoftmaxCrossEntropyLoss()
+        l1 = gluon.loss.L1Loss()
+        rng = np.random.RandomState(2)
+        x_np = rng.rand(1, 3, 32, 32).astype(np.float32) * 0.1
+        x_np[:, :, 8:24, 8:24] = 1.0  # bright square = the object
+        x = nd.array(x_np)
+        gt = [0.25, 0.25, 0.75, 0.75]
+        labels = nd.array(np.array([[[0.0] + gt]], np.float32))
+        for _ in range(60):
+            with autograd.record():
+                anchors, cls_preds, box_preds = net(x)
+                bt, bm, ct = tgen(anchors, labels, cls_preds)
+                L = ce(cls_preds.reshape(-1, 2), ct.reshape(-1)).mean() + \
+                    0.5 * l1(box_preds * bm, bt * bm).mean()
+            L.backward()
+            trainer.step(1)
+        det = net.detect(x).asnumpy()[0]
+        best = det[np.argmax(det[:, 1])]
+        assert best[0] == 0.0  # class 0 found
+        from mxnet_tpu.ops.contrib import box_iou
+        import jax.numpy as jnp
+
+        iou = float(np.asarray(box_iou(
+            jnp.asarray(best[None, None, 2:]),
+            jnp.asarray(np.array([[gt]], np.float32)),
+        )).reshape(-1)[0])
+        assert iou > 0.4, (best, iou)
+
+
+class TestReviewRegressions:
+    def test_steps_offsets_are_y_then_x(self):
+        x = nd.zeros((1, 1, 2, 4))  # H=2, W=4
+        a = nd.MultiBoxPrior(x, sizes=(0.1,), ratios=(1,),
+                             steps=(0.5, 0.25), offsets=(0.5, 0.5))
+        a = a.asnumpy().reshape(2, 4, 1, 4)
+        # center of pixel (0,0): y = 0.5*0.5 = 0.25, x = 0.5*0.25 = 0.125
+        cx = (a[0, 0, 0, 0] + a[0, 0, 0, 2]) / 2
+        cy = (a[0, 0, 0, 1] + a[0, 0, 0, 3]) / 2
+        np.testing.assert_allclose([cx, cy], [0.125, 0.25], atol=1e-6)
+
+    def test_nonsquare_aspect_scaling(self):
+        """size-s ratio-1 anchors are square in pixel space (reference
+        in_height/in_width factor)."""
+        x = nd.zeros((1, 1, 2, 4))  # H=2, W=4 -> aspect 0.5
+        a = nd.MultiBoxPrior(x, sizes=(0.4,), ratios=(1,))
+        a = a.asnumpy().reshape(-1, 4)[0]
+        w, h = a[2] - a[0], a[3] - a[1]
+        np.testing.assert_allclose(w, 0.4 * 2 / 4, atol=1e-6)
+        np.testing.assert_allclose(h, 0.4, atol=1e-6)
+
+    def test_padded_gt_cannot_steal_anchor_zero(self):
+        """Padding rows must not clobber a valid gt's forced match at
+        anchor 0 (duplicate-scatter race)."""
+        anchors = nd.array(np.array(
+            [[[0.4, 0.4, 0.6, 0.6], [0.0, 0.0, 0.1, 0.1]]], np.float32
+        ))
+        # valid gt matches anchor 0; THEN padding rows (argmax of all -1
+        # IoU lands on anchor 0 too)
+        labels = nd.array(np.array(
+            [[[2.0, 0.4, 0.4, 0.6, 0.6],
+              [-1.0, 0, 0, 0, 0], [-1.0, 0, 0, 0, 0]]], np.float32
+        ))
+        cls_preds = nd.zeros((1, 4, 2))
+        bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds)
+        assert ct.asnumpy()[0, 0] == 3.0  # class 2 -> 3, not stolen
+
+    def test_hard_negative_mining(self):
+        rng = np.random.RandomState(0)
+        anchors = nd.array(rng.rand(1, 40, 4).astype(np.float32) * 0.01 +
+                           np.linspace(0, 0.9, 40)[None, :, None]
+                           .astype(np.float32))
+        # one gt on anchor 0's box
+        a0 = anchors.asnumpy()[0, 0]
+        labels = nd.array(np.array([[[0.0, *a0]]], np.float32))
+        cls_preds = nd.array(rng.rand(1, 2, 40).astype(np.float32))
+        bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds,
+                                       negative_mining_ratio=3.0)
+        ct = ct.asnumpy()[0]
+        n_pos = (ct > 0).sum()
+        n_bg = (ct == 0).sum()
+        n_ignored = (ct == -1).sum()
+        assert n_pos >= 1
+        assert n_bg <= 3 * n_pos + 2  # ratio bound (+ threshold ties)
+        assert n_ignored > 0
