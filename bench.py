@@ -53,7 +53,9 @@ def _build(batch, seq):
 
 def main():
     seq = 128
-    measure_steps = 20
+    # windows of 10: the end-of-window loss sync costs a full tunnel round
+    # trip (~20 ms), so short windows understate throughput
+    measure_steps = 40
     # import ONCE up front: a structural failure (bad module, registry bug)
     # must surface as itself, not as a re-import artifact from a retry
     try:

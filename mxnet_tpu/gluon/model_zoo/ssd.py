@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from ...base import MXNetError
 from ..block import HybridBlock
-from ..nn import BatchNorm, Conv2D, HybridSequential, MaxPool2D
+from ..nn import Activation, BatchNorm, Conv2D, HybridSequential, MaxPool2D
 
 __all__ = ["SSD", "ssd_tiny", "SSDTargetGenerator"]
 
@@ -22,7 +22,8 @@ def _down_block(channels):
     blk = HybridSequential()
     for _ in range(2):
         blk.add(Conv2D(channels, kernel_size=3, padding=1),
-                BatchNorm(in_channels=channels),)
+                BatchNorm(in_channels=channels),
+                Activation("relu"))
     blk.add(MaxPool2D(pool_size=2, strides=2))
     return blk
 

@@ -41,6 +41,15 @@ class Module:
         self.params_initialized = False
         self.optimizer_initialized = False
         self._logger = logger
+        # multi-device data parallelism: the reference's
+        # DataParallelExecutorGroup replicated one executor per GPU and
+        # host-split batches; here a context LIST becomes a 1-d device mesh
+        # and batches are sharded over it — GSPMD partitions the ONE jitted
+        # executor program (grad psum inserted automatically)
+        self._context = list(context) if isinstance(
+            context, (list, tuple)
+        ) else ([context] if context is not None else None)
+        self._data_sharding = None
 
     # ------------------------------------------------------------ properties
     @property
@@ -77,6 +86,14 @@ class Module:
         self._exec = self._symbol.simple_bind(
             grad_req=grad_req if for_training else "null", **shapes
         )
+        if self._context and len(self._context) > 1:
+            import numpy as _onp
+
+            from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+            devs = [c.jax_device() for c in self._context]
+            mesh = Mesh(_onp.array(devs), ("data",))
+            self._data_sharding = NamedSharding(mesh, PartitionSpec("data"))
         self._for_training = for_training
         self.binded = True
 
@@ -121,11 +138,28 @@ class Module:
             is_train = self._for_training
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
-            feed[name] = arr
+            feed[name] = self._shard(arr)
         if data_batch.label:
             for name, arr in zip(self._label_names, data_batch.label):
-                feed[name] = arr
+                feed[name] = self._shard(arr)
         self._exec.forward(is_train=is_train, **feed)
+
+    def _shard(self, arr):
+        """Split a batch over the context mesh (DataParallelExecutorGroup
+        role); no-op for a single context."""
+        if self._data_sharding is None:
+            return arr
+        import jax
+
+        data = arr.data if isinstance(arr, NDArray) else jnp.asarray(arr)
+        n = len(self._context)
+        if data.shape[0] % n:
+            raise MXNetError(
+                f"batch size {data.shape[0]} is not divisible by the "
+                f"{n} contexts; pick a batch size that splits evenly "
+                "(NDArrayIter pads the final batch to batch_size)"
+            )
+        return NDArray(jax.device_put(data, self._data_sharding))
 
     def backward(self, out_grads=None):
         self._exec.backward(out_grads)

@@ -439,16 +439,32 @@ def multibox_target(anchors, labels, cls_preds, overlap_threshold=0.5,
         best_iou = jnp.max(iou, axis=1)
         matched = jnp.logical_and(best_iou >= overlap_threshold,
                                   best_iou > 0)
-        # each valid gt claims its best anchor (bipartite guarantee);
-        # padded rows scatter out of bounds and are dropped
-        best_anchor = jnp.where(valid, jnp.argmax(iou, axis=0), N)  # (M,)
-        forced = jnp.zeros((N,), bool).at[best_anchor].set(
-            True, mode="drop"
+        # greedy bipartite matching (reference dmlc matcher): M rounds of
+        # global-argmax over still-available (anchor, gt) pairs, so two
+        # gts sharing a best anchor each claim a distinct one
+        def bipartite_round(carry, _):
+            gt_of, avail_a, avail_g = carry
+            masked = jnp.where(
+                jnp.logical_and(avail_a[:, None], avail_g[None, :]),
+                iou, -1.0,
+            )
+            flat = jnp.argmax(masked)
+            i, j = flat // M, flat % M
+            ok = masked.reshape(-1)[flat] > 1e-12
+            gt_of = jnp.where(
+                ok, gt_of.at[i].set(j.astype(jnp.int32)), gt_of
+            )
+            avail_a = jnp.where(ok, avail_a.at[i].set(False), avail_a)
+            avail_g = jnp.where(ok, avail_g.at[j].set(False), avail_g)
+            return (gt_of, avail_a, avail_g), 0
+
+        (gt_of_forced, _, _), _ = jax.lax.scan(
+            bipartite_round,
+            (jnp.full((N,), -1, jnp.int32), jnp.ones((N,), bool), valid),
+            None, length=M,
         )
-        gt_of_forced = jnp.zeros((N,), jnp.int32).at[best_anchor].set(
-            jnp.arange(M, dtype=jnp.int32), mode="drop"
-        )
-        assign = jnp.where(forced, gt_of_forced, best_gt)
+        forced = gt_of_forced >= 0
+        assign = jnp.where(forced, jnp.maximum(gt_of_forced, 0), best_gt)
         pos = jnp.logical_or(matched, forced)
 
         # encode via the shared box_encode kernel (batch of 1)

@@ -373,10 +373,17 @@ class TrainStep:
         frozen_vals = {n: v for n, v in self._values.items()
                        if n not in train_set}
         key = _random.next_key()
+        # scalar operands cost a host->device transfer each; lr/rescale are
+        # usually step-invariant, so reuse their device buffers
+        rescale = self._optimizer.rescale_grad
+        if getattr(self, "_lr_host", None) != lr:
+            self._lr_host, self._lr_dev = lr, jnp.float32(lr)
+        if getattr(self, "_rescale_host", None) != rescale:
+            self._rescale_host = rescale
+            self._rescale_dev = jnp.float32(rescale)
         L, new_vals, self._opt_state, aux = self._step_fn(
             train_vals, frozen_vals, self._opt_state, tuple(batch), label,
-            key, jnp.float32(lr), jnp.int32(self._t),
-            jnp.float32(self._optimizer.rescale_grad),
+            key, self._lr_dev, jnp.int32(self._t), self._rescale_dev,
         )
         self._values.update(new_vals)
         for n, v in aux.items():

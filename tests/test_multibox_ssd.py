@@ -231,9 +231,10 @@ class TestReviewRegressions:
 
     def test_hard_negative_mining(self):
         rng = np.random.RandomState(0)
-        anchors = nd.array(rng.rand(1, 40, 4).astype(np.float32) * 0.01 +
-                           np.linspace(0, 0.9, 40)[None, :, None]
-                           .astype(np.float32))
+        lo = np.linspace(0, 0.85, 40).astype(np.float32)
+        anchors = nd.array(
+            np.stack([lo, lo, lo + 0.1, lo + 0.1], axis=-1)[None]
+        )
         # one gt on anchor 0's box
         a0 = anchors.asnumpy()[0, 0]
         labels = nd.array(np.array([[[0.0, *a0]]], np.float32))
@@ -247,3 +248,19 @@ class TestReviewRegressions:
         assert n_pos >= 1
         assert n_bg <= 3 * n_pos + 2  # ratio bound (+ threshold ties)
         assert n_ignored > 0
+
+    def test_two_gts_sharing_best_anchor_both_match(self):
+        """Greedy bipartite: the second gt claims its next-best anchor."""
+        anchors = nd.array(np.array(
+            [[[0.4, 0.4, 0.6, 0.6], [0.42, 0.42, 0.62, 0.62]]], np.float32
+        ))
+        # both gts' best anchor is 0 (first gt exactly, second closely)
+        labels = nd.array(np.array(
+            [[[0.0, 0.4, 0.4, 0.6, 0.6], [1.0, 0.41, 0.41, 0.61, 0.61]]],
+            np.float32,
+        ))
+        cls_preds = nd.zeros((1, 3, 2))
+        bt, bm, ct = nd.MultiBoxTarget(anchors, labels, cls_preds,
+                                       overlap_threshold=0.95)
+        ct = ct.asnumpy()[0]
+        assert set(ct.tolist()) == {1.0, 2.0}  # both classes assigned

@@ -618,3 +618,48 @@ def test_batchnorm_head_eval_single_output():
                   bn7_moving_mean=nd.zeros((3,)),
                   bn7_moving_var=nd.ones((3,)))
     assert len(outs) == 1  # matches list_outputs()
+
+
+class TestModuleDataParallel:
+    """context=[cpu(0)..cpu(7)] shards batches over a device mesh — the
+    reference DataParallelExecutorGroup semantics via GSPMD."""
+
+    def _fit(self, ctxs, seed=0):
+        import mxnet_tpu as mx
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        x = sym.var("data")
+        net = sym.SoftmaxOutput(
+            sym.FullyConnected(x, num_hidden=4, name="fcdp"), name="softmax"
+        )
+        mod = Module(net, data_names=("data",),
+                     label_names=("softmax_label",), context=ctxs)
+        rng = np.random.RandomState(0)
+        X = rng.rand(64, 8).astype(np.float32)
+        y = rng.randint(0, 4, 64).astype(np.float32)
+        it = NDArrayIter(X, y, batch_size=16, label_name="softmax_label")
+        mod.fit(it, num_epoch=2, optimizer="sgd",
+                optimizer_params={"learning_rate": 0.1})
+        args, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in args.items()}
+
+    def test_multi_device_matches_single(self):
+        import mxnet_tpu as mx
+
+        single = self._fit(None)
+        multi = self._fit([mx.cpu(i) for i in range(8)])
+        for k in single:
+            np.testing.assert_allclose(single[k], multi[k], rtol=1e-4,
+                                       atol=1e-5, err_msg=k)
+
+    def test_sharded_input_really_distributed(self):
+        import mxnet_tpu as mx
+
+        ctxs = [mx.cpu(i) for i in range(8)]
+        x = sym.var("data")
+        net = sym.FullyConnected(x, num_hidden=2, name="fcdp2")
+        mod = Module(net, data_names=("data",), label_names=())
+        mod._context = ctxs
+        mod.bind(data_shapes=[("data", (16, 4))], for_training=False)
+        sharded = mod._shard(nd.ones((16, 4)))
+        assert len(sharded.data.sharding.device_set) == 8
