@@ -18,7 +18,7 @@ import tempfile
 import numpy as np
 
 
-def build_step(batch, seq=128):
+def build_step(batch, seq=128, loss="fused"):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, optimizer as opt
     from mxnet_tpu.gluon.model_zoo.bert import BERTModel
@@ -33,6 +33,8 @@ def build_step(batch, seq=128):
 
     def loss_fn(seq_out, pooled, label):
         w = word_w.data()
+        if loss == "fused":
+            return mx.nd.linear_cross_entropy(seq_out, w, label)
         logits = seq_out.reshape(-1, seq_out.shape[-1]).dot(w.T)
         return ce(logits, label.reshape(-1))
 
@@ -81,9 +83,10 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--keep", default=None,
                     help="keep the trace at this directory")
+    ap.add_argument("--loss", default="fused", choices=("fused", "naive"))
     args = ap.parse_args()
     trace_dir = args.keep or tempfile.mkdtemp(prefix="bert_trace_")
-    step, ids, labels = build_step(args.batch)
+    step, ids, labels = build_step(args.batch, loss=args.loss)
     capture(step, ids, labels, trace_dir, args.steps)
     ms = analyze(trace_dir, args.steps)
     tok = args.batch * 128 / (ms / 1e3)

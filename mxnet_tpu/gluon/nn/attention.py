@@ -118,23 +118,19 @@ class MultiHeadAttention(HybridBlock):
                     f"mesh's axes {mesh.axis_names}"
                 )
         if use_ring:
-            if valid_length is not None:
-                raise MXNetError(
-                    "valid_length is not supported with sequence-parallel "
-                    "attention yet; pad to full length or use the "
-                    "single-chip kernel"
-                )
             if self._seq_mode == "ulysses":
                 from ...parallel.ulysses import ulysses_attention
 
                 out = ulysses_attention(
                     q, k, v, mesh, self._ring_axis, causal=self._causal,
                     sm_scale=1.0 / math.sqrt(self._head_dim),
+                    valid_length=valid_length,
                 )
             else:
                 out = ring_flash_attention(
                     q, k, v, mesh, self._ring_axis, causal=self._causal,
                     sm_scale=1.0 / math.sqrt(self._head_dim),
+                    valid_length=valid_length,
                 )
         else:
             out = F.flash_attention(

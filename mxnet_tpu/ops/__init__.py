@@ -13,3 +13,4 @@ from . import tensor  # noqa: F401 - registers tensor ops
 from . import nn  # noqa: F401 - registers nn ops
 from . import contrib  # noqa: F401 - registers contrib ops
 from . import optimizer_op  # noqa: F401 - registers fused optimizer updates
+from . import fused_loss  # noqa: F401 - registers blocked vocab-proj + CE

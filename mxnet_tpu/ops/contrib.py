@@ -16,6 +16,7 @@ code ports unchanged.
 from __future__ import annotations
 
 import math
+import os as _os
 
 import numpy as _np
 import jax
@@ -334,20 +335,57 @@ def count_sketch(data, h, s, out_dim=None, **kw):  # rarely used; minimal
 
 
 # ------------------------------------------------------- fused attention
+# Below this key length the exact dense path beats the flash kernel on TPU:
+# the whole (B,H,Sq,Sk) score tile fits comfortably in HBM/VMEM and XLA
+# fuses qk->softmax->pv better than the kernel's block machinery amortizes
+# (measured on v5e-lite, BERT b64 s128: dense 50.6 ms/step vs flash 57.3).
+_DENSE_MAX_SEQ = int(_os.environ.get("MXTPU_ATTN_DENSE_MAX", "256"))
+
+
+def _dense_attention(q, k, v, valid_length, causal, sm_scale):
+    """Exact softmax attention; f32 scores, grad via XLA autodiff."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if valid_length is not None:
+        mask = jnp.arange(k.shape[2])[None, None, None, :] < \
+            valid_length.astype(jnp.int32)[:, None, None, None]
+        s = jnp.where(mask, s, -jnp.inf)
+    if causal:
+        qi = jnp.arange(q.shape[2])[:, None]
+        ki = jnp.arange(k.shape[2])[None, :]
+        s = jnp.where(qi >= ki, s, -jnp.inf)
+    p = jax.nn.softmax(s, axis=-1)
+    # fully-masked rows (valid_length == 0) produce NaN softmax; zero them
+    # like the flash kernel does
+    if valid_length is not None:
+        p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
 @register("_contrib_flash_attention", aliases=["flash_attention"])
 def _flash_attention_op(query, key, value, valid_length=None, causal=False,
                         sm_scale=None, block_q=128, block_k=128, **kw):
-    """Fused O(S)-memory attention over the Pallas kernel (beyond-reference:
-    replaces the O(L^2) interleaved ops of src/operator/contrib/transformer.cc
-    [unverified] as the long-context path). Shapes (B, H, S, D);
-    ``valid_length`` (B,) masks padding keys (reference softmax
-    ``use_length`` semantics)."""
+    """Fused O(S)-memory attention (beyond-reference: replaces the O(L^2)
+    interleaved ops of src/operator/contrib/transformer.cc [unverified] as
+    the long-context path). Shapes (B, H, S, D); ``valid_length`` (B,)
+    masks padding keys (reference softmax ``use_length`` semantics).
+
+    Short sequences (Sk <= MXTPU_ATTN_DENSE_MAX, default 256) take an exact
+    dense path — at these sizes the score tile is small and XLA's fusion
+    beats the flash kernel's block overhead; long sequences take the
+    O(S)-memory Pallas flash kernel. Both are numerically exact softmax
+    attention."""
     from .pallas import flash_attention as _fa
 
     # keyword args bypass invoke()'s NDArray unwrapping — accept both
     # styles; NOT getattr(..., "data"): numpy arrays expose a memoryview
     if hasattr(valid_length, "asnumpy"):
         valid_length = valid_length.data
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(query.shape[-1])
+    if max(query.shape[2], key.shape[2]) <= _DENSE_MAX_SEQ:
+        return _dense_attention(query, key, value, valid_length,
+                                bool(causal), float(sm_scale))
     return _fa(query, key, value, valid_length, bool(causal), sm_scale,
                int(block_q), int(block_k))
 

@@ -1,0 +1,155 @@
+"""Fused (blocked) vocab-projection + softmax cross-entropy.
+
+TPU-native replacement for the reference's ``FullyConnected -> SoftmaxOutput``
+tail on language models (reference: ``src/operator/nn/fully_connected.cc`` +
+``src/operator/nn/softmax.cc`` [unverified]).  On a 30k+ vocabulary the naive
+pipeline materializes a (B*S, V) logits tensor *and its gradient* in HBM —
+at B*S=8192, V=30522 that is ~1 GB of f32 traffic per step, and it dominated
+the BERT/Transformer benchmarks in round 2 (see BASELINE.md).
+
+The fused form never materializes logits.  Forward runs an online-logsumexp
+scan over vocabulary blocks (the flash-attention trick applied to the
+classifier head): each block computes an (N, Vb) logits tile on the MXU,
+folds it into running (max, sumexp) statistics, and discards it.  The label
+logit comes from a row gather of W.  Backward re-runs the scan, rebuilding
+each softmax tile from the saved statistics and accumulating
+
+    dx  = sum_b (g * p_b) @ W_b          - g * W[labels]
+    dW_b = (g * p_b)^T @ x               (scatter  -g*x  into label rows)
+
+so peak memory is one (N, Vb) tile instead of (N, V).  All matmuls accumulate
+in f32 (``preferred_element_type``) regardless of the bf16 inputs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = ["linear_cross_entropy"]
+
+
+def _pad_vocab(w, block):
+    v = w.shape[0]
+    vpad = ((v + block - 1) // block) * block
+    if vpad != v:
+        w = jnp.pad(w, ((0, vpad - v), (0, 0)))
+    return w, vpad
+
+
+def _fwd_scan(x, w, block, valid_v):
+    """Online logsumexp over vocab blocks. Returns (m, s): (N,) f32 each."""
+    n = x.shape[0]
+    wp, vpad = _pad_vocab(w, block)
+    nblocks = vpad // block
+    wb_all = wp.reshape(nblocks, block, wp.shape[1])
+
+    def body(carry, wb_i):
+        m, s = carry
+        wb, i = wb_i
+        logits = jnp.dot(x, wb.T, preferred_element_type=jnp.float32)
+        # mask vocab padding (only the last block can contain it)
+        col = i * block + jax.lax.iota(jnp.int32, block)
+        logits = jnp.where(col[None, :] < valid_v, logits, -jnp.inf)
+        bm = jnp.max(logits, axis=-1)
+        nm = jnp.maximum(m, bm)
+        s = s * jnp.exp(m - nm) + jnp.sum(jnp.exp(logits - nm[:, None]), axis=-1)
+        return (nm, s), None
+
+    init = (jnp.full((n,), -jnp.inf, jnp.float32), jnp.zeros((n,), jnp.float32))
+    (m, s), _ = jax.lax.scan(body, init, (wb_all, jnp.arange(nblocks)))
+    return m, s
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _linear_ce(x, w, labels, block, ignore_label):
+    m, s = _fwd_scan(x, w, block, w.shape[0])
+    wl = jnp.take(w, labels, axis=0)  # (N, H)
+    label_logit = jnp.sum(
+        x.astype(jnp.float32) * wl.astype(jnp.float32), axis=-1
+    )
+    loss = (m + jnp.log(s)) - label_logit
+    if ignore_label is not None:
+        loss = jnp.where(labels == ignore_label, 0.0, loss)
+    return loss
+
+
+def _linear_ce_fwd(x, w, labels, block, ignore_label):
+    m, s = _fwd_scan(x, w, block, w.shape[0])
+    wl = jnp.take(w, labels, axis=0)
+    label_logit = jnp.sum(
+        x.astype(jnp.float32) * wl.astype(jnp.float32), axis=-1
+    )
+    loss = (m + jnp.log(s)) - label_logit
+    if ignore_label is not None:
+        loss = jnp.where(labels == ignore_label, 0.0, loss)
+    return loss, (x, w, labels, m, s)
+
+
+def _linear_ce_bwd(block, ignore_label, res, g):
+    x, w, labels, m, s = res
+    n, h = x.shape
+    v = w.shape[0]
+    if ignore_label is not None:
+        g = jnp.where(labels == ignore_label, 0.0, g)
+    g = g.astype(jnp.float32)
+    wp, vpad = _pad_vocab(w, block)
+    nblocks = vpad // block
+    wb_all = wp.reshape(nblocks, block, h)
+    log_z = (m + jnp.log(s))[:, None]  # (N, 1)
+
+    def body(dx, wb_i):
+        wb, i = wb_i
+        logits = jnp.dot(x, wb.T, preferred_element_type=jnp.float32)
+        col = i * block + jax.lax.iota(jnp.int32, block)
+        logits = jnp.where(col[None, :] < v, logits, -jnp.inf)
+        gp = g[:, None] * jnp.exp(logits - log_z)  # (N, Vb) f32
+        gp_c = gp.astype(x.dtype)
+        dx = dx + jnp.dot(gp_c, wb, preferred_element_type=jnp.float32)
+        dwb = jnp.dot(gp_c.T, x, preferred_element_type=jnp.float32)
+        return dx, dwb
+
+    dx0 = jnp.zeros((n, h), jnp.float32)
+    dx, dw_blocks = jax.lax.scan(body, dx0, (wb_all, jnp.arange(nblocks)))
+    dw = dw_blocks.reshape(vpad, h)[:v]
+    # label-row corrections: dx -= g*W[labels];  dW[labels] -= g*x
+    wl = jnp.take(w, labels, axis=0).astype(jnp.float32)
+    dx = dx - g[:, None] * wl
+    dw = dw - jax.ops.segment_sum(
+        g[:, None] * x.astype(jnp.float32), labels, num_segments=v
+    )
+    return dx.astype(x.dtype), dw.astype(w.dtype), None
+
+
+_linear_ce.defvjp(_linear_ce_fwd, _linear_ce_bwd)
+
+
+@register("linear_cross_entropy", namespaces=("nd", "npx"))
+def linear_cross_entropy(x, weight, labels, block_size=8192,
+                         ignore_label: Optional[int] = None, **kw):
+    """Cross-entropy of ``softmax(x @ weight.T)`` against integer ``labels``
+    without materializing the (N, V) logits.
+
+    Args:
+        x: (..., H) activations (any leading shape; flattened internally).
+        weight: (V, H) classifier / tied-embedding matrix.
+        labels: (...,) int class ids, same leading shape as ``x``.
+        block_size: vocab tile width of the online-softmax scan.
+        ignore_label: optional label id whose rows contribute zero loss
+            (the reference's ``ignore_label`` on SoftmaxOutput).
+
+    Returns:
+        (...,) per-element losses (f32) with the leading shape of ``labels``.
+    """
+    lead = labels.shape
+    h = x.shape[-1]
+    xf = x.reshape(-1, h)
+    lf = labels.reshape(-1).astype(jnp.int32)
+    block = int(min(block_size, max(256, weight.shape[0])))
+    loss = _linear_ce(xf, weight, lf, block, ignore_label)
+    return loss.reshape(lead)

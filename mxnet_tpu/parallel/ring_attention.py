@@ -57,10 +57,12 @@ def _merge(acc_out, acc_lse, out_s, lse_s):
     m_safe = jnp.maximum(m, _NEG_INF / 2)
     w_acc = jnp.exp(acc_lse - m_safe)[..., None]
     w_s = jnp.exp(lse_s - m_safe)[..., None]
+    # floor must be a NORMAL f32: 1e-38 is subnormal and flushes to zero
+    # on FTZ backends, turning fully-masked rows into 0/0 = NaN
     new_out = (acc_out * w_acc + out_s * w_s) / jnp.maximum(
-        w_acc + w_s, 1e-38
+        w_acc + w_s, 1e-30
     )
-    new_lse = m_safe + jnp.log(jnp.maximum(w_acc + w_s, 1e-38))[..., 0]
+    new_lse = m_safe + jnp.log(jnp.maximum(w_acc + w_s, 1e-30))[..., 0]
     return new_out, new_lse
 
 
@@ -68,40 +70,58 @@ def _ring_perm(axis_name, n):
     return [(j, (j + 1) % n) for j in range(n)]
 
 
-def _ring_fwd(q, k, v, axis_name, causal, sm_scale):
-    """Inside shard_map: q/k/v are LOCAL chunks (B, H, S_local, D)."""
+def _local_vl(vl, j, s_local):
+    """Per-visiting-chunk key budget: chunk j holds GLOBAL key positions
+    [j*s_local, (j+1)*s_local), so a row with global valid_length ``vl``
+    keeps ``clip(vl - j*s_local, 0, s_local)`` keys of it (the flash
+    kernel's local valid_length semantics)."""
+    if vl is None:
+        return None
+    return jnp.clip(vl.astype(jnp.int32) - j * s_local, 0, s_local)
+
+
+def _ring_fwd(q, k, v, vl, axis_name, causal, sm_scale):
+    """Inside shard_map: q/k/v are LOCAL chunks (B, H, S_local, D);
+    ``vl`` (B,) is the GLOBAL per-row valid key length (or None)."""
     n = jax.lax.psum(1, axis_name)  # static axis size
     i = jax.lax.axis_index(axis_name)
     perm = _ring_perm(axis_name, n)
+    s_local = k.shape[2]
 
-    out0, lse0 = _flash_fwd(q, k, v, None, causal, sm_scale, 128, 128)
+    out0, lse0 = _flash_fwd(q, k, v, _local_vl(vl, i, s_local), causal,
+                            sm_scale, 128, 128)
     acc_out = out0.astype(jnp.float32)
     acc_lse = lse0
     k_cur, v_cur = k, v
     for s in range(1, n):
         k_cur = jax.lax.ppermute(k_cur, axis_name, perm)
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
-        out_s, lse_s = _flash_fwd(q, k_cur, v_cur, None, False, sm_scale,
-                                  128, 128)
+        j = (i - s) % n  # home index of the chunk visiting at step s
+        out_s, lse_s = _flash_fwd(q, k_cur, v_cur, _local_vl(vl, j, s_local),
+                                  False, sm_scale, 128, 128)
         if causal:
             include = i >= s  # visiting chunk j=(i-s)%n is fully past iff so
             lse_s = jnp.where(include, lse_s, _NEG_INF)
+        # a fully-masked visiting chunk (vl <= j*s_local) contributes
+        # nothing: its kernel rows come back with lse == -inf already, so
+        # the merge drops them without extra handling
         acc_out, acc_lse = _merge(acc_out, acc_lse, out_s.astype(jnp.float32),
                                   lse_s)
     return acc_out.astype(q.dtype), acc_lse
 
 
 def _ring_bwd_math(q, k_cur, v_cur, g, out, lse, sm_scale, local_causal,
-                   include):
+                   include, vl_local=None):
     """Gradient contributions of one visiting chunk: the single-chip
     blockwise-recompute backward with the GLOBAL lse — O(S_local·block)
     memory, never the full S_local² score matrix."""
     from ..ops.pallas.flash_attention import _flash_bwd_impl
 
     B = q.shape[0]
-    full = jnp.full((B,), k_cur.shape[2], jnp.int32)
+    if vl_local is None:
+        vl_local = jnp.full((B,), k_cur.shape[2], jnp.int32)
     dq_b, dk_b, dv_b = _flash_bwd_impl(
-        q, k_cur, v_cur, full, out, lse, g, local_causal, sm_scale, 128
+        q, k_cur, v_cur, vl_local, out, lse, g, local_causal, sm_scale, 128
     )
     if include is not None:  # all-or-nothing chunk inclusion (causal ring)
         dq_b = dq_b * include
@@ -112,10 +132,12 @@ def _ring_bwd_math(q, k_cur, v_cur, g, out, lse, sm_scale, local_causal,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def ring_flash_attention_shard(q, k, v, axis_name, causal=False,
-                               sm_scale=None):
+                               sm_scale=None, valid_length=None):
     """Ring attention over ``axis_name``; call INSIDE shard_map with the
-    sequence dimension sharded over that axis. Shapes (B, H, S_local, D)."""
-    out, _ = _ring_fwd(q, k, v, axis_name, causal,
+    sequence dimension sharded over that axis. Shapes (B, H, S_local, D);
+    ``valid_length`` (B,) GLOBAL key budget per row, or None (placed last
+    so positional (q, k, v, axis_name, ...) callers keep working)."""
+    out, _ = _ring_fwd(q, k, v, valid_length, axis_name, causal,
                        _scale(sm_scale, q))
     return out
 
@@ -126,21 +148,24 @@ def _scale(sm_scale, q):
     )
 
 
-def _ring_fwd_rule(q, k, v, axis_name, causal, sm_scale):
-    out, lse = _ring_fwd(q, k, v, axis_name, causal, _scale(sm_scale, q))
-    return out, (q, k, v, out, lse)
+def _ring_fwd_rule(q, k, v, axis_name, causal, sm_scale, valid_length):
+    out, lse = _ring_fwd(q, k, v, valid_length, axis_name, causal,
+                         _scale(sm_scale, q))
+    return out, (q, k, v, valid_length, out, lse)
 
 
 def _ring_bwd_rule(axis_name, causal, sm_scale, res, g):
-    q, k, v, out, lse = res
+    q, k, v, vl, out, lse = res
     scale = _scale(sm_scale, q)
     n = jax.lax.psum(1, axis_name)
     i = jax.lax.axis_index(axis_name)
     perm = _ring_perm(axis_name, n)
+    s_local = k.shape[2]
 
     # step 0: diagonal chunk (local causal when causal)
     dq0, dk0, dv0 = _ring_bwd_math(
-        q, k, v, g, out, lse, scale, local_causal=causal, include=None
+        q, k, v, g, out, lse, scale, local_causal=causal, include=None,
+        vl_local=_local_vl(vl, i, s_local),
     )
     dq = dq0.astype(jnp.float32)
     dk_cur = dk0.astype(jnp.float32)
@@ -153,10 +178,11 @@ def _ring_bwd_rule(axis_name, causal, sm_scale, res, g):
         v_cur = jax.lax.ppermute(v_cur, axis_name, perm)
         dk_cur = jax.lax.ppermute(dk_cur, axis_name, perm)
         dv_cur = jax.lax.ppermute(dv_cur, axis_name, perm)
+        j = (i - s) % n
         include = (i >= s).astype(jnp.float32) if causal else None
         dq_b, dk_b, dv_b = _ring_bwd_math(
             q, k_cur, v_cur, g, out, lse, scale, local_causal=False,
-            include=include,
+            include=include, vl_local=_local_vl(vl, j, s_local),
         )
         dq = dq + dq_b.astype(jnp.float32)
         dk_cur = dk_cur + dk_b.astype(jnp.float32)
@@ -164,45 +190,66 @@ def _ring_bwd_rule(axis_name, causal, sm_scale, res, g):
     # one more rotation brings accumulators back to their home device
     dk = jax.lax.ppermute(dk_cur, axis_name, perm)
     dv = jax.lax.ppermute(dv_cur, axis_name, perm)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None)
 
 
 ring_flash_attention_shard.defvjp(_ring_fwd_rule, _ring_bwd_rule)
 
 
 def _seq_parallel_call(shard_fn, q, k, v, mesh, axis, causal, sm_scale,
-                       batch_axis, precheck=None):
+                       batch_axis, precheck=None, valid_length=None):
     """Shared wrapper for sequence-parallel attention variants: NDArray
     unwrap/rewrap, batch-axis resolution (shard B over ``batch_axis`` when
     the mesh has it — replicating B over 'data' would silently double
     attention FLOPs per device), and the shard_map plumbing. Composes
-    under jit — GSPMD sees an opaque manually-sharded region."""
+    under jit — GSPMD sees an opaque manually-sharded region.
+
+    ``valid_length`` (B,) is the GLOBAL per-row key budget; each variant
+    translates it to its own local masking (ring: per-visiting-chunk
+    offsets; ulysses: pass-through after the all_to_all)."""
     from ..ndarray.ndarray import NDArray
 
     unwrap = lambda x: x.data if isinstance(x, NDArray) else x  # noqa: E731
     wrapped = isinstance(q, NDArray)
     q, k, v = unwrap(q), unwrap(k), unwrap(v)
+    vl = unwrap(valid_length) if valid_length is not None else None
     if precheck is not None:
         precheck(q)
     b_ax = batch_axis if (batch_axis in mesh.axis_names
                           and batch_axis != axis) else None
     spec = PartitionSpec(b_ax, None, axis, None)
+    in_specs = (spec, spec, spec)
+    args = (q, k, v)
+    if vl is not None:
+        in_specs = in_specs + (PartitionSpec(b_ax),)
+        args = args + (vl,)
+
+        def inner(q, k, v, vl_):
+            return shard_fn(q, k, v, axis_name=axis, causal=causal,
+                            sm_scale=sm_scale, valid_length=vl_)
+    else:
+        inner = functools.partial(shard_fn, axis_name=axis, causal=causal,
+                                  sm_scale=sm_scale, valid_length=None)
     fn = shard_map(
-        functools.partial(shard_fn, axis_name=axis, causal=causal,
-                          sm_scale=sm_scale),
-        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        inner,
+        mesh=mesh, in_specs=in_specs, out_specs=spec,
         check_vma=False,  # pallas_call out_shapes carry no vma info
     )
-    out = fn(q, k, v)
+    out = fn(*args)
     return NDArray(out) if wrapped else out
 
 
 def ring_flash_attention(q, k, v, mesh: Mesh, axis: str = "seq",
-                         causal=False, sm_scale=None, batch_axis="data"):
+                         causal=False, sm_scale=None, batch_axis="data",
+                         valid_length=None):
     """Sequence-parallel attention over ``mesh`` axis ``axis``.
 
     q/k/v (B, H, S, D) with S divisible by the axis size; K/V chunks
-    rotate around the ring via ppermute (see module docstring). See also
-    ``parallel.ulysses`` for the all-to-all variant."""
+    rotate around the ring via ppermute (see module docstring).
+    ``valid_length`` (B,) int: GLOBAL count of non-padding key positions
+    per row (ragged batches). See also ``parallel.ulysses`` for the
+    all-to-all variant."""
     return _seq_parallel_call(ring_flash_attention_shard, q, k, v, mesh,
-                              axis, causal, sm_scale, batch_axis)
+                              axis, causal, sm_scale, batch_axis,
+                              valid_length=valid_length)

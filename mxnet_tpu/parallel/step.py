@@ -155,12 +155,18 @@ class TrainStep:
                  data_spec: Optional[PartitionSpec] = None,
                  param_rules: Sequence[Tuple[str, PartitionSpec]] = (),
                  donate: bool = True, grad_accum: int = 1,
-                 compute_dtype=None, state_dtype=None):
+                 compute_dtype=None, state_dtype=None, steps_per_call: int = 1):
         self._net = net
         self._loss = loss_fn
         self._optimizer = optimizer
         self._mesh = mesh
         self._accum = int(grad_accum)
+        # steps_per_call > 1: run that many full optimizer steps per
+        # dispatch via a device-side lax.scan; batch inputs then carry a
+        # leading (steps_per_call,) axis of distinct microbatches. Trades
+        # per-step host control (lr schedule moves only between calls) for
+        # dispatch latency — the standard JAX input-dispatch amortization.
+        self._steps_per_call = int(steps_per_call)
         # AMP: cast float params/inputs to this dtype INSIDE the jitted step.
         # The step differentiates W.R.T. THE CAST COPIES, so gradients carry
         # the compute dtype — the reference's multi-precision scheme exactly
@@ -196,7 +202,17 @@ class TrainStep:
             if data_spec is None:
                 data_spec = PartitionSpec("data") if "data" in axis_names \
                     else PartitionSpec()
-            self._data_sharding = NamedSharding(mesh, data_spec)
+            # data_spec may be ONE spec for every input, or a sequence of
+            # per-input specs covering (*batch, label) — ragged inputs like
+            # a (B,) valid_length can't share the (B, S) spec
+            if isinstance(data_spec, (tuple, list)) and not isinstance(
+                data_spec, PartitionSpec
+            ):
+                self._data_sharding = [
+                    NamedSharding(mesh, s) for s in data_spec
+                ]
+            else:
+                self._data_sharding = NamedSharding(mesh, data_spec)
             rules = [(re.compile(pat), spec) for pat, spec in param_rules]
 
             def param_sharding(name):
@@ -287,24 +303,29 @@ class TrainStep:
             return Lm, aux
 
         # rescale_grad is a dynamic operand: AMP dynamic loss scaling and
-        # batch-size changes fold into it per step and must not retrace
+        # batch-size changes fold into it per step and must not retrace.
+        # key and t are DEVICE-carried state (returned updated, donated):
+        # advancing them on host would cost a host->device transfer plus an
+        # eager dispatch per step — measurable over the tunneled backend.
         def step(train_vals, frozen_vals, opt_state, batch, label, key,
                  lr, t, rescale):
+            key, sub = jax.random.split(key)
+            t = t + 1
             # batch: tuple of arrays; with accum > 1 each has a leading
             # microbatch dim of size `accum` scanned by lax.scan
             cast_vals = {n: _cast(v) for n, v in train_vals.items()}
             if accum == 1:
                 (L, aux), grads = jax.value_and_grad(
                     forward_loss, has_aux=True
-                )(cast_vals, frozen_vals, batch, label, key)
+                )(cast_vals, frozen_vals, batch, label, sub)
             else:
                 def micro(carry, inp):
                     g_acc, k = carry
-                    k, sub = jax.random.split(k)
+                    k, sk = jax.random.split(k)
                     mb, ml = inp
                     (Lm, aux_m), g = jax.value_and_grad(
                         forward_loss, has_aux=True
-                    )(cast_vals, frozen_vals, mb, ml, sub)
+                    )(cast_vals, frozen_vals, mb, ml, sk)
                     # accumulate in f32 regardless of grad dtype
                     g_acc = jax.tree.map(
                         lambda a, b: a + b.astype(a.dtype), g_acc, g
@@ -315,7 +336,7 @@ class TrainStep:
                     lambda v: jnp.zeros(v.shape, jnp.float32), train_vals
                 )
                 (grads, _), (Ls, auxs) = jax.lax.scan(
-                    micro, (g0, key), (batch, label)
+                    micro, (g0, sub), (batch, label)
                 )
                 grads = jax.tree.map(lambda g: g / accum, grads)
                 L = Ls.mean()
@@ -338,9 +359,35 @@ class TrainStep:
                     s_new.astype(s_old.dtype)
                     for s_new, s_old in zip(ns, st)
                 )
-            return L, new_vals, new_opt, aux
+            return L, new_vals, new_opt, key, t, aux
 
-        donate_args = (0, 2) if donate else ()
+        nsteps = self._steps_per_call
+        if nsteps > 1:
+            # device-side training loop: scan `nsteps` FULL optimizer steps
+            # (distinct microbatches stacked on a leading axis) inside one
+            # executable — one dispatch amortizes host/tunnel latency over
+            # nsteps steps; the scan body is the single-step program, so
+            # compile time and numerics are unchanged
+            def multi(train_vals, frozen_vals, opt_state, batch, label, key,
+                      lr, t, rescale):
+                def one(carry, inp):
+                    tv, os_, k, tt = carry
+                    mb, ml = inp
+                    L, nv, no, nk, nt, aux = step(
+                        tv, frozen_vals, os_, mb, ml, k, lr, tt, rescale
+                    )
+                    return (nv, no, nk, nt), (L, aux)
+
+                (tv, os_, k, tt), (Ls, auxs) = jax.lax.scan(
+                    one, (train_vals, opt_state, key, t), (batch, label)
+                )
+                aux = jax.tree.map(lambda a: a[-1], auxs)
+                return Ls.mean(), tv, os_, k, tt, aux
+
+            donate_args = (0, 2, 5, 7) if donate else ()
+            return jax.jit(multi, donate_argnums=donate_args)
+
+        donate_args = (0, 2, 5, 7) if donate else ()
         return jax.jit(step, donate_argnums=donate_args)
 
     # ----------------------------------------------------------------- call
@@ -350,29 +397,57 @@ class TrainStep:
         batch = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                  for b in batch]
         label = label.data if isinstance(label, NDArray) else jnp.asarray(label)
-        if self._accum > 1:
-            n = self._accum
-            batch = [b.reshape((n, b.shape[0] // n) + b.shape[1:])
-                     for b in batch]
-            label = label.reshape((n, label.shape[0] // n) + label.shape[1:])
-        if self._data_sharding is not None:
-            # with accum, shard the per-microbatch axis (axis 1) instead
+        nsteps = self._steps_per_call
+        if nsteps > 1 or self._accum > 1:
+            # split the flat global batch into the leading axes consumed by
+            # the device-side loops: (nsteps, accum, microbatch, ...)
+            lead = (nsteps,) if nsteps > 1 else ()
             if self._accum > 1:
-                spec = self._data_sharding.spec
-                shard = NamedSharding(
-                    self._mesh, PartitionSpec(None, *spec)
-                )
+                lead = lead + (self._accum,)
+            n = 1
+            for d in lead:
+                n *= d
+
+            def _split(a):
+                return a.reshape(lead + (a.shape[0] // n,) + a.shape[1:])
+
+            batch = [_split(b) for b in batch]
+            label = _split(label)
+        if self._data_sharding is not None:
+            # leading step/accum axes are device-side loop axes, not data
+            # axes — shard the per-microbatch batch axis that follows them
+            nlead = (1 if nsteps > 1 else 0) + (1 if self._accum > 1 else 0)
+            if isinstance(self._data_sharding, list):
+                per_input = self._data_sharding
+                if len(per_input) != len(batch) + 1:
+                    raise MXNetError(
+                        f"data_spec sequence has {len(per_input)} specs but "
+                        f"the step takes {len(batch)} inputs + 1 label"
+                    )
             else:
-                shard = self._data_sharding
-            batch = [jax.device_put(b, shard) for b in batch]
-            label = jax.device_put(label, shard)
-        self._t += 1
+                per_input = [self._data_sharding] * (len(batch) + 1)
+            if nlead:
+                per_input = [
+                    NamedSharding(
+                        self._mesh,
+                        PartitionSpec(*([None] * nlead), *s.spec),
+                    )
+                    for s in per_input
+                ]
+            batch = [jax.device_put(b, s)
+                     for b, s in zip(batch, per_input[:-1])]
+            label = jax.device_put(label, per_input[-1])
+        self._t += nsteps
         lr = self._current_lr()
         train_set = set(self._train_names)
         train_vals = {n: self._values[n] for n in self._train_names}
         frozen_vals = {n: v for n, v in self._values.items()
                        if n not in train_set}
-        key = _random.next_key()
+        # key and t live on device, advanced inside the jitted step — the
+        # seed is drawn from mx.random state once, on the first step
+        if getattr(self, "_key_dev", None) is None:
+            self._key_dev = _random.next_key()
+            self._t_dev = jnp.int32(self._t - nsteps)
         # scalar operands cost a host->device transfer each; lr/rescale are
         # usually step-invariant, so reuse their device buffers
         rescale = self._optimizer.rescale_grad
@@ -381,10 +456,12 @@ class TrainStep:
         if getattr(self, "_rescale_host", None) != rescale:
             self._rescale_host = rescale
             self._rescale_dev = jnp.float32(rescale)
-        L, new_vals, self._opt_state, aux = self._step_fn(
-            train_vals, frozen_vals, self._opt_state, tuple(batch), label,
-            key, self._lr_dev, jnp.int32(self._t), self._rescale_dev,
-        )
+        L, new_vals, self._opt_state, self._key_dev, self._t_dev, aux = \
+            self._step_fn(
+                train_vals, frozen_vals, self._opt_state, tuple(batch),
+                label, self._key_dev, self._lr_dev, self._t_dev,
+                self._rescale_dev,
+            )
         self._values.update(new_vals)
         for n, v in aux.items():
             self._values[n] = v

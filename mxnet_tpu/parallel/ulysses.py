@@ -26,9 +26,13 @@ from ..ops.pallas.flash_attention import flash_attention
 __all__ = ["ulysses_attention", "ulysses_attention_shard"]
 
 
-def ulysses_attention_shard(q, k, v, axis_name, causal=False, sm_scale=None):
+def ulysses_attention_shard(q, k, v, axis_name=None, causal=False,
+                            sm_scale=None, valid_length=None):
     """Inside shard_map: q/k/v local chunks (B, H, S_local, D) sharded on
-    the sequence dim; returns the same layout."""
+    the sequence dim; returns the same layout. ``valid_length`` (B,) is
+    the GLOBAL key budget — after the all_to_all each device holds the
+    full sequence (for a head subset), so it applies unchanged (placed
+    last so positional (q, k, v, axis_name, ...) callers keep working)."""
 
     def swap_in(x):
         # (B, H, S/n, D) -> (B, H/n, S, D): scatter heads, gather sequence
@@ -45,15 +49,17 @@ def ulysses_attention_shard(q, k, v, axis_name, causal=False, sm_scale=None):
     )
     # full-sequence attention over the local head subset: exact, so causal
     # masking needs no cross-device bookkeeping (unlike the ring)
-    out = flash_attention(qh, kh, vh, None, causal=causal, sm_scale=scale)
+    out = flash_attention(qh, kh, vh, valid_length, causal=causal,
+                          sm_scale=scale)
     return swap_out(out)
 
 
 def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "seq", causal=False,
-                      sm_scale=None, batch_axis="data"):
+                      sm_scale=None, batch_axis="data", valid_length=None):
     """Sequence-parallel attention over ``mesh`` axis ``axis`` with one
     all-to-all pair. q/k/v (B, H, S, D), S divisible by the axis size,
-    H divisible by the axis size."""
+    H divisible by the axis size. ``valid_length`` (B,) int: GLOBAL count
+    of non-padding key positions per row."""
     from .ring_attention import _seq_parallel_call
 
     def check(qd):
@@ -67,5 +73,5 @@ def ulysses_attention(q, k, v, mesh: Mesh, axis: str = "seq", causal=False,
 
     return _seq_parallel_call(
         ulysses_attention_shard, q, k, v, mesh, axis, causal, sm_scale,
-        batch_axis, precheck=check,
+        batch_axis, precheck=check, valid_length=valid_length,
     )
