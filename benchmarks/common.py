@@ -1,8 +1,9 @@
-"""Shared benchmark harness: warmup, timed loop, driver JSON line."""
+"""Shared benchmark harness: warmup, timed windows, driver JSON line."""
 
 from __future__ import annotations
 
 import json
+import statistics
 import time
 
 
@@ -12,29 +13,31 @@ def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
 
     ``sync_fn`` must force completion via a host transfer — on the tunneled
     TPU backend ``block_until_ready`` does not actually block. The tunneled
-    chip is shared and noisy (observed 2-3x swings between runs), so the
-    loop is split into ``windows`` windows and the BEST window is reported —
-    the standard noisy-neighbor countermeasure; the best window is the one
-    closest to unperturbed hardware."""
+    chip is shared and noisy, so the loop is split into ``windows`` windows;
+    the MEDIAN window rate is the metric of record (the honest central
+    figure), with the best window and the full list reported alongside
+    (a best-only figure selects favorable noise; advisor round-2 finding).
+    """
     try:
         for _ in range(warmup):
             out = step_fn()
         sync_fn(out)
         per = max(1, steps // windows)
-        best = float("inf")
+        rates = []
         for _ in range(windows):
             t0 = time.perf_counter()
             for _ in range(per):
                 out = step_fn()
             sync_fn(out)
-            best = min(best, time.perf_counter() - t0)
-        dt = best
-        value = per * items_per_step / dt
+            rates.append(per * items_per_step / (time.perf_counter() - t0))
+        value = statistics.median(rates)
         print(json.dumps({
             "metric": metric,
             "value": round(value, 1),
             "unit": unit,
             "vs_baseline": round(value / ceiling, 4),
+            "best": round(max(rates), 1),
+            "windows": [round(r, 1) for r in rates],
         }))
         return value
     except Exception as e:  # noqa: BLE001 - driver wants a line either way

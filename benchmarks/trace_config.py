@@ -16,21 +16,24 @@ import numpy as np
 from .trace_bert import analyze
 
 
-def build_resnet50(batch=64):
+def build_resnet50(batch=64, layout="NCHW"):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, optimizer as opt
     from mxnet_tpu.gluon.model_zoo.vision import get_model
     from mxnet_tpu.parallel import TrainStep
 
-    net = get_model("resnet50_v1")
+    net = get_model("resnet50_v1", layout=layout)
     net.initialize(mx.initializer.Xavier())
-    net._probe_shapes(nd.zeros((2, 3, 224, 224)))
+    shape = (2, 224, 224, 3) if layout == "NHWC" else (2, 3, 224, 224)
+    net._probe_shapes(nd.zeros(shape))
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     step = TrainStep(net, lambda o, l: loss_fn(o, l),
                      opt.SGD(learning_rate=0.1, momentum=0.9),
                      compute_dtype="bfloat16", state_dtype="bfloat16")
     rng = np.random.RandomState(0)
-    x = nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))
+    xshape = (batch, 224, 224, 3) if layout == "NHWC" \
+        else (batch, 3, 224, 224)
+    x = nd.array(rng.rand(*xshape).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
     return step, x, y, batch
 
@@ -65,11 +68,12 @@ def main():
     ap.add_argument("--config", default="resnet50",
                     choices=("resnet50", "transformer"))
     ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--layout", default="NCHW", choices=("NCHW", "NHWC"))
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--keep", default=None)
     args = ap.parse_args()
     if args.config == "resnet50":
-        step, x, y, items = build_resnet50(args.batch or 64)
+        step, x, y, items = build_resnet50(args.batch or 64, args.layout)
         inputs = (x, y)
     else:
         step, srctgt, y, items = build_transformer(args.batch or 32)

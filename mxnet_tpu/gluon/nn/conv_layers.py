@@ -37,16 +37,19 @@ class _Conv(HybridBlock):
         self._channels = channels
         self._in_channels = in_channels
         ndim = len(kernel_size)
-        if layout not in ("NCW", "NCHW", "NCDHW"):
+        if layout not in ("NCW", "NCHW", "NCDHW", "NWC", "NHWC", "NDHWC"):
             raise MXNetError(
-                f"only channel-first layouts are supported, got {layout}; "
-                "XLA handles physical layout internally"
+                f"unsupported conv layout {layout!r}; use channel-first "
+                "(NCW/NCHW/NCDHW) or channel-last (NWC/NHWC/NDHWC — the "
+                "TPU-preferred layout)"
             )
+        self._layout = layout
+        self._channel_axis = -1 if layout[-1] == "C" else 1
         self._op_name = op_name
         self._kwargs = {
             "kernel": kernel_size, "stride": strides, "dilate": dilation,
             "pad": padding, "num_filter": channels, "num_group": groups,
-            "no_bias": not use_bias,
+            "no_bias": not use_bias, "layout": layout,
         }
         if adj is not None:
             self._kwargs["adj"] = adj
@@ -76,7 +79,7 @@ class _Conv(HybridBlock):
         return tuple(wshape) + self._kwargs["kernel"]
 
     def infer_shape(self, x, *args):
-        in_channels = int(x.shape[1])
+        in_channels = int(x.shape[self._channel_axis])
         groups = self._kwargs["num_group"]
         self.weight.shape = (
             (self._channels, in_channels // groups) + self._kwargs["kernel"]
@@ -216,6 +219,7 @@ class _Pooling(HybridBlock):
             "kernel": pool_size, "stride": strides, "pad": padding,
             "global_pool": global_pool, "pool_type": pool_type,
             "pooling_convention": "full" if ceil_mode else "valid",
+            "layout": layout,
         }
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad

@@ -151,7 +151,11 @@ def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
             return keep, 0
 
         keep0 = svalid
-        keep, _ = jax.lax.scan(step, keep0, jnp.arange(M))
+        # unrolled x10: batches of sequential (M,)-vector steps fuse into
+        # straight-line kernels, cutting the device-loop per-iteration
+        # overhead ~10x without the compile blowup a FULL unroll causes
+        # on big batches (the suppression order stays exactly greedy)
+        keep, _ = jax.lax.scan(step, keep0, jnp.arange(M), unroll=10)
         # scatter back to original positions (beyond-topk stays suppressed)
         keep_orig = jnp.zeros((N,), bool).at[order_m].set(keep)
         out = batch.at[:, score_index].set(
@@ -210,29 +214,55 @@ def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
 def _roi_sample(data, rois, pooled_size, spatial_scale, sample_ratio, aligned,
                 reduce_fn):
     """Shared bilinear ROI sampler: sample sr×sr points per output bin, then
-    reduce with ``reduce_fn`` (mean → ROIAlign, max → legacy ROIPooling)."""
+    reduce with ``reduce_fn`` (mean → ROIAlign, max → legacy ROIPooling).
+
+    rois (R, 5) rows [batch_idx, x1, y1, x2, y2] — reference layout — or
+    (B, K, 4|5) per-image rois (batched fast path: with flat rois every
+    ROI dynamically gathers its whole (C, H, W) image, which at detection
+    sizes moves GBs through HBM; the batched form maps over images so no
+    cross-image gather exists)."""
     ph, pw = pooled_size if isinstance(pooled_size, (tuple, list)) else (pooled_size,) * 2
     sr = sample_ratio if sample_ratio > 0 else 2
     offset = 0.5 if aligned else 0.0
 
     H, W = data.shape[2], data.shape[3]
 
+    def _weights(roi):
+        """roi (4,) [x1,y1,x2,y2] -> bilinear weight mats (s,H), (t,W).
+
+        Separable bilinear interpolation as two matmuls (MXU path; a
+        per-point gather formulation is scatter-bound on TPU): weight of
+        pixel h for sample y is the bilinear hat max(0, 1-|y-h|), which is
+        exactly map_coordinates(order=1, mode="constant", cval=0)."""
+        x1, y1, x2, y2 = (roi[0] * spatial_scale - offset,
+                          roi[1] * spatial_scale - offset,
+                          roi[2] * spatial_scale - offset,
+                          roi[3] * spatial_scale - offset)
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        ys = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
+        xs = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
+        wy = jnp.maximum(0.0, 1.0 - jnp.abs(ys[:, None] - jnp.arange(H)[None, :]))
+        wx = jnp.maximum(0.0, 1.0 - jnp.abs(xs[:, None] - jnp.arange(W)[None, :]))
+        return wy, wx
+
+    if rois.ndim == 3:
+        # batched fast path: (B, K, 4|5) rois belong to data[b] by position
+        coords = rois[..., -4:]
+
+        def one_img(img, r):  # img (C, H, W), r (K, 4)
+            wy, wx = jax.vmap(_weights)(r)  # (K, s, H), (K, t, W)
+            t1 = jnp.einsum("ksh,chw->kcsw", wy, img)
+            sampled = jnp.einsum("kcsw,ktw->kcst", t1, wx)
+            sampled = sampled.reshape(r.shape[0], img.shape[0], ph, sr, pw, sr)
+            return reduce_fn(sampled, (3, 5))
+
+        return jax.vmap(one_img)(data, coords)  # (B, K, C, ph, pw)
+
     def one_roi(roi):
         bidx = roi[0].astype(jnp.int32)
         img = data[bidx]  # (C, H, W)
-        x1, y1, x2, y2 = roi[1] * spatial_scale - offset, roi[2] * spatial_scale - offset, \
-            roi[3] * spatial_scale - offset, roi[4] * spatial_scale - offset
-        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
-        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
-        # sample grid: (ph*sr, pw*sr)
-        ys = y1 + (jnp.arange(ph * sr) + 0.5) * rh / (ph * sr)
-        xs = x1 + (jnp.arange(pw * sr) + 0.5) * rw / (pw * sr)
-        # separable bilinear interpolation as two matmuls (MXU path; a
-        # per-point gather formulation is scatter-bound on TPU):
-        # weight of pixel h for sample y is the bilinear hat max(0, 1-|y-h|),
-        # which is exactly map_coordinates(order=1, mode="constant", cval=0)
-        wy = jnp.maximum(0.0, 1.0 - jnp.abs(ys[:, None] - jnp.arange(H)[None, :]))
-        wx = jnp.maximum(0.0, 1.0 - jnp.abs(xs[:, None] - jnp.arange(W)[None, :]))
+        wy, wx = _weights(roi[1:5])
         t1 = jnp.einsum("sh,chw->csw", wy, img)
         sampled = jnp.einsum("csw,tw->cst", t1, wx)
         sampled = sampled.reshape(img.shape[0], ph, sr, pw, sr)
@@ -246,7 +276,10 @@ def roi_align(data, rois, pooled_size=(7, 7), spatial_scale=1.0, sample_ratio=-1
               position_sensitive=False, aligned=False, **kw):
     """Bilinear ROI pooling (reference: ``roi_align.cc`` [unverified]).
 
-    data (N, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2].
+    data (N, C, H, W); rois (R, 5) rows [batch_idx, x1, y1, x2, y2]
+    -> (R, C, ph, pw), or the batched fast path rois (B, K, 4|5)
+    -> (B, K, C, ph, pw) where rois[b] belong to data[b] (no cross-image
+    gather — use this from detection heads).
     Average of sampled bilinear points per bin, matching the reference.
     """
     return _roi_sample(data, rois, pooled_size, spatial_scale, sample_ratio,

@@ -191,7 +191,13 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     else:
         padding = [(p, p) for p in pad]
     spatial = "DHW"[-nd:] if nd <= 3 else None
-    lhs_spec = "NC" + spatial
+    # layout: channel-first (NCHW, reference default) or channel-last
+    # (NHWC — the TPU-preferred layout: channels ride the lane dimension,
+    # so per-channel BatchNorm reductions and conv epilogues fuse without
+    # strided access). Weights stay (O, I/g, *k) in BOTH layouts so
+    # checkpoints are layout-portable.
+    channel_last = bool(layout) and layout[-1] == "C"
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
     rhs_spec = "OI" + spatial
     out = jax.lax.conv_general_dilated(
         data,
@@ -203,15 +209,21 @@ def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
         feature_group_count=num_group,
     )
     if bias is not None and not no_bias:
-        out = out + bias.reshape((1, -1) + (1,) * nd)
+        bshape = ((1,) * (nd + 1) + (-1,)) if channel_last \
+            else ((1, -1) + (1,) * nd)
+        out = out + bias.reshape(bshape)
     return out
 
 
 @register("Deconvolution")
 def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                   pad=None, adj=None, target_shape=None, num_filter=None,
-                  num_group=1, no_bias=True, **kw):
+                  num_group=1, no_bias=True, layout=None, **kw):
     """Transposed conv (reference: ``src/operator/nn/deconvolution.cc``)."""
+    if layout is not None and layout[-1] == "C":
+        raise NotImplementedError(
+            "channel-last Deconvolution not supported yet; use NC* layouts"
+        )
     nd = data.ndim - 2
     stride = _tuplify(stride, nd)
     dilate = _tuplify(dilate, nd)
@@ -248,29 +260,38 @@ def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None
 def pooling(data, kernel=None, pool_type="max", global_pool=False, cudnn_off=False,
             pooling_convention="valid", stride=None, pad=None, p_value=2,
             count_include_pad=True, layout=None, **kw):
-    """Reference: ``src/operator/nn/pooling.cc`` [unverified]."""
+    """Reference: ``src/operator/nn/pooling.cc`` [unverified]. ``layout``
+    ending in C selects channel-last (spatial dims at 1..ndim-2)."""
     nd = data.ndim - 2
+    channel_last = bool(layout) and layout[-1] == "C"
+    sp0 = 1 if channel_last else 2  # first spatial axis
     if global_pool:
-        axes = tuple(range(2, data.ndim))
+        axes = tuple(range(sp0, sp0 + nd))
         if pool_type == "max":
             return jnp.max(data, axis=axes, keepdims=True)
         return jnp.mean(data, axis=axes, keepdims=True)
     kernel = _tuplify(kernel, nd)
     stride = _tuplify(stride if stride is not None else 1, nd)
     pad = _tuplify(pad if pad is not None else 0, nd)
-    window = (1, 1) + kernel
-    strides = (1, 1) + stride
-    pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    if channel_last:
+        window = (1,) + kernel + (1,)
+        strides = (1,) + stride + (1,)
+        base_pads = ((0, 0),) + tuple((p, p) for p in pad) + ((0, 0),)
+    else:
+        window = (1, 1) + kernel
+        strides = (1, 1) + stride
+        base_pads = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+    pads = base_pads
     if pooling_convention == "full":
         # ceil-mode: extend padding on the high side so the last window fits
         extra = []
         for i in range(nd):
-            size = data.shape[2 + i] + 2 * pad[i] - kernel[i]
+            size = data.shape[sp0 + i] + 2 * pad[i] - kernel[i]
             rem = size % stride[i]
             extra.append(stride[i] - rem if rem else 0)
-        pads = ((0, 0), (0, 0)) + tuple(
-            (p, p + e) for p, e in zip(pad, extra)
-        )
+        sp_pads = tuple((p, p + e) for p, e in zip(pad, extra))
+        pads = (((0, 0),) + sp_pads + ((0, 0),)) if channel_last \
+            else (((0, 0), (0, 0)) + sp_pads)
     if pool_type == "max":
         init = -jnp.inf
         out = jax.lax.reduce_window(data, init, jax.lax.max, window, strides, pads)
@@ -311,13 +332,29 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
     if training and not use_global_stats:
-        mean = jnp.mean(data, axis=red)
-        var = jnp.var(data, axis=red)
+        # two-pass statistics, f32 accumulators, nothing materialized: the
+        # one-pass E[x^2]-E[x]^2 form cancels catastrophically whenever
+        # |mean| >> std (even in f32: at mean/std=200 the f32 rounding of
+        # E[x^2] is the size of the true variance), so the centered form
+        # is required. XLA fuses the convert/subtract/square into the
+        # reduction, so the cost is one extra READ of the bf16 activation.
+        mean = jnp.mean(data, axis=red, dtype=jnp.float32)
+        bcast = [1] * data.ndim
+        bcast[axis % data.ndim] = data.shape[axis]
+        cdiff = data.astype(jnp.float32) - mean.reshape(bcast)
+        var = jnp.mean(jnp.square(cdiff), axis=red)
     else:
-        mean, var = moving_mean, moving_var
+        mean = moving_mean.astype(jnp.float32)
+        var = moving_var.astype(jnp.float32)
+    # normalize as ONE fma in the activation dtype: precompute per-channel
+    # scale/shift in f32, cast once — the (B,H,W)-sized math stays bf16
+    # under AMP instead of promoting to f32 through a broadcast subtract
     inv = jax.lax.rsqrt(var + eps)
-    out = (data - mean.reshape(bshape)) * (inv * g).reshape(bshape) + beta.reshape(bshape)
-    return out, mean, var
+    scale = inv * g.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean * scale
+    out = data * scale.astype(data.dtype).reshape(bshape) \
+        + shift.astype(data.dtype).reshape(bshape)
+    return out, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype)
 
 
 @register("LayerNorm")

@@ -400,19 +400,31 @@ class TrainStep:
         nsteps = self._steps_per_call
         if nsteps > 1 or self._accum > 1:
             # split the flat global batch into the leading axes consumed by
-            # the device-side loops: (nsteps, accum, microbatch, ...)
+            # the device-side loops: (nsteps, accum, microbatch, ...).
+            # jax arrays are immutable, so memoize by input identity — a
+            # training loop feeding the same buffers (benchmarks, epochs
+            # over a device-resident set) pays the eager reshape dispatch
+            # once instead of one tunnel round trip per call
             lead = (nsteps,) if nsteps > 1 else ()
             if self._accum > 1:
                 lead = lead + (self._accum,)
             n = 1
             for d in lead:
                 n *= d
+            memo = getattr(self, "_split_memo", None)
+            if memo is None:
+                memo = self._split_memo = {}
 
-            def _split(a):
-                return a.reshape(lead + (a.shape[0] // n,) + a.shape[1:])
+            def _split(a, pos):
+                hit = memo.get(pos)
+                if hit is not None and hit[0] is a:
+                    return hit[1]
+                out = a.reshape(lead + (a.shape[0] // n,) + a.shape[1:])
+                memo[pos] = (a, out)
+                return out
 
-            batch = [_split(b) for b in batch]
-            label = _split(label)
+            batch = [_split(b, i) for i, b in enumerate(batch)]
+            label = _split(label, -1)
         if self._data_sharding is not None:
             # leading step/accum axes are device-side loop axes, not data
             # axes — shard the per-microbatch batch axis that follows them

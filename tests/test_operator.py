@@ -246,6 +246,28 @@ class TestRegressions:
         assert out.shape == (1, 1, 2, 2)
         assert_close(out, np.ones((1, 1, 2, 2)), rtol=1e-4)
 
+    def test_roi_align_batched_matches_flat(self):
+        # the (B, K, 4) batched fast path == the flat (R, 5) reference form
+        rng = np.random.RandomState(0)
+        B, K, C, H, W = 3, 5, 4, 8, 8
+        feats = rng.randn(B, C, H, W).astype(np.float32)
+        xy1 = rng.rand(B, K, 2).astype(np.float32) * 3
+        wh = rng.rand(B, K, 2).astype(np.float32) * 4 + 1
+        rois_xy = np.concatenate([xy1, xy1 + wh], -1)
+        bidx = np.broadcast_to(
+            np.arange(B, dtype=np.float32)[:, None, None], (B, K, 1)
+        )
+        flat = np.concatenate([bidx, rois_xy], -1).reshape(-1, 5)
+        out_flat = nd.ROIAlign(nd.array(feats), nd.array(flat),
+                               pooled_size=(2, 2), spatial_scale=1.0,
+                               sample_ratio=2)
+        out_batched = nd.ROIAlign(nd.array(feats), nd.array(rois_xy),
+                                  pooled_size=(2, 2), spatial_scale=1.0,
+                                  sample_ratio=2)
+        assert out_batched.shape == (B, K, C, 2, 2)
+        assert_close(out_batched.asnumpy().reshape(B * K, C, 2, 2),
+                     out_flat.asnumpy(), rtol=1e-5)
+
 
 def test_softmax_output_int_label_vjp():
     # integer labels must yield a float0 cotangent, not a TypeError
