@@ -4,7 +4,8 @@ from __future__ import annotations
 
 
 def main():
-    from . import bench_lenet, bench_resnet50, bench_ssd, bench_transformer
+    from . import (bench_frcnn, bench_lenet, bench_resnet50, bench_ssd,
+                   bench_transformer)
 
     bench_lenet.main()
     bench_resnet50.main()
@@ -13,6 +14,7 @@ def main():
     bench_bert.main()
     bench_transformer.main()
     bench_ssd.main()
+    bench_frcnn.main()
 
 
 if __name__ == "__main__":

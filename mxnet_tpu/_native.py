@@ -38,10 +38,15 @@ def _build() -> Optional[str]:
     if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(src):
         return so
     try:
+        # compile to a per-process temp name and rename into place: rename
+        # is atomic on the same filesystem, so a concurrent process (the
+        # multi-worker launcher) can never dlopen a half-written .so
+        tmp = f"{so}.{os.getpid()}.tmp"
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-o", so, src, "-ljpeg"],
+            ["g++", "-O2", "-shared", "-fPIC", "-o", tmp, src, "-ljpeg"],
             check=True, capture_output=True, timeout=120,
         )
+        os.replace(tmp, so)
         return so
     except Exception:  # noqa: BLE001 - no compiler / no libjpeg: fallback
         return None

@@ -433,7 +433,7 @@ class _StagedHolder:
         self.n_out = None
         self.out_treedef = None
         self.aux_params = None
-        self.last_flat = None  # flat_args of the most recent call (for export)
+        self.last_flat = None  # avals of the most recent call (for export)
         self.last_used = 0  # global call sequence (export picks the newest)
 
 
@@ -519,8 +519,12 @@ class CachedOp:
         key = _random.next_key()
         flat_args = [n.data for n in param_nds] + [n.data for n in input_nds] + [key]
         # export() serializes the shapes/signature actually in use: remember
-        # the latest call's args (one attr store — hot path) and recency
-        holder.last_flat = flat_args
+        # ABSTRACT avals only — storing the live arrays would pin the most
+        # recent batch's device buffers (HBM scales with batch size and
+        # traced signatures) for the block's lifetime
+        holder.last_flat = [
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat_args
+        ]
         CachedOp._call_seq += 1
         holder.last_used = CachedOp._call_seq
 
@@ -715,6 +719,10 @@ class HybridBlock(Block):
             "n_out": holder.n_out,
             "n_inputs": len(in_avals) - len(ordered) - 1,
             "class": type(self).__name__,
+            # the traced program's key operand layout depends on the PRNG
+            # impl active at export (rbg: uint32[4], threefry: uint32[2]);
+            # imports must rebuild the key with the SAME impl
+            "prng_impl": jax.config.jax_default_prng_impl,
         }
         with open(f"{path}-symbol.json", "w") as f:
             json.dump(meta, f, indent=2)
@@ -786,7 +794,11 @@ class SymbolBlock(HybridBlock):
                 a.data if isinstance(a, _ND) else jnp.asarray(a)
                 for a in in_leaves
             ]
-            flat.append(jax.random.PRNGKey(0))  # predict-mode program
+            impl = self._meta.get("prng_impl")
+            # predict-mode program; key layout must match the export-time
+            # PRNG impl (recorded in the manifest since export-v1.1)
+            flat.append(jax.random.PRNGKey(0, impl=impl) if impl
+                        else jax.random.PRNGKey(0))
             outs = self._exported.call(*flat)
             outs = outs if isinstance(outs, (tuple, list)) else (outs,)
             primary = [_ND(o) for o in outs[: self._meta.get("n_out", len(outs))]]

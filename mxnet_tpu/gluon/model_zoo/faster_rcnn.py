@@ -1,0 +1,253 @@
+"""Faster R-CNN two-stage detector (reference workload: the rcnn example
+family over ``src/operator/contrib/proposal.cc`` + ROIAlign, and GluonCV
+``faster_rcnn`` [unverified]; the second half of BASELINE config 5).
+
+TPU-first shape discipline end to end:
+- the RPN emits a STATIC ``rpn_post_nms_top_n`` proposals per image
+  (suppressed slots ride along with score -1 — no dynamic compaction);
+- second-stage sampling (``rcnn_target_sampler``) is deterministic
+  top-by-IoU with static fg/bg counts;
+- ROI pooling uses the batched (B, K, 4) ROIAlign fast path (no per-ROI
+  whole-image gather);
+- the whole train step (backbone -> RPN -> proposal -> sample -> pool ->
+  heads) stages into ONE XLA program under hybridize()/TrainStep.
+"""
+
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn import Activation, BatchNorm, Conv2D, Dense, HybridSequential, \
+    MaxPool2D
+
+__all__ = ["FasterRCNN", "faster_rcnn_tiny"]
+
+
+def _down_block(channels):
+    blk = HybridSequential()
+    for _ in range(2):
+        blk.add(Conv2D(channels, kernel_size=3, padding=1),
+                BatchNorm(in_channels=channels),
+                Activation("relu"))
+    blk.add(MaxPool2D(pool_size=2, strides=2))
+    return blk
+
+
+class FasterRCNN(HybridBlock):
+    """Configurable two-stage detector.
+
+    Parameters
+    ----------
+    num_classes : foreground classes (background is implicit class 0)
+    channels : backbone down-block widths; stride = 2**len(channels)
+    scales / ratios : RPN anchor shapes in feature-stride units
+    rpn_post_nms_top_n : static proposal count per image
+    num_sample / pos_ratio / pos_iou_thresh : second-stage sampler config
+    """
+
+    def __init__(self, num_classes, channels=(16, 32), scales=(2, 4),
+                 ratios=(0.5, 1, 2), rpn_channels=64,
+                 rpn_pre_nms_top_n=256, rpn_post_nms_top_n=64,
+                 rpn_nms_thresh=0.7, rpn_min_size=4,
+                 num_sample=32, pos_ratio=0.25, pos_iou_thresh=0.5,
+                 roi_size=(7, 7), top_units=128, **kwargs):
+        super().__init__(**kwargs)
+        self._num_classes = num_classes
+        self._stride = 2 ** len(channels)
+        self._scales = tuple(scales)
+        self._ratios = tuple(ratios)
+        self._num_anchors = len(scales) * len(ratios)
+        self._rpn_pre = int(rpn_pre_nms_top_n)
+        self._rpn_post = int(rpn_post_nms_top_n)
+        self._rpn_nms = float(rpn_nms_thresh)
+        self._rpn_min = float(rpn_min_size)
+        self._num_sample = int(num_sample)
+        self._pos_ratio = float(pos_ratio)
+        self._pos_iou = float(pos_iou_thresh)
+        self._roi_size = tuple(roi_size)
+        with self.name_scope():
+            self.backbone = HybridSequential(prefix="backbone_")
+            for c in channels:
+                self.backbone.add(_down_block(c))
+            A = self._num_anchors
+            self.rpn_conv = Conv2D(rpn_channels, kernel_size=3, padding=1,
+                                   activation="relu", prefix="rpnconv_")
+            self.rpn_cls = Conv2D(2 * A, kernel_size=1, prefix="rpncls_")
+            self.rpn_box = Conv2D(4 * A, kernel_size=1, prefix="rpnbox_")
+            self.top = Dense(top_units, activation="relu", prefix="top_")
+            self.rcnn_cls = Dense(num_classes + 1, prefix="rcnncls_")
+            self.rcnn_box = Dense(4, prefix="rcnnbox_")
+
+    # ------------------------------------------------------------ stages
+    def _rpn(self, F, x):
+        feat = self.backbone(x)
+        r = self.rpn_conv(feat)
+        rpn_cls = self.rpn_cls(r)   # (B, 2A, Hf, Wf) raw scores
+        rpn_box = self.rpn_box(r)   # (B, 4A, Hf, Wf)
+        B = rpn_cls.shape[0]
+        A = self._num_anchors
+        Hf, Wf = rpn_cls.shape[2], rpn_cls.shape[3]
+        # per-anchor {bg, fg} softmax, reference SoftmaxActivation layout
+        prob = F.softmax(rpn_cls.reshape(B, 2, A, Hf, Wf), axis=1)
+        prob = prob.reshape(B, 2 * A, Hf, Wf)
+        return feat, rpn_cls, rpn_box, prob
+
+    def _proposals(self, F, prob, rpn_box, im_hw):
+        # im_info rows [img_h, img_w, scale] built as a traced constant —
+        # the model takes same-sized images per batch (static shapes)
+        import jax.numpy as jnp
+        from ...ndarray.ndarray import NDArray
+
+        B = prob.shape[0]
+        raw = jnp.broadcast_to(
+            jnp.asarray([float(im_hw[0]), float(im_hw[1]), 1.0],
+                        jnp.float32), (B, 3),
+        )
+        im_info = NDArray(raw)
+        return F.Proposal(
+            prob, rpn_box, im_info,
+            rpn_pre_nms_top_n=self._rpn_pre,
+            rpn_post_nms_top_n=self._rpn_post,
+            threshold=self._rpn_nms, rpn_min_size=self._rpn_min,
+            scales=self._scales, ratios=self._ratios,
+            feature_stride=self._stride,
+        )
+
+    def _heads(self, F, feat, rois_xy):
+        # rois_xy (B, K, 4) pixel coords -> batched ROIAlign on the feature
+        pooled = F.ROIAlign(
+            feat, rois_xy, pooled_size=self._roi_size,
+            spatial_scale=1.0 / self._stride, sample_ratio=2,
+        )  # (B, K, C, ph, pw)
+        B, K = pooled.shape[0], pooled.shape[1]
+        flat = pooled.reshape(B * K, -1)
+        t = self.top(flat)
+        cls = self.rcnn_cls(t).reshape(B, K, self._num_classes + 1)
+        box = self.rcnn_box(t).reshape(B, K, 4)
+        return cls, box
+
+    # ----------------------------------------------------------- forward
+    def hybrid_forward(self, F, x, gt_boxes=None):
+        """Training (``gt_boxes`` (B, M, 5) rows [cls, x1, y1, x2, y2],
+        cls < 0 padding): returns (rcnn_cls_pred, rcnn_box_pred,
+        cls_targets, box_targets, box_masks, rpn_cls_scores, rpn_box_pred,
+        rois). Inference (gt None): returns (rois, rcnn_cls_pred,
+        rcnn_box_pred) over all proposals."""
+        im_hw = (float(x.shape[2]), float(x.shape[3]))  # NCHW input
+        feat, rpn_cls, rpn_box, prob = self._rpn(F, x)
+        rois = self._proposals(F, prob, rpn_box, im_hw)  # (B, K, 5)
+        if gt_boxes is None:
+            cls, box = self._heads(F, feat, rois[:, :, 1:5])
+            return rois, cls, box
+        # append gt boxes PLUS deterministic jittered copies to the
+        # proposals before sampling (the reference recipe appends gt and
+        # samples randomly; with a deterministic sampler the jitter is
+        # what gives the classifier foreground VARIETY — trained only on
+        # exact gt boxes it learns a razor-thin fg boundary that nothing
+        # at inference clears). Padding gts are all-zero boxes with IoU 0.
+        gt_as_rois = gt_boxes[:, :, 1:5] * (gt_boxes[:, :, :1] >= 0)
+        x1, y1, x2, y2 = (gt_as_rois[:, :, 0:1], gt_as_rois[:, :, 1:2],
+                          gt_as_rois[:, :, 2:3], gt_as_rois[:, :, 3:4])
+        w_, h_ = x2 - x1, y2 - y1
+        jittered = []
+        for dx, dy, ds in ((0.08, -0.06, 0.1), (-0.07, 0.08, -0.1),
+                           (0.05, 0.05, 0.15)):
+            jittered.append(F.concat(
+                x1 + dx * w_ - ds * w_ / 2, y1 + dy * h_ - ds * h_ / 2,
+                x2 + dx * w_ + ds * w_ / 2, y2 + dy * h_ + ds * h_ / 2,
+                dim=-1,
+            ))
+        cand = F.concat(rois[:, :, 1:5], gt_as_rois, *jittered, dim=1)
+        sampled, cls_t, box_t, box_m = F.rcnn_target_sampler(
+            cand, gt_boxes, num_sample=self._num_sample,
+            pos_ratio=self._pos_ratio, pos_iou_thresh=self._pos_iou,
+        )
+        cls, box = self._heads(F, feat, sampled)
+        return cls, box, cls_t, box_t, box_m, rpn_cls, rpn_box, rois
+
+    # ------------------------------------------------------- rpn targets
+    def rpn_dense_targets(self, gt_boxes, im_hw, feat_hw,
+                          negative_mining_ratio=-1.0, cls_preds=None):
+        """Dense per-anchor RPN training targets via MultiBoxTarget
+        (class-agnostic). Default is the DENSE loss — weight foregrounds
+        up in the classification loss (e.g. ``1 + 19*(ct > 0)``) so the
+        easy backgrounds don't swamp them. Deterministic hard-negative
+        mining (``negative_mining_ratio > 0``) is available but leaves
+        never-mined anchors unconstrained, which poisons the proposal
+        ranking — the reference avoided that with RANDOM per-iteration
+        sampling, which a static graph can't cheaply do.
+
+        gt_boxes (B, M, 5) pixel coords; returns
+        (box_targets (B, N*4), box_masks (B, N*4), cls_targets (B, N))
+        with cls in {0 bg, 1 fg} (plus -1 ignore when mining is on),
+        anchor order (Hf, Wf, A) matching the rpn head layout helpers
+        below."""
+        import jax.numpy as jnp
+        from ... import ndarray as nd
+        from ...ndarray.ndarray import NDArray
+        from ...ops.contrib import _rpn_anchors
+
+        ih, iw = float(im_hw[0]), float(im_hw[1])
+        anchors = _rpn_anchors(int(feat_hw[0]), int(feat_hw[1]),
+                               self._stride, self._scales, self._ratios)
+        norm = anchors / jnp.asarray([iw, ih, iw, ih], jnp.float32)
+        gt = gt_boxes.data if isinstance(gt_boxes, NDArray) \
+            else jnp.asarray(gt_boxes)
+        cls = jnp.where(gt[:, :, :1] >= 0, 0.0, -1.0)  # class-agnostic fg
+        boxes = gt[:, :, 1:5] / jnp.asarray([iw, ih, iw, ih], jnp.float32)
+        labels = jnp.concatenate([cls, boxes], axis=-1)
+        B, N = gt.shape[0], anchors.shape[0]
+        if cls_preds is None:
+            # zero preds: mining then picks arbitrary (equal-score)
+            # negatives; pass the live rpn logits in (B, 2, N) layout for
+            # true hard-negative mining
+            cls_preds = NDArray(jnp.zeros((B, 2, N), jnp.float32))
+        # variances=1: the Proposal op decodes rpn deltas WITHOUT stds
+        # (reference RPN convention), so targets must be encoded the same
+        return nd.MultiBoxTarget(
+            NDArray(norm[None]), NDArray(labels), cls_preds,
+            negative_mining_ratio=float(negative_mining_ratio),
+            variances=(1.0, 1.0, 1.0, 1.0),
+        )
+
+    def rpn_per_anchor(self, rpn_cls, rpn_box):
+        """Reshape raw RPN head maps to per-anchor layout matching
+        ``rpn_dense_targets``: (B, N, 2) logits and (B, N*4) deltas."""
+        B = rpn_cls.shape[0]
+        A = self._num_anchors
+        Hf, Wf = rpn_cls.shape[2], rpn_cls.shape[3]
+        logits = rpn_cls.reshape(B, 2, A, Hf, Wf).transpose(
+            0, 3, 4, 2, 1).reshape(B, -1, 2)
+        deltas = rpn_box.reshape(B, A, 4, Hf, Wf).transpose(
+            0, 3, 4, 1, 2).reshape(B, -1)
+        return logits, deltas
+
+    # ------------------------------------------------------------ detect
+    def detect(self, x, threshold=0.05, nms_threshold=0.45, topk=20):
+        """Inference: (B, K, 6) rows [cls_id, score, x1, y1, x2, y2]
+        (pixel coords), NMS'd per class via box_nms."""
+        from ... import ndarray as nd
+
+        rois, cls_pred, box_pred = self(x)
+        probs = nd.softmax(cls_pred, axis=-1)
+        import jax.numpy as jnp
+        from ...ndarray.ndarray import NDArray
+        from ...ops.contrib import _rcnn_decode, box_nms as _nms
+
+        stds = jnp.asarray([0.1, 0.1, 0.2, 0.2], jnp.float32)
+        boxes = _rcnn_decode(rois.data[:, :, 1:5],
+                             box_pred.data * stds)  # (B, K, 4)
+        p = probs.data[:, :, 1:]  # drop background
+        best = jnp.argmax(p, axis=-1)
+        score = jnp.max(p, axis=-1)
+        score = jnp.where(score > threshold, score, -1.0)
+        dets = jnp.concatenate([
+            best[..., None].astype(jnp.float32), score[..., None], boxes,
+        ], axis=-1)
+        out = _nms(dets, overlap_thresh=nms_threshold, topk=topk,
+                   coord_start=2, score_index=1, id_index=0)
+        return NDArray(out)
+
+
+def faster_rcnn_tiny(num_classes=2, **kwargs):
+    """Small Faster R-CNN for tests/examples (stride-4 backbone)."""
+    return FasterRCNN(num_classes, **kwargs)

@@ -172,6 +172,48 @@ class Dropout(HybridBlock):
         return f"Dropout(p = {self._rate}, axes={self._axes})"
 
 
+def _sparse_embedding_apply(x, weight_param, input_dim, output_dim):
+    """Eager sparse-grad embedding: gather forward; backward writes a
+    compressed row-sparse gradient (token rows, output cotangents) into
+    ``weight_param.grad`` DIRECTLY — the weight deliberately does not ride
+    the tape, so the dense (V, D) scatter is never built (reference
+    ``Embedding(sparse_grad=True)`` semantics; the sparse optimizer
+    updates then touch live rows only)."""
+    import jax.numpy as jnp
+
+    from ...ndarray.ndarray import NDArray
+    from ...ndarray.sparse import RowSparseNDArray
+
+    import jax
+    import numpy as _np2
+
+    weight_nd = weight_param.data()
+
+    class _Apply(autograd.Function):
+        def forward(self, x_nd, w_nd):
+            ids = x_nd.data.astype(jnp.int32)
+            return NDArray(jnp.take(w_nd.data, ids, axis=0))
+
+        def backward(self, dout):
+            ids = x.data.astype(jnp.int32).reshape(-1)
+            vals = dout.data.reshape(-1, output_dim)
+            g = RowSparseNDArray.from_pair(
+                ids, vals, (input_dim, output_dim)
+            )
+            if weight_param.grad_req == "add" and isinstance(
+                weight_nd._grad, RowSparseNDArray
+            ):
+                g = weight_nd._grad + g
+            weight_nd._grad = g
+            # float0 cotangents: the tape must NOT accumulate a dense
+            # gradient for the weight (that's the whole point) — the
+            # compressed pair was just written into weight.grad above
+            return (_np2.zeros(x.shape, jax.dtypes.float0),
+                    _np2.zeros(weight_nd.shape, jax.dtypes.float0))
+
+    return _Apply()(x, weight_nd)
+
+
 class Embedding(HybridBlock):
     """Index -> dense vector lookup (reference: ``Embedding`` over the
     ``Embedding`` op = gather rows of the weight)."""
@@ -179,20 +221,32 @@ class Embedding(HybridBlock):
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, **kwargs):
         super().__init__(**kwargs)
-        if sparse_grad:
-            raise MXNetError(
-                "sparse_grad is not supported by the TPU build (dense grads "
-                "are XLA-scatter aggregated)"
-            )
         self._input_dim = input_dim
         self._output_dim = output_dim
+        # sparse_grad: eager backward writes a COMPRESSED row-sparse
+        # gradient (token rows, output cotangents) into weight.grad
+        # instead of scatter-adding a dense (V, D) — the reference's
+        # row_sparse embedding-gradient path (``Embedding(sparse_grad=
+        # True)`` + sparse optimizer updates touching live rows only).
+        # Under hybridize()/TrainStep tracing the dense XLA scatter path
+        # is used (jit gradients are whole-program).
+        self._sparse_grad = bool(sparse_grad)
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim),
                 init=weight_initializer, dtype=dtype,
+                grad_stype="row_sparse" if sparse_grad else "default",
             )
 
     def hybrid_forward(self, F, x, weight):
+        if self._sparse_grad:
+            from ... import autograd as _ag
+            from ...gluon.block import _in_trace
+
+            if _ag.is_recording() and not _in_trace():
+                return _sparse_embedding_apply(
+                    x, self.weight, self._input_dim, self._output_dim
+                )
         return F.Embedding(
             x, weight, input_dim=self._input_dim, output_dim=self._output_dim
         )

@@ -88,11 +88,17 @@ class Parameter:
         self.wd_mult = wd_mult
         self.grad_req = grad_req
         self.init = init
-        if stype != "default" or grad_stype != "default":
+        if stype != "default":
             raise MXNetError(
                 "sparse parameter storage is not supported by the TPU build; "
                 "use default stype"
             )
+        if grad_stype not in ("default", "row_sparse"):
+            raise MXNetError(
+                f"unsupported grad_stype {grad_stype!r}; 'row_sparse' is "
+                "the only sparse gradient storage (embedding gradients)"
+            )
+        self.grad_stype = grad_stype
 
     def __repr__(self):
         return f"Parameter {self.name} (shape={self.shape}, dtype={self.dtype})"

@@ -127,10 +127,45 @@ class KVStore(KVStoreBase):
         self.pull(key, out, priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        raise MXNetError(
-            "row_sparse storage is not supported by the TPU build; dense "
-            "embedding gradients are XLA-scatter aggregated instead"
-        )
+        """Pull only the requested rows of ``key`` (reference: the
+        row_sparse pull the sparse-embedding training loop used to fetch
+        live rows of a big table, ``src/kvstore/`` [unverified]).
+
+        ``out`` becomes a PAIR-backed RowSparseNDArray holding exactly the
+        requested rows — a gather, never the dense table."""
+        from ..ndarray.sparse import RowSparseNDArray
+
+        if row_ids is None:
+            raise MXNetError("row_sparse_pull requires row_ids")
+        keys = _as_list(key)
+        outs = self._grouped(keys, out)
+        rids = _as_list(row_ids)
+        if len(rids) == 1 and len(keys) > 1:
+            rids = rids * len(keys)
+        for k, dsts, rid in zip(keys, outs, rids):
+            k = str(k)
+            if k not in self._data:
+                raise MXNetError(f"key {k} not initialized in kvstore")
+            src = self._data[k]
+            rows = rid.data.astype(jnp.int32).reshape(-1) \
+                if isinstance(rid, NDArray) else jnp.asarray(rid, jnp.int32)
+            # pull is a READ with set semantics: duplicate requested ids
+            # must not double rows when the pair densifies (densify sums)
+            import numpy as _nphost
+            rows = jnp.asarray(_nphost.unique(_nphost.asarray(rows)),
+                               jnp.int32)
+            vals = jnp.take(src.data, rows, axis=0)
+            for d in dsts:
+                if isinstance(d, RowSparseNDArray):
+                    d._rs_rows = rows
+                    d._rs_vals = vals
+                    d._rs_shape = tuple(src.shape)
+                    d._rs_dense = None
+                else:
+                    d._rebind(
+                        jnp.zeros(src.shape, src.data.dtype)
+                        .at[rows].set(vals)
+                    )
 
     def set_gradient_compression(self, compression_params):
         from .compression import GradientCompression

@@ -69,8 +69,13 @@ class KVStoreDist(KVStore):
                 raise MXNetError(f"key {k} not initialized in kvstore")
             datas = [v.data for v in vals]
             if self._compression is not None:
-                # worker-side compression before the wire (reference: the
-                # 2bit path compresses worker->server pushes)
+                # NOTE: this emulates the reference 2-bit path's
+                # QUANTIZATION/RESIDUAL semantics (worker gradients pass
+                # through quantize+error-feedback before aggregation), but
+                # NOT its wire-byte reduction: the values are dequantized
+                # before _cross_host_sum, so the cross-host transfer
+                # carries full-precision floats. Packing the uint8 codes
+                # over the collective is future work.
                 datas = [
                     self._compression.compress((k, i), d)
                     for i, d in enumerate(datas)

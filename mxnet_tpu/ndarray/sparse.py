@@ -1,13 +1,17 @@
-"""Sparse NDArray facade: ``row_sparse`` and ``csr`` storage types.
+"""Sparse NDArray storage: ``row_sparse`` and ``csr`` types.
 
 Reference: ``src/ndarray/`` row_sparse/CSR storage + ``src/operator/tensor``
 sparse kernels [unverified]. On TPU, XLA has no sparse buffer type and the
-MXU wants dense tiles, so the TPU-native stance is: keep the *API* (creation,
-``.indices``/``.data``, conversion, sparse ``dot``) while backing storage
-densely the moment it reaches device; ``row_sparse`` keeps its compressed
-(indices, values) host-side identity for the cases the reference optimized
-(embedding gradients, kvstore push), which our Trainer handles by scatter-add
-on device instead.
+MXU wants dense tiles, so the stance is split by role:
+
+- general sparse COMPUTE (csr dot etc.) keeps the API with dense backing —
+  the facade role;
+- the sparse TRAINING path is real: ``RowSparseNDArray.from_pair`` holds a
+  compressed (rows, vals) pair on device, produced by
+  ``Embedding(sparse_grad=True)`` backward, consumed by the lazy sparse
+  SGD/Adam updates (scatter to live rows only) and by
+  ``kvstore.row_sparse_pull`` (gather of requested rows) — the cases the
+  reference actually optimized with row_sparse kernels.
 """
 
 from __future__ import annotations
@@ -42,19 +46,96 @@ class BaseSparseNDArray(NDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
+    """Row-sparse array. Two storage modes:
+
+    - dense-backed (the round-2 facade): behaves as dense, indices/values
+      derived by scanning;
+    - PAIR-backed (``from_pair``): holds a compressed (rows, vals) pair —
+      the REAL sparse storage used by sparse embedding gradients, sparse
+      optimizer updates, and ``kvstore.row_sparse_pull``. The dense view
+      is scatter-materialized lazily only if some consumer asks for
+      ``.data``; the sparse training path never does.
+    """
+
     _stype = "row_sparse"
+
+    @classmethod
+    def from_pair(cls, rows, vals, shape) -> "RowSparseNDArray":
+        """rows (K,) int32 (duplicates allowed — they SUM on densify,
+        gradient semantics), vals (K, ...) matching shape[1:]."""
+        obj = cls.__new__(cls)
+        NDArray.__init__(obj, jnp.zeros((), jnp.float32))  # placeholder
+        obj._rs_rows = jnp.asarray(_unwrap(rows)).astype(jnp.int32)
+        obj._rs_vals = jnp.asarray(_unwrap(vals))
+        obj._rs_shape = tuple(shape)
+        obj._rs_dense = None
+        return obj
+
+    @property
+    def _pair(self):
+        return getattr(self, "_rs_rows", None) is not None \
+            and getattr(self, "_rs_shape", None) is not None
+
+    def _rebind(self, new_data):
+        # writing a dense value into a pair-backed array (kvstore.pull
+        # into a grad buffer) must DROP the stale pair, or .data keeps
+        # returning the old compressed value
+        if getattr(self, "_rs_shape", None) is not None:
+            self._rs_rows = None
+            self._rs_vals = None
+            self._rs_shape = None
+            self._rs_dense = None
+        NDArray._rebind(self, new_data)
+
+    # ------------------------------------------------- dense materialization
+    @property
+    def data(self):
+        if getattr(self, "_rs_shape", None) is not None:
+            if self._rs_dense is None:
+                dense = jnp.zeros(self._rs_shape, self._rs_vals.dtype)
+                self._rs_dense = dense.at[self._rs_rows].add(self._rs_vals)
+            return self._rs_dense
+        return NDArray.data.fget(self)
+
+    @property
+    def shape(self):
+        if getattr(self, "_rs_shape", None) is not None:
+            return self._rs_shape
+        return NDArray.shape.fget(self)
 
     @property
     def indices(self) -> NDArray:
+        if self._pair:
+            return NDArray(self._rs_rows)
         nz = _np.nonzero(_np.any(self.asnumpy() != 0, axis=tuple(range(1, self.ndim))))[0]
         return NDArray(jnp.asarray(nz, jnp.int32))
 
     @property
     def values(self) -> NDArray:  # data rows at indices
+        if self._pair:
+            return NDArray(self._rs_vals)
         return NDArray(jnp.take(self.data, self.indices.data.astype(jnp.int32), axis=0))
+
+    def __add__(self, other):
+        # pair + pair concatenates (gradient accumulation keeps compressed)
+        if self._pair and isinstance(other, RowSparseNDArray) and other._pair:
+            assert self._rs_shape == other._rs_shape
+            return RowSparseNDArray.from_pair(
+                jnp.concatenate([self._rs_rows, other._rs_rows]),
+                jnp.concatenate([self._rs_vals, other._rs_vals]),
+                self._rs_shape,
+            )
+        return NDArray.__add__(self, other)
 
     def retain(self, indices) -> "RowSparseNDArray":
         idx = jnp.asarray(_unwrap(indices)).astype(jnp.int32)
+        if self._pair:
+            # reference retain REMOVES non-retained rows (indices shrink);
+            # eager-only path, so the dynamic result shape is fine
+            keep = _np.asarray(jnp.isin(self._rs_rows, idx))
+            return RowSparseNDArray.from_pair(
+                self._rs_rows[keep], self._rs_vals[keep], self._rs_shape
+            )
         keep = jnp.zeros((self.shape[0],), bool).at[idx].set(True)
         out = jnp.where(keep.reshape((-1,) + (1,) * (self.ndim - 1)), self.data, 0)
         return RowSparseNDArray(out)

@@ -602,3 +602,187 @@ def multibox_detection(cls_probs, loc_preds, anchors, clip=True,
         return out.at[:, 0].set(jnp.where(out[:, 1] < 0, -1.0, out[:, 0]))
 
     return jax.vmap(per_image)(cls_probs, loc_preds)
+
+
+# -------------------------------------------------------------- faster-rcnn
+def _rpn_anchors(H, W, feature_stride, scales, ratios):
+    """Pixel-space base anchors at every feature position.
+
+    Reference ``src/operator/contrib/proposal.cc`` GenerateAnchors
+    [unverified]: a base box of side ``feature_stride`` centered on each
+    position, reshaped per (ratio, scale) keeping area (ratio) / scaling
+    sides (scale). Returns (H*W*A, 4) corner boxes, A = len(ratios)*len(scales).
+    """
+    base = float(feature_stride)
+    cx = (jnp.arange(W, dtype=jnp.float32) + 0.5) * base
+    cy = (jnp.arange(H, dtype=jnp.float32) + 0.5) * base
+    ws, hs = [], []
+    for r in ratios:
+        for s in scales:
+            w = base * float(s) / math.sqrt(float(r))
+            h = base * float(s) * math.sqrt(float(r))
+            ws.append(w)
+            hs.append(h)
+    ws = jnp.asarray(ws, jnp.float32)  # (A,)
+    hs = jnp.asarray(hs, jnp.float32)
+    cxg, cyg = jnp.meshgrid(cx, cy)  # (H, W)
+    cxg = cxg[:, :, None]
+    cyg = cyg[:, :, None]
+    boxes = jnp.stack([
+        cxg - ws / 2, cyg - hs / 2, cxg + ws / 2, cyg + hs / 2,
+    ], axis=-1)  # (H, W, A, 4)
+    return boxes.reshape(-1, 4)
+
+
+def _rcnn_decode(anchors, deltas, clip_hw=None):
+    """Standard R-CNN box decoding (no stds): anchors/deltas (..., 4)."""
+    ax1, ay1, ax2, ay2 = jnp.split(anchors, 4, axis=-1)
+    dx, dy, dw, dh = jnp.split(deltas, 4, axis=-1)
+    aw, ah = ax2 - ax1, ay2 - ay1
+    acx, acy = ax1 + aw / 2, ay1 + ah / 2
+    cx = acx + dx * aw
+    cy = acy + dy * ah
+    w = aw * jnp.exp(jnp.clip(dw, -10.0, 10.0))
+    h = ah * jnp.exp(jnp.clip(dh, -10.0, 10.0))
+    out = jnp.concatenate([cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                          axis=-1)
+    if clip_hw is not None:
+        hlim, wlim = clip_hw
+        out = jnp.stack([
+            jnp.clip(out[..., 0], 0, wlim - 1.0),
+            jnp.clip(out[..., 1], 0, hlim - 1.0),
+            jnp.clip(out[..., 2], 0, wlim - 1.0),
+            jnp.clip(out[..., 3], 0, hlim - 1.0),
+        ], axis=-1)
+    return out
+
+
+@register("_contrib_Proposal", aliases=["Proposal"], num_outputs=None,
+          differentiable=False)
+def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+             rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+             scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+             feature_stride=16, output_score=False, **kw):
+    """RPN proposal generation (reference ``proposal.cc`` [unverified]).
+
+    cls_prob (B, 2A, H, W) — [:, :A] background, [:, A:] foreground
+    scores; bbox_pred (B, 4A, H, W); im_info (B, 3) rows [h, w, scale].
+
+    TPU-first deviations from the reference, both static-shape driven:
+    rois come back BATCHED as (B, rpn_post_nms_top_n, 5) rows
+    [batch_idx, x1, y1, x2, y2] (the flat (B*N, 5) reference layout is a
+    reshape away; the batched form feeds the batched ROIAlign directly),
+    and slots past the survivor count hold the highest-scoring suppressed
+    boxes (score -1 in the score output) rather than shrinking.
+    """
+    B = cls_prob.shape[0]
+    H, W = cls_prob.shape[2], cls_prob.shape[3]
+    A = cls_prob.shape[1] // 2
+    if A != len(scales) * len(ratios):
+        raise ValueError(
+            f"cls_prob carries {A} anchors/position but scales x ratios "
+            f"defines {len(scales) * len(ratios)}"
+        )
+    anchors = _rpn_anchors(H, W, feature_stride, scales, ratios)  # (HWA, 4)
+    N = anchors.shape[0]
+
+    # (B, A, H, W) -> (B, H, W, A) -> (B, HWA): match the anchor layout
+    fg = jnp.transpose(cls_prob[:, A:], (0, 2, 3, 1)).reshape(B, N)
+    deltas = bbox_pred.reshape(B, A, 4, H, W)
+    deltas = jnp.transpose(deltas, (0, 3, 4, 1, 2)).reshape(B, N, 4)
+
+    def one(fg_b, deltas_b, info):
+        boxes = _rcnn_decode(anchors, deltas_b, clip_hw=(info[0], info[1]))
+        ws = boxes[:, 2] - boxes[:, 0] + 1.0
+        hs = boxes[:, 3] - boxes[:, 1] + 1.0
+        min_sz = rpn_min_size * info[2]
+        score = jnp.where((ws >= min_sz) & (hs >= min_sz), fg_b, -jnp.inf)
+        k1 = min(int(rpn_pre_nms_top_n), N)
+        top_scores, top_idx = jax.lax.top_k(score, k1)
+        top_boxes = boxes[top_idx]
+        dets = jnp.concatenate([
+            jnp.zeros((k1, 1)), top_scores[:, None], top_boxes,
+        ], axis=-1)
+        kept = box_nms(dets, overlap_thresh=threshold,
+                       topk=int(rpn_post_nms_top_n), coord_start=2,
+                       score_index=1, id_index=0)
+        ord_scores, ord_idx = jax.lax.top_k(kept[:, 1],
+                                            int(rpn_post_nms_top_n))
+        rois = kept[ord_idx, 2:6]
+        return rois, ord_scores
+
+    rois, scores = jax.vmap(one)(fg, deltas, im_info)
+    bidx = jnp.broadcast_to(
+        jnp.arange(B, dtype=rois.dtype)[:, None, None],
+        (B, rois.shape[1], 1),
+    )
+    rois = jnp.concatenate([bidx, rois], axis=-1)
+    if output_score:
+        return rois, scores[..., None]
+    return rois
+
+
+@register("_contrib_rcnn_target_sampler", aliases=["rcnn_target_sampler"],
+          num_outputs=4, differentiable=False)
+def rcnn_target_sampler(rois, gt_boxes, num_sample=128, pos_ratio=0.25,
+                        pos_iou_thresh=0.5, bg_iou_low=0.0,
+                        box_stds=(0.1, 0.1, 0.2, 0.2), **kw):
+    """Second-stage target sampling + encoding (reference: the rcnn
+    ``proposal_target`` operator / GluonCV RCNNTargetSampler+Generator
+    [unverified]) with static shapes.
+
+    rois (B, R, 4|5) proposals (batch-idx column ignored if present);
+    gt_boxes (B, M, 5) rows [cls, x1, y1, x2, y2], cls < 0 = padding.
+
+    Returns (sampled_rois (B, S, 4), cls_targets (B, S) int32 with
+    0 = background and gt cls k -> k+1, box_targets (B, S, 4),
+    box_masks (B, S, 4)); S = num_sample. Selection is deterministic
+    top-by-IoU (foregrounds first, capped at pos_ratio*S, then the
+    highest-IoU backgrounds) — the reference sampled randomly; determinism
+    is the jit-friendly choice and tests/training treat it as the
+    hardest-example variant.
+    """
+    rois = rois[..., -4:]
+    S = int(num_sample)
+    num_fg = int(round(S * float(pos_ratio)))
+
+    def one(rois_b, gt_b):
+        gt_cls = gt_b[:, 0]
+        gt_box = gt_b[:, 1:5]
+        valid_gt = gt_cls >= 0
+        iou = box_iou(rois_b, gt_box)  # (R, M)
+        iou = jnp.where(valid_gt[None, :], iou, 0.0)
+        best_gt = jnp.argmax(iou, axis=1)
+        best_iou = jnp.max(iou, axis=1)
+        is_fg = best_iou >= pos_iou_thresh
+        fg_key = jnp.where(is_fg, best_iou, -jnp.inf)
+        _, fg_idx = jax.lax.top_k(fg_key, num_fg)
+        bg_key = jnp.where(~is_fg & (best_iou >= bg_iou_low), best_iou,
+                           -jnp.inf)
+        _, bg_idx = jax.lax.top_k(bg_key, S - num_fg)
+        sel = jnp.concatenate([fg_idx, bg_idx])
+        sel_rois = rois_b[sel]
+        sel_iou = best_iou[sel]
+        sel_fg = is_fg[sel]
+        # fg slots past the actual fg count carry non-fg rois; their
+        # sel_fg is False so they fall through to background cleanly
+        sel_gt = best_gt[sel]
+        cls_t = jnp.where(sel_fg, gt_cls[sel_gt].astype(jnp.int32) + 1, 0)
+        matched = gt_box[sel_gt]
+        # center-form encoding with stds (the reference's bbox_transform)
+        ax1, ay1, ax2, ay2 = jnp.split(sel_rois, 4, axis=-1)
+        gx1, gy1, gx2, gy2 = jnp.split(matched, 4, axis=-1)
+        aw = jnp.maximum(ax2 - ax1, 1e-6)
+        ah = jnp.maximum(ay2 - ay1, 1e-6)
+        gw = jnp.maximum(gx2 - gx1, 1e-6)
+        gh = jnp.maximum(gy2 - gy1, 1e-6)
+        t = jnp.concatenate([
+            ((gx1 + gw / 2) - (ax1 + aw / 2)) / aw / box_stds[0],
+            ((gy1 + gh / 2) - (ay1 + ah / 2)) / ah / box_stds[1],
+            jnp.log(gw / aw) / box_stds[2],
+            jnp.log(gh / ah) / box_stds[3],
+        ], axis=-1)
+        mask = sel_fg[:, None].astype(t.dtype) * jnp.ones_like(t)
+        return sel_rois, cls_t, t * mask, mask
+
+    return jax.vmap(one)(rois, gt_boxes)

@@ -339,9 +339,7 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
         # is required. XLA fuses the convert/subtract/square into the
         # reduction, so the cost is one extra READ of the bf16 activation.
         mean = jnp.mean(data, axis=red, dtype=jnp.float32)
-        bcast = [1] * data.ndim
-        bcast[axis % data.ndim] = data.shape[axis]
-        cdiff = data.astype(jnp.float32) - mean.reshape(bcast)
+        cdiff = data.astype(jnp.float32) - mean.reshape(bshape)
         var = jnp.mean(jnp.square(cdiff), axis=red)
     else:
         mean = moving_mean.astype(jnp.float32)

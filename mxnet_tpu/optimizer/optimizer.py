@@ -73,6 +73,33 @@ def _jit_update(fn, static_hypers):
     return step
 
 
+def _is_row_sparse(grad) -> bool:
+    from ..ndarray.sparse import RowSparseNDArray
+
+    return isinstance(grad, RowSparseNDArray) and grad._pair
+
+
+def _rs_aggregate(grad, rescale, clip):
+    """Compressed (rows, vals) -> (unique_rows, summed_vals, valid_mask).
+
+    Duplicate rows (the same token appearing twice in a batch) must sum
+    BEFORE clipping/decay — matching what the dense scatter-add would have
+    produced. Output stays fixed-size (K slots, padded rows masked) so the
+    path is jit-compatible."""
+    rows, vals = grad._rs_rows, grad._rs_vals
+    K = rows.shape[0]
+    nrows = grad.shape[0]
+    rows_u, inv = jnp.unique(rows, return_inverse=True, size=K,
+                             fill_value=nrows)
+    agg = jnp.zeros_like(vals).at[inv].add(vals)
+    valid = rows_u < nrows
+    rows_safe = jnp.where(valid, rows_u, 0)
+    g = agg * rescale
+    if clip is not None:
+        g = jnp.clip(g, -clip, clip)
+    return rows_safe, g, valid
+
+
 class Optimizer:
     """Base optimizer. Reference API: create_state/update(+_multi_precision)."""
 
@@ -260,6 +287,20 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        if _is_row_sparse(grad):
+            if self.momentum:
+                raise MXNetError(
+                    "sparse SGD with momentum is not supported (the "
+                    "reference's sparse sgd_mom kept dense momentum; use "
+                    "momentum=0 for row_sparse grads)"
+                )
+            rows, g, valid = _rs_aggregate(grad, self.rescale_grad,
+                                           self.clip_gradient)
+            w = weight.data
+            upd = lr * (g + wd * jnp.take(w, rows, axis=0))
+            upd = upd * valid[:, None]
+            weight._rebind(w.at[rows].add(-upd.astype(w.dtype)))
+            return
         if state is None:
             self._apply(_fused.sgd_update, weight, grad, (), lr, wd)
         else:
@@ -335,6 +376,23 @@ class Adam(Optimizer):
         coef2 = 1.0 - self.beta2 ** t
         lr = lr * math.sqrt(coef2) / coef1
         mean, var = state
+        if _is_row_sparse(grad):
+            # lazy adam (reference ``lazy_update=True``): moments and
+            # weight rows touched only where the gradient has rows
+            rows, g, valid = _rs_aggregate(grad, self.rescale_grad,
+                                           self.clip_gradient)
+            w = weight.data
+            g = g + wd * jnp.take(w, rows, axis=0)
+            m_old = jnp.take(mean.data, rows, axis=0)
+            v_old = jnp.take(var.data, rows, axis=0)
+            m_r = self.beta1 * m_old + (1.0 - self.beta1) * g
+            v_r = self.beta2 * v_old + (1.0 - self.beta2) * g * g
+            vm = valid[:, None]
+            mean._rebind(mean.data.at[rows].add((m_r - m_old) * vm))
+            var._rebind(var.data.at[rows].add((v_r - v_old) * vm))
+            upd = lr * m_r / (jnp.sqrt(v_r) + self.epsilon) * vm
+            weight._rebind(w.at[rows].add(-upd.astype(w.dtype)))
+            return
         self._apply(_fused.adam_update, weight, grad, (mean, var), lr, wd,
                     beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
 

@@ -1,0 +1,126 @@
+"""Real row_sparse path: compressed-pair storage, sparse embedding
+gradients, lazy sparse optimizer updates, kvstore.row_sparse_pull
+(reference: ``src/kvstore/`` row_sparse push/pull + Embedding
+sparse_grad + sparse optimizer kernels [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+
+
+class TestPairStorage:
+    def test_from_pair_and_densify(self):
+        rs = RowSparseNDArray.from_pair([1, 3, 1], [[1., 2.], [3., 4.], [5., 6.]],
+                                        (5, 2))
+        assert rs.stype == "row_sparse"
+        assert rs.shape == (5, 2)
+        d = rs.asnumpy()
+        np.testing.assert_allclose(d[1], [6., 8.])  # duplicates sum
+        np.testing.assert_allclose(d[3], [3., 4.])
+        assert d[0].sum() == 0
+
+    def test_pair_add_concat(self):
+        a = RowSparseNDArray.from_pair([0], [[1., 1.]], (3, 2))
+        b = RowSparseNDArray.from_pair([2], [[2., 2.]], (3, 2))
+        c = a + b
+        assert isinstance(c, RowSparseNDArray)
+        np.testing.assert_allclose(c.asnumpy(), [[1, 1], [0, 0], [2, 2]])
+
+
+class TestSparseEmbeddingGrad:
+    def test_backward_writes_compressed_pair(self):
+        emb = gluon.nn.Embedding(10, 4, sparse_grad=True)
+        emb.initialize()
+        emb.collect_params().setattr("grad_req", "write")
+        x = nd.array(np.array([[1, 3], [1, 7]]), dtype="int32")
+        with autograd.record():
+            out = emb(x)
+            loss = (out * out).sum()
+        loss.backward()
+        g = emb.weight.grad()
+        assert isinstance(g, RowSparseNDArray)
+        rows = np.sort(np.unique(g.indices.asnumpy()))
+        np.testing.assert_array_equal(rows, [1, 3, 7])
+        # value check vs dense: d(sum w[i]^2)/dw[i] = 2*w[i] per occurrence
+        w = emb.weight.data().asnumpy()
+        dense = np.zeros_like(w)
+        for ids in [1, 3, 1, 7]:
+            dense[ids] += 2 * w[ids]
+        np.testing.assert_allclose(g.asnumpy(), dense, rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("optimizer,kw", [
+        ("sgd", {"learning_rate": 0.1, "momentum": 0.0}),
+        ("adam", {"learning_rate": 0.05}),
+    ])
+    def test_sparse_training_matches_dense(self, optimizer, kw):
+        """The whole point: Embedding(sparse_grad=True) + Trainer must
+        track dense training to tolerance (reference parity claim)."""
+        rng = np.random.RandomState(0)
+        V, D, B, S = 20, 8, 4, 3
+        init_w = rng.randn(V, D).astype(np.float32)
+
+        # NOTE: lazy adam (the sparse path, reference ``lazy_update=True``)
+        # deliberately skips moment decay on rows absent from a step, so
+        # exact dense parity only holds when the same rows appear every
+        # step — adam uses a fixed token batch; sgd (memoryless) varies it
+        fixed = optimizer == "adam"
+        r0 = np.random.RandomState(7)
+        x_fixed = r0.randint(0, V, (B, S))
+
+        def run(sparse):
+            emb = gluon.nn.Embedding(V, D, sparse_grad=sparse)
+            emb.initialize()
+            emb.weight.set_data(nd.array(init_w))
+            tr = gluon.Trainer(emb.collect_params(), optimizer, dict(kw))
+            r = np.random.RandomState(7)
+            for i in range(8):
+                x_np = x_fixed if fixed else r.randint(0, V, (B, S))
+                x = nd.array(x_np, dtype="int32")
+                y = nd.array(r.randn(B, S, D).astype(np.float32))
+                with autograd.record():
+                    out = emb(x)
+                    loss = ((out - y) ** 2).mean()
+                loss.backward()
+                tr.step(B)
+            return emb.weight.data().asnumpy()
+
+        w_sparse = run(True)
+        w_dense = run(False)
+        np.testing.assert_allclose(w_sparse, w_dense, rtol=2e-4, atol=2e-4)
+
+    def test_untouched_rows_have_no_state_updates(self):
+        # lazy adam: rows never seen keep zero moments and exact weights
+        V, D = 12, 4
+        emb = gluon.nn.Embedding(V, D, sparse_grad=True)
+        emb.initialize()
+        w0 = emb.weight.data().asnumpy().copy()
+        tr = gluon.Trainer(emb.collect_params(), "adam",
+                           {"learning_rate": 0.1})
+        x = nd.array(np.array([[2, 5]]), dtype="int32")
+        for _ in range(3):
+            with autograd.record():
+                loss = (emb(x) ** 2).sum()
+            loss.backward()
+            tr.step(1)
+        w1 = emb.weight.data().asnumpy()
+        touched = [2, 5]
+        untouched = [i for i in range(V) if i not in touched]
+        np.testing.assert_array_equal(w1[untouched], w0[untouched])
+        assert not np.allclose(w1[touched], w0[touched])
+
+
+class TestRowSparsePull:
+    def test_pull_requested_rows_only(self):
+        kv = mx.kv.create("local")
+        val = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+        kv.init("emb", val)
+        out = RowSparseNDArray.from_pair([0], [[0., 0.]], (6, 2))
+        kv.row_sparse_pull("emb", out=out, row_ids=nd.array([1, 4]))
+        np.testing.assert_array_equal(out.indices.asnumpy(), [1, 4])
+        np.testing.assert_allclose(out.values.asnumpy(),
+                                   [[2., 3.], [8., 9.]])
+        dense = out.asnumpy()
+        assert dense[0].sum() == 0 and dense[2].sum() == 0
