@@ -1,0 +1,82 @@
+"""DataLoader backends on a Python-heavy decode/augment pipeline:
+serial vs thread pool vs forked processes (the round-3 addition).
+
+The per-sample work mimics the reference's JPEG-decode+augment profile:
+mostly Python/GIL-bound (byte munging, per-pixel python loops) with some
+numpy. Threads can't parallelize the GIL-bound part; processes can —
+GIVEN CORES. This benchmark machine has os.sched_getaffinity == 1 CPU,
+so here processes only add IPC overhead and threads/serial tie; the
+output records all three so multi-core hosts can see the crossover
+(worker parallelism itself is covered by tests/test_dataloader_mp.py).
+
+    python -m benchmarks.bench_dataloader
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+from mxnet_tpu.gluon import data as gdata
+
+N, DIM, BATCH = 256, (32, 32, 3), 16
+
+
+class _AugmentDataset(gdata.Dataset):
+    def __len__(self):
+        return N
+
+    def __getitem__(self, i):
+        rng = np.random.RandomState(i)
+        img = rng.randint(0, 255, DIM).astype(np.uint8)
+        # GIL-bound "decode": python-level byte shuffling sized like a
+        # real JPEG entropy-decode loop (~100k python ops per image)
+        rows = [bytes(img[r].tobytes()) for r in range(DIM[0])]
+        acc = 0
+        for _ in range(12):
+            for r in rows:
+                for b in r:
+                    acc = (acc * 31 + b) & 0xFFFF
+        # numpy augment: flip + normalize + crop
+        out = img[:, ::-1].astype(np.float32) / 255.0
+        out = (out - 0.5) + (acc % 7) * 1e-4
+        return out[2:30, 2:30]
+
+
+def _time(loader):
+    # epoch 0 warms the pipeline (fork startup for the mp backend — its
+    # workers persist across epochs); time the steady-state epoch
+    for b in loader:
+        pass
+    t0 = time.perf_counter()
+    n = 0
+    for b in loader:
+        n += b.shape[0]
+    return n / (time.perf_counter() - t0)
+
+
+def main():
+    import os
+
+    ds = _AugmentDataset()
+    serial = _time(gdata.DataLoader(ds, batch_size=BATCH))
+    threads = _time(gdata.DataLoader(ds, batch_size=BATCH, num_workers=4,
+                                     thread_pool=True))
+    procs = _time(gdata.DataLoader(ds, batch_size=BATCH, num_workers=4))
+    best = max(serial, threads, procs)
+    print(json.dumps({
+        "metric": "dataloader_augment_images_per_sec",
+        "value": round(best, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(best / max(serial, 1e-9), 4),
+        "serial": round(serial, 1),
+        "threads_x4": round(threads, 1),
+        "processes_x4": round(procs, 1),
+        "cpus": len(os.sched_getaffinity(0)),
+    }))
+
+
+if __name__ == "__main__":
+    main()

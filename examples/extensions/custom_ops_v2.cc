@@ -1,0 +1,105 @@
+// mxtpu extension library, ABI VERSION 2: shape/dtype inference,
+// multi-output, non-f32 dtypes, scalar params.
+//
+// Ops:
+//   scaled_rowsum  f32 (N, D) -> f32 (N,)  out[n] = alpha * sum_d in[n,d]
+//                  (param alpha, default 1; has backward)
+//   minmax_i32     i32 (N,) -> (i32 (1,), i32 (1,))  min and max
+//                  (multi-output, integer dtype, no backward)
+//
+// Build:
+//   g++ -O2 -shared -fPIC -o libcustom_v2.so custom_ops_v2.cc
+
+#include <cstring>
+#include <cstdint>
+#include <cstdlib>
+#include <string>
+
+extern "C" {
+
+int mxtpu_abi_version() { return 2; }
+int mxtpu_op_count() { return 2; }
+
+const char* mxtpu_op_name(int op) {
+  return op == 0 ? "scaled_rowsum" : "minmax_i32";
+}
+
+int mxtpu_op_num_inputs(int op) { (void)op; return 1; }
+int mxtpu_op_num_outputs(int op) { return op == 0 ? 1 : 2; }
+int mxtpu_op_has_backward(int op) { return op == 0 ? 1 : 0; }
+
+static double param_alpha(const char* params) {
+  if (!params) return 1.0;
+  std::string s(params);
+  auto pos = s.find("alpha=");
+  if (pos == std::string::npos) return 1.0;
+  return std::atof(s.c_str() + pos + 6);
+}
+
+int mxtpu_op_infer(int op, const long long* in_shapes, const int* in_ndims,
+                   const int* in_dtypes, int nin, long long* out_shapes,
+                   int* out_ndims, int* out_dtypes, int max_ndim,
+                   const char* params) {
+  (void)nin; (void)params;
+  if (op == 0) {  // (N, D) f32 -> (N,) f32
+    if (in_ndims[0] != 2 || in_dtypes[0] != 0) return 1;
+    out_ndims[0] = 1;
+    out_shapes[0 * max_ndim + 0] = in_shapes[0];
+    out_dtypes[0] = 0;
+    return 0;
+  }
+  // minmax: (N,) i32 -> ((1,), (1,)) i32
+  if (in_ndims[0] != 1 || in_dtypes[0] != 2) return 1;
+  out_ndims[0] = 1; out_shapes[0 * max_ndim + 0] = 1; out_dtypes[0] = 2;
+  out_ndims[1] = 1; out_shapes[1 * max_ndim + 0] = 1; out_dtypes[1] = 2;
+  return 0;
+}
+
+void mxtpu_op_compute2(int op, const void** ins, const long long* in_shapes,
+                       const int* in_ndims, const int* in_dtypes, int nin,
+                       void** outs, const long long* out_shapes,
+                       const int* out_ndims, const int* out_dtypes, int nout,
+                       const char* params) {
+  (void)in_ndims; (void)in_dtypes; (void)nin;
+  (void)out_shapes; (void)out_ndims; (void)out_dtypes; (void)nout;
+  if (op == 0) {
+    const float* x = static_cast<const float*>(ins[0]);
+    float* y = static_cast<float*>(outs[0]);
+    long long n = in_shapes[0], d = in_shapes[1];
+    float alpha = static_cast<float>(param_alpha(params));
+    for (long long i = 0; i < n; ++i) {
+      float acc = 0.f;
+      for (long long j = 0; j < d; ++j) acc += x[i * d + j];
+      y[i] = alpha * acc;
+    }
+    return;
+  }
+  const int32_t* x = static_cast<const int32_t*>(ins[0]);
+  long long n = in_shapes[0];
+  int32_t mn = x[0], mx = x[0];
+  for (long long i = 1; i < n; ++i) {
+    if (x[i] < mn) mn = x[i];
+    if (x[i] > mx) mx = x[i];
+  }
+  static_cast<int32_t*>(outs[0])[0] = mn;
+  static_cast<int32_t*>(outs[1])[0] = mx;
+}
+
+void mxtpu_op_backward2(int op, const void** out_grads, const void** ins,
+                        const long long* in_shapes, const int* in_ndims,
+                        const int* in_dtypes, int nin, void** in_grads,
+                        const char* params) {
+  (void)in_ndims; (void)in_dtypes; (void)nin;
+  if (op != 0) return;
+  // d(alpha * rowsum)/dx[i,j] = alpha * og[i]
+  const float* og = static_cast<const float*>(out_grads[0]);
+  (void)ins;
+  float* gx = static_cast<float*>(in_grads[0]);
+  long long n = in_shapes[0], d = in_shapes[1];
+  float alpha = static_cast<float>(param_alpha(params));
+  for (long long i = 0; i < n; ++i)
+    for (long long j = 0; j < d; ++j)
+      gx[i * d + j] = alpha * og[i];
+}
+
+}  // extern "C"

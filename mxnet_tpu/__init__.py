@@ -75,6 +75,7 @@ for _mod, _aliases in [
     ("checkpoint", ()),
     ("callback", ()),
     ("library", ()),
+    ("operator", ()),
     ("contrib", ()),
     ("onnx", ()),
     ("debug", ()),

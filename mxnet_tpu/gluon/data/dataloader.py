@@ -1,19 +1,35 @@
 """DataLoader (reference: ``python/mxnet/gluon/data/dataloader.py``
 [unverified]).
 
-The reference forked worker *processes* that rebuilt NDArrays in shared
-memory. Here batches are host-side numpy until the device feed (a jax
-device_put at the end), so worker *threads* suffice: decode/augment/batchify
-release the GIL inside numpy, and the thread pool + bounded prefetch queue
-reproduces the reference's ``ThreadedIter`` pipeline without fork-unsafe
-interaction with the TPU runtime (the reference itself had engine-fork
-handlers for exactly that hazard)."""
+Two parallel backends, matching the reference's split:
+
+- ``num_workers > 0`` (default): forked worker PROCESSES, batches come
+  back as numpy through POSIX shared memory (the reference rebuilt
+  NDArrays in shared memory the same way) and are device-fed in the
+  parent. True parallelism for Python-heavy augmentation pipelines the
+  GIL would serialize. Workers must not touch the device: datasets
+  should yield numpy/python values (device arrays are converted in the
+  parent) — the fork inherits the TPU runtime's sockets, so any child
+  device call would corrupt the parent's session (the reference kept
+  engine fork-handlers for exactly this hazard).
+- ``thread_pool=True``: worker threads + bounded prefetch queue (the
+  reference's ``ThreadedIter`` shape) — right when the work is
+  numpy-bound (releases the GIL) or the dataset holds device arrays.
+
+``pin_memory=True`` device_puts each batch as it is yielded (the TPU
+analogue of pinned-host staging: the transfer is issued immediately,
+async, so compute overlaps the next batch's host work).
+"""
 
 from __future__ import annotations
 
+import multiprocessing as _mp
+import os
+import pickle
 import queue
 import threading
 from concurrent.futures import ThreadPoolExecutor
+from multiprocessing import shared_memory as _shm
 
 import numpy as _np
 
@@ -32,6 +48,95 @@ def default_batchify_fn(data):
         return [default_batchify_fn(list(i)) for i in zip(*data)]
     data = _np.asarray(data)
     return nd_array(data)
+
+
+def _np_batchify(data):
+    """Worker-side batchify: numpy only — a forked worker must never
+    touch the device (it inherits the parent runtime's sockets), so
+    device NDArrays from the dataset are a hard error with the fix
+    named instead of a silent session-corrupting transfer."""
+    first = data[0]
+    if isinstance(first, NDArray):
+        raise TypeError(
+            "dataset yielded device NDArrays inside a forked DataLoader "
+            "worker; device access from the child would corrupt the "
+            "parent's TPU session. Yield numpy/python values, or use "
+            "DataLoader(..., thread_pool=True)"
+        )
+    if isinstance(first, (tuple, list)):
+        return [_np_batchify(list(i)) for i in zip(*data)]
+    return _np.asarray(data)
+
+
+def _to_device(batch):
+    if isinstance(batch, list):
+        return [_to_device(b) for b in batch]
+    return nd_array(batch)
+
+
+# ---------------------------------------------------------------- mp worker
+def _pack(tree):
+    """numpy tree -> (spec, shm list): arrays ride shared memory, not the
+    pickle stream (one copy on each side instead of pickle+copy)."""
+    shms = []
+
+    def walk(node):
+        if isinstance(node, _np.ndarray) and node.nbytes > 0:
+            s = _shm.SharedMemory(create=True, size=node.nbytes)
+            view = _np.ndarray(node.shape, node.dtype, buffer=s.buf)
+            view[...] = node
+            shms.append(s)
+            return ("arr", node.shape, str(node.dtype), s.name)
+        if isinstance(node, list):
+            return ("list", [walk(n) for n in node])
+        return ("obj", node)
+
+    return walk(tree), shms
+
+
+def _unpack(spec):
+    kind = spec[0]
+    if kind == "arr":
+        _, shape, dtype, name = spec
+        s = _shm.SharedMemory(name=name)
+        try:
+            out = _np.ndarray(shape, dtype, buffer=s.buf).copy()
+        finally:
+            s.close()
+            s.unlink()
+        return out
+    if kind == "list":
+        return [_unpack(n) for n in spec[1]]
+    return spec[1]
+
+
+def _worker_loop(dataset, index_q, data_q, seed, batchify_fn):
+    # child of fork: numpy-only territory (device calls are forbidden).
+    # batchify_fn is fork-inherited; a custom one must return numpy/python
+    # values only (the parent converts to device arrays)
+    _np.random.seed(seed)
+    batchify = batchify_fn or _np_batchify
+    while True:
+        job = index_q.get()
+        if job is None:
+            return
+        bid, indices = job
+        try:
+            batch = batchify([dataset[i] for i in indices])
+            spec, shms = _pack(batch)
+            data_q.put((bid, "ok", spec))
+            for s in shms:
+                s.close()
+                # the parent unlinks after rebuilding; deregister here so
+                # this process's resource tracker doesn't warn about (and
+                # double-unlink) segments it no longer owns
+                try:
+                    from multiprocessing import resource_tracker
+                    resource_tracker.unregister(s._name, "shared_memory")
+                except Exception:  # noqa: BLE001 - tracker impl detail
+                    pass
+        except Exception as e:  # noqa: BLE001 - forward to the parent
+            data_q.put((bid, "err", pickle.dumps(e)))
 
 
 class DataLoader:
@@ -61,6 +166,9 @@ class DataLoader:
             )
         self._batch_sampler = batch_sampler
         self._num_workers = max(0, num_workers)
+        self._thread_pool = bool(thread_pool)
+        self._pin_memory = bool(pin_memory)
+        self._pin_device_id = int(pin_device_id)
         self._prefetch = max(
             0, int(prefetch) if prefetch is not None else 2 * self._num_workers
         )
@@ -72,12 +180,35 @@ class DataLoader:
     def _load(self, indices):
         return self._batchify_fn([self._dataset[i] for i in indices])
 
+    def _maybe_pin(self, batch):
+        if not self._pin_memory:
+            return batch
+        import jax
+
+        dev = jax.devices()[self._pin_device_id] \
+            if self._pin_device_id < len(jax.devices()) else jax.devices()[0]
+
+        def put(b):
+            if isinstance(b, list):
+                return [put(x) for x in b]
+            if isinstance(b, NDArray):
+                return NDArray(jax.device_put(b.data, dev))
+            return b
+
+        return put(batch)
+
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
-                yield self._load(indices)
+                yield self._maybe_pin(self._load(indices))
             return
-        # threaded pipeline: submit up to `prefetch` batches ahead
+        if self._thread_pool:
+            yield from self._iter_threaded()
+        else:
+            yield from self._iter_mp()
+
+    # --------------------------------------------------------- thread pool
+    def _iter_threaded(self):
         with ThreadPoolExecutor(max_workers=self._num_workers) as pool:
             # bounded queue: feeder blocks when `prefetch` batches are pending
             futures = queue.Queue(maxsize=self._prefetch + 1)
@@ -113,6 +244,141 @@ class DataLoader:
                     fut = futures.get()
                     if fut is None:
                         break
-                    yield fut.result(timeout=self._timeout)
+                    yield self._maybe_pin(fut.result(timeout=self._timeout))
             finally:
                 stop.set()
+
+    # ------------------------------------------------------ fork processes
+    def _ensure_pool(self):
+        """Spawn the worker pool ONCE and reuse it across epochs
+        (persistent workers): forking a large parent per epoch costs more
+        than a short epoch's worth of loading."""
+        pool = getattr(self, "_mp_pool", None)
+        if pool is not None:
+            if all(p.is_alive() for p in pool[0]):
+                return pool
+            # partially dead: retire the survivors before rebuilding, or
+            # they stay blocked on the orphaned queue forever
+            old_workers, old_index_q, _old_dq = pool
+            for p in old_workers:
+                if p.is_alive():
+                    try:
+                        old_index_q.put_nowait(None)
+                    except Exception:  # noqa: BLE001 - full/closed queue
+                        pass
+            for p in old_workers:
+                p.join(timeout=0.5)
+                if p.is_alive():
+                    p.terminate()
+        ctx = _mp.get_context("fork")
+        index_q = ctx.Queue()
+        data_q = ctx.Queue()
+        workers = []
+        custom = self._batchify_fn \
+            if self._batchify_fn is not default_batchify_fn else None
+        for w in range(self._num_workers):
+            p = ctx.Process(
+                target=_worker_loop,
+                args=(self._dataset, index_q, data_q,
+                      _np.random.randint(0, 2 ** 31 - 1), custom),
+                daemon=True,
+            )
+            p.start()
+            workers.append(p)
+        self._mp_pool = (workers, index_q, data_q)
+        self._mp_next_id = 0
+        return self._mp_pool
+
+    def __del__(self):
+        pool = getattr(self, "_mp_pool", None)
+        if pool is None:
+            return
+        workers, index_q, _ = pool
+        for _w in workers:
+            try:
+                index_q.put(None)
+            except Exception:  # noqa: BLE001 - interpreter shutdown
+                pass
+        for p in workers:
+            if p.is_alive():
+                p.terminate()
+
+    @staticmethod
+    def _discard(spec):
+        """Unlink the shared memory of an unclaimed result."""
+        if spec[0] == "arr":
+            try:
+                seg = _shm.SharedMemory(name=spec[3])
+                seg.close()
+                seg.unlink()
+            except FileNotFoundError:
+                pass
+        elif spec[0] == "list":
+            for n in spec[1]:
+                DataLoader._discard(n)
+
+    def _drain_stale(self, data_q):
+        """Consume results left over from an abandoned iterator, freeing
+        their shared-memory segments."""
+        while True:
+            try:
+                _bid, status, payload = data_q.get_nowait()
+            except queue.Empty:
+                return
+            if status == "ok":
+                self._discard(payload)
+
+    def _iter_mp(self):
+        workers, index_q, data_q = self._ensure_pool()
+        self._drain_stale(data_q)
+        batches = list(self._batch_sampler)
+        base = self._mp_next_id  # unique ids across epochs
+        self._mp_next_id += len(batches)
+        ahead = min(len(batches), self._num_workers + self._prefetch)
+        for i in range(ahead):
+            index_q.put((base + i, batches[i]))
+        next_submit = ahead
+        pending = {}
+        import time as _time
+
+        try:
+            yield from self._mp_consume(
+                workers, index_q, data_q, batches, base, ahead, pending,
+                _time)
+        finally:
+            # abandoned mid-epoch (break/exception): results already on
+            # the queue would leak their shm segments; reap them now (a
+            # worker still computing is reaped by the next epoch's drain)
+            self._drain_stale(data_q)
+
+    def _mp_consume(self, workers, index_q, data_q, batches, base, ahead,
+                    pending, _time):
+        next_submit = ahead
+        for want_i in range(len(batches)):
+            want = base + want_i
+            deadline = _time.monotonic() + self._timeout
+            while want not in pending:
+                try:
+                    bid, status, payload = data_q.get(timeout=1.0)
+                except queue.Empty:
+                    dead = [i for i, p in enumerate(workers)
+                            if not p.is_alive()]
+                    if dead:
+                        codes = [workers[i].exitcode for i in dead]
+                        raise RuntimeError(
+                            f"DataLoader worker(s) {dead} died "
+                            f"(exitcode {codes}); restart the iterator"
+                        )
+                    if _time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"DataLoader batch {want} timed out after "
+                            f"{self._timeout}s (workers alive but stuck)"
+                        )
+                    continue
+                if status == "err":
+                    raise pickle.loads(payload)
+                pending[bid] = _unpack(payload)
+            if next_submit < len(batches):
+                index_q.put((base + next_submit, batches[next_submit]))
+                next_submit += 1
+            yield self._maybe_pin(_to_device(pending.pop(want)))

@@ -188,6 +188,12 @@ def _sparse_embedding_apply(x, weight_param, input_dim, output_dim):
     import numpy as _np2
 
     weight_nd = weight_param.data()
+    # 'write' semantics reset at APPLY time (all applies of one recorded
+    # graph run before any backward), so multiple uses of the same weight
+    # in one graph ACCUMULATE in the backward — matching the dense tape —
+    # while the next iteration's forward drops the stale gradient
+    if weight_param.grad_req == "write":
+        weight_nd._grad = None
 
     class _Apply(autograd.Function):
         def forward(self, x_nd, w_nd):
@@ -200,9 +206,8 @@ def _sparse_embedding_apply(x, weight_param, input_dim, output_dim):
             g = RowSparseNDArray.from_pair(
                 ids, vals, (input_dim, output_dim)
             )
-            if weight_param.grad_req == "add" and isinstance(
-                weight_nd._grad, RowSparseNDArray
-            ):
+            if isinstance(weight_nd._grad, RowSparseNDArray) and \
+                    weight_nd._grad._pair:
                 g = weight_nd._grad + g
             weight_nd._grad = g
             # float0 cotangents: the tape must NOT accumulate a dense

@@ -83,7 +83,13 @@ def invoke(op, *args, out=None, **params):
             rest = [NDArray(o) for o in outs_t[1:]]
             return target if not rest else (target, *rest)
         return _wrap_outputs(outs)
-    result = invoke_fn(fn, *args)
+    if getattr(op, "self_recording", False):
+        # the op's fn builds its own tape entry (python/C++ custom ops
+        # whose host bodies cannot consume jax tracers): hand it the
+        # ORIGINAL NDArrays so its Function links to the caller's graph
+        result = _wrap_outputs(fn(*args))
+    else:
+        result = invoke_fn(fn, *args)
     if out is not None:
         _bind_out(out, result)
         return out

@@ -222,6 +222,15 @@ def create(name="local") -> KVStore:
     'local'/'device'/'nccl' → in-process store (GSPMD handles intra-host
     reduction). 'dist_sync'/'dist_async' → distributed store over the jax
     coordinator (requires `mxnet_tpu.parallel.init_process_group`).
+
+    SEMANTICS NOTE: 'dist_async' is accepted for API compatibility but
+    runs with 'dist_sync' semantics. The reference's async mode let each
+    worker push/pull against the parameter server without waiting for
+    the others; the TPU-native transport is XLA collectives, which are
+    inherently bulk-synchronous — there is no parameter server to be
+    asynchronous against. Code written for dist_async runs correctly
+    (synchronous execution satisfies async's contract), just without the
+    staleness/throughput trade the reference offered.
     """
     if not isinstance(name, str):
         raise MXNetError("name must be a string")

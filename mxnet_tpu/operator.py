@@ -1,0 +1,196 @@
+"""Pure-Python custom operators — the reference's ``mx.operator`` module
+(``CustomOp``/``CustomOpProp``/``register`` over
+``src/operator/custom/custom.cc`` [unverified]).
+
+The reference dispatched these through C++->Python callbacks on the
+engine's CPU stream; here the op body runs eagerly on host numpy (same
+execution model) and hooks into the framework tape for autograd. Loaded
+ops join the one operator registry, so ``mx.nd.Custom(data,
+op_type='my_op')`` and direct ``mx.nd.my_op(data)`` both work.
+
+The in/out protocol matches the reference:
+
+    @mx.operator.register("sigmoid2x")
+    class Sigmoid2xProp(mx.operator.CustomOpProp):
+        def list_arguments(self): return ["data"]
+        def list_outputs(self):   return ["output"]
+        def infer_shape(self, in_shape):
+            return in_shape, [in_shape[0]], []
+        def create_operator(self, ctx, shapes, dtypes):
+            return Sigmoid2x()
+
+    class Sigmoid2x(mx.operator.CustomOp):
+        def forward(self, is_train, req, in_data, out_data, aux):
+            y = 2 / (1 + np.exp(-in_data[0].asnumpy()))
+            self.assign(out_data[0], req[0], mx.nd.array(y))
+        def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+            y = out_data[0].asnumpy() / 2
+            g = out_grad[0].asnumpy() * 2 * y * (1 - y)
+            self.assign(in_grad[0], req[0], mx.nd.array(g))
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Type
+
+import jax.numpy as jnp
+import numpy as _np
+
+from . import autograd as _ag
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ops import registry as _registry
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered"]
+
+_PROPS: Dict[str, Type["CustomOpProp"]] = {}
+
+
+class CustomOp:
+    """Base class for the operator body (reference ``mx.operator.CustomOp``)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise MXNetError(
+            f"{type(self).__name__} does not implement backward()"
+        )
+
+    @staticmethod
+    def assign(dst: NDArray, req: str, src):
+        """Write ``src`` into ``dst`` honoring grad_req semantics."""
+        if req == "null":
+            return
+        src_nd = src if isinstance(src, NDArray) else NDArray(jnp.asarray(src))
+        if req in ("write", "inplace"):
+            dst._rebind(src_nd.data.astype(dst.data.dtype))
+        elif req == "add":
+            dst._rebind(dst.data + src_nd.data.astype(dst.data.dtype))
+        else:
+            raise MXNetError(f"unknown req {req!r}")
+
+
+class CustomOpProp:
+    """Operator metadata + factory (reference ``CustomOpProp``)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        """Default: single output shaped like input 0 (reference default)."""
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str):
+    """Class decorator registering a CustomOpProp under ``reg_name``.
+
+    Exposes the op both as ``mx.nd.<reg_name>`` and through
+    ``mx.nd.Custom(..., op_type=reg_name)``."""
+
+    def deco(prop_cls: Type[CustomOpProp]):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() needs a CustomOpProp subclass")
+        if reg_name in _PROPS:
+            raise MXNetError(f"custom op {reg_name!r} already registered")
+        _PROPS[reg_name] = prop_cls
+
+        def fn(*arrays, **kw):
+            import jax
+
+            if any(isinstance(a, jax.core.Tracer) for a in arrays):
+                raise MXNetError(
+                    f"python CustomOp {reg_name!r} runs eagerly only "
+                    "(reference custom.cc semantics); call outside "
+                    "jit/hybridize"
+                )
+            return _run_custom(reg_name, arrays, kw)
+
+        fn.__name__ = reg_name
+        fn.__doc__ = f"Python custom operator {reg_name!r} " \
+            f"({prop_cls.__name__})."
+        if _registry.maybe_get(reg_name) is None:
+            # the fn makes its own tape entry; keep the invoke layer's
+            # jax.vjp routing away from the python callback
+            _registry.register(reg_name, differentiable=False,
+                               self_recording=True)(fn)
+            import sys
+
+            from .ndarray import register as _nd_register
+
+            _nd_register.populate_module(
+                sys.modules["mxnet_tpu.ndarray"], "nd"
+            )
+        return prop_cls
+
+    return deco
+
+
+def get_all_registered():
+    return sorted(_PROPS)
+
+
+def _run_custom(reg_name, raw_inputs, kwargs):
+    prop = _PROPS[reg_name](**kwargs) if kwargs else _PROPS[reg_name]()
+    in_nds = [a if isinstance(a, NDArray) else NDArray(jnp.asarray(a))
+              for a in raw_inputs]
+    in_shapes = [tuple(a.shape) for a in in_nds]
+    out_shapes, = [prop.infer_shape(list(in_shapes))[1]]
+    in_types = [_np.dtype(str(a.data.dtype)) for a in in_nds]
+    out_types = prop.infer_type(list(in_types))[1]
+    body = prop.create_operator(None, in_shapes, in_types)
+    out_nds = [NDArray(jnp.zeros(tuple(s), t))
+               for s, t in zip(out_shapes, out_types)]
+
+    recording = _ag.is_recording()
+    with _ag.pause():
+        body.forward(recording, ["write"] * len(out_nds), in_nds, out_nds,
+                     [])
+    if not recording:
+        return out_nds[0] if len(out_nds) == 1 else tuple(out_nds)
+
+    fwd_outs = [NDArray(o.data) for o in out_nds]
+
+    class _Fn(_ag.Function):
+        def forward(self, *ins):
+            return tuple(fwd_outs) if len(fwd_outs) > 1 else fwd_outs[0]
+
+        def backward(self, *ogs):
+            in_grads = [NDArray(jnp.zeros_like(a.data)) for a in in_nds]
+            body.backward(
+                ["write"] * len(in_grads), list(ogs), in_nds, out_nds,
+                in_grads, [],
+            )
+            return tuple(in_grads)
+
+    return _Fn()(*in_nds)
+
+
+def _custom_dispatch(*arrays, op_type=None, **kw):
+    """``nd.Custom(data..., op_type='name')`` — the reference's generic
+    entry point for python custom ops."""
+    if op_type is None or op_type not in _PROPS:
+        raise MXNetError(
+            f"Custom: unknown op_type {op_type!r}; registered: "
+            f"{get_all_registered()}"
+        )
+    return _run_custom(op_type, arrays, kw)
+
+
+_registry.register("Custom", differentiable=False,
+                   self_recording=True)(_custom_dispatch)

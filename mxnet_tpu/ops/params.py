@@ -1,0 +1,299 @@
+"""Typed operator-parameter schemas — the ``dmlc::Parameter`` analogue.
+
+Reference: every reference op declared a ``dmlc::Parameter`` struct
+(name, type, default, range, description) that powered generated python
+docstrings, argument validation at the C API boundary, and op-config
+serialization (``include/dmlc/parameter.h`` [unverified]). Here the same
+schema is a Python declaration attached to a registered op:
+
+    @op_params(
+        P("kernel", "Shape", required=True, doc="convolution window"),
+        P("stride", "Shape", default=1, doc="window stride"),
+        P("num_filter", "int", required=True, low=1, doc="output channels"),
+    )
+    @register("Convolution")
+    def convolution(...): ...
+
+What it powers:
+- ``describe_op(name)`` / ``Operator.param_schema`` — structured
+  introspection (the reference's ``MXSymbolGetAtomicSymbolInfo``);
+- generated docstring PARAMETER sections (appended to the op's own);
+- ``validate_params(name, kwargs)`` — typed coercion + range checks,
+  used by the frontends that accept string attrs (symbol JSON);
+- schema serialization via ``schema_to_json`` (op-config round trips).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from .registry import get as _get_op, maybe_get as _maybe_get
+
+__all__ = ["P", "op_params", "describe_op", "validate_params",
+           "schema_to_json", "list_documented_ops"]
+
+# name -> coercion callable; mirrors the dmlc type names the reference
+# printed in docstrings
+_TYPES: Dict[str, Callable[[Any], Any]] = {
+    "int": int,
+    "float": float,
+    "bool": lambda v: v if isinstance(v, bool) else str(v).lower()
+    in ("1", "true", "yes", "on"),
+    "str": str,
+    "Shape": lambda v: tuple(int(x) for x in v)
+    if isinstance(v, (tuple, list)) else (int(v),),
+    "tuple_of_float": lambda v: tuple(float(x) for x in v),
+    "any": lambda v: v,
+}
+
+
+class P:
+    """One parameter declaration."""
+
+    __slots__ = ("name", "type", "default", "required", "low", "high",
+                 "choices", "doc")
+
+    def __init__(self, name: str, type: str = "any", default: Any = None,
+                 required: bool = False, low=None, high=None,
+                 choices: Optional[Sequence] = None, doc: str = ""):
+        if type not in _TYPES:
+            raise ValueError(f"unknown param type {type!r}")
+        self.name = name
+        self.type = type
+        self.default = default
+        self.required = required
+        self.low = low
+        self.high = high
+        self.choices = tuple(choices) if choices else None
+        self.doc = doc
+
+    def describe(self) -> dict:
+        d = {"name": self.name, "type": self.type, "doc": self.doc}
+        if self.required:
+            d["required"] = True
+        else:
+            d["default"] = self.default
+        if self.low is not None:
+            d["low"] = self.low
+        if self.high is not None:
+            d["high"] = self.high
+        if self.choices is not None:
+            d["choices"] = list(self.choices)
+        return d
+
+    def coerce(self, value):
+        out = _TYPES[self.type](value)
+        if self.low is not None and out < self.low:
+            raise ValueError(
+                f"param {self.name}={out!r} below minimum {self.low}"
+            )
+        if self.high is not None and out > self.high:
+            raise ValueError(
+                f"param {self.name}={out!r} above maximum {self.high}"
+            )
+        if self.choices is not None and out not in self.choices:
+            raise ValueError(
+                f"param {self.name}={out!r} not in {self.choices}"
+            )
+        return out
+
+
+def _docstring_section(schema: Sequence[P]) -> str:
+    lines = ["", "", "Op Parameters", "-------------"]
+    for p in schema:
+        head = f"{p.name} : {p.type}"
+        head += ", required" if p.required else f", default={p.default!r}"
+        if p.choices:
+            head += f", choices={list(p.choices)}"
+        lines.append(head)
+        if p.doc:
+            lines.append(f"    {p.doc}")
+    return "\n".join(lines)
+
+
+def op_params(*schema: P):
+    """Attach a typed parameter schema to a registered op function.
+
+    Apply ABOVE ``@register`` (decorates the raw fn after registration);
+    the schema lands on the Operator entry and the fn's docstring grows a
+    generated PARAMETERS section."""
+
+    def deco(fn):
+        opname = getattr(fn, "__mx_op_name__", fn.__name__)
+        op = _maybe_get(opname)
+        if op is None:
+            # fall back: find the op whose fn is this function
+            from .registry import _REGISTRY
+
+            for name, entry in _REGISTRY.items():
+                if entry.fn is fn:
+                    op = entry
+                    break
+        if op is None:
+            raise ValueError(
+                f"op_params: no registered op found for {fn.__name__}; "
+                "apply above @register"
+            )
+        op.param_schema = list(schema)
+        fn.__doc__ = (fn.__doc__ or "") + _docstring_section(schema)
+        return fn
+
+    return deco
+
+
+def describe_op(name: str) -> dict:
+    """Structured op description (reference: GetAtomicSymbolInfo)."""
+    op = _get_op(name)
+    schema = getattr(op, "param_schema", None)
+    return {
+        "name": op.name,
+        "aliases": list(op.aliases),
+        "doc": (op.fn.__doc__ or "").strip(),
+        "params": [p.describe() for p in schema] if schema else [],
+    }
+
+
+def validate_params(name: str, kwargs: dict, allow_unknown: bool = True
+                    ) -> dict:
+    """Coerce/validate kwargs against the op's schema (typed attrs from
+    symbol JSON arrive as strings — this is the boundary that fixes
+    them). Unknown keys pass through unless allow_unknown=False."""
+    op = _get_op(name)
+    schema = getattr(op, "param_schema", None)
+    if not schema:
+        return dict(kwargs)
+    by_name = {p.name: p for p in schema}
+    out = {}
+    for k, v in kwargs.items():
+        p = by_name.get(k)
+        if p is None:
+            if not allow_unknown:
+                raise ValueError(f"op {name}: unknown param {k!r}")
+            out[k] = v
+        else:
+            out[k] = p.coerce(v)
+    missing = [p.name for p in schema
+               if p.required and p.name not in kwargs]
+    if missing:
+        raise ValueError(f"op {name}: missing required params {missing}")
+    return out
+
+
+def schema_to_json(name: str) -> str:
+    return json.dumps(describe_op(name), indent=2)
+
+
+def list_documented_ops():
+    from .registry import _REGISTRY
+
+    return sorted(n for n, e in _REGISTRY.items()
+                  if getattr(e, "param_schema", None))
+
+
+def _install_builtin_schemas():
+    """Schemas for the heavily-parameterized builtin ops (the reference
+    declared one dmlc::Parameter struct per op; the long tail of simple
+    elementwise ops has nothing to declare)."""
+    from .registry import maybe_get
+
+    def attach(name, *schema):
+        op = maybe_get(name)
+        if op is not None and op.param_schema is None:
+            op.param_schema = list(schema)
+            op.fn.__doc__ = (op.fn.__doc__ or "") + _docstring_section(schema)
+
+    attach(
+        "Convolution",
+        P("kernel", "Shape", required=True, doc="convolution window"),
+        P("stride", "Shape", default=1, doc="window strides"),
+        P("dilate", "Shape", default=1, doc="kernel dilation"),
+        P("pad", "Shape", default=0, doc="symmetric zero padding"),
+        P("num_filter", "int", required=True, low=1, doc="output channels"),
+        P("num_group", "int", default=1, low=1, doc="grouped-conv groups"),
+        P("no_bias", "bool", default=False, doc="skip the bias add"),
+        P("layout", "str", default="NCHW",
+          choices=("NCW", "NCHW", "NCDHW", "NWC", "NHWC", "NDHWC"),
+          doc="channel-first (reference default) or channel-last (TPU)"),
+    )
+    attach(
+        "Pooling",
+        P("kernel", "Shape", default=1, doc="pooling window"),
+        P("pool_type", "str", default="max",
+          choices=("max", "avg", "sum", "lp"), doc="reduction kind"),
+        P("global_pool", "bool", default=False, doc="pool whole spatial"),
+        P("stride", "Shape", default=1, doc="window strides"),
+        P("pad", "Shape", default=0, doc="symmetric padding"),
+        P("pooling_convention", "str", default="valid",
+          choices=("valid", "full"), doc="floor vs ceil output size"),
+        P("count_include_pad", "bool", default=True,
+          doc="avg divides by window size incl. padding"),
+        P("layout", "str", default="NCHW", doc="NC* or N*C data layout"),
+    )
+    attach(
+        "BatchNorm",
+        P("eps", "float", default=1e-3, low=0.0, doc="variance epsilon"),
+        P("momentum", "float", default=0.9, low=0.0, high=1.0,
+          doc="moving-average momentum"),
+        P("fix_gamma", "bool", default=True, doc="freeze gamma at 1"),
+        P("use_global_stats", "bool", default=False,
+          doc="normalize with moving stats even in training"),
+        P("axis", "int", default=1, doc="channel axis"),
+    )
+    attach(
+        "Dropout",
+        P("p", "float", default=0.5, low=0.0, high=1.0, doc="drop rate"),
+        P("mode", "str", default="training",
+          choices=("training", "always"), doc="when masks apply"),
+    )
+    attach(
+        "_contrib_box_nms",
+        P("overlap_thresh", "float", default=0.5, low=0.0, high=1.0,
+          doc="IoU suppression threshold"),
+        P("valid_thresh", "float", default=0.0, doc="min score to enter"),
+        P("topk", "int", default=-1, doc="max survivors (-1: all)"),
+        P("coord_start", "int", default=2, doc="box column offset"),
+        P("score_index", "int", default=1, doc="score column"),
+        P("id_index", "int", default=-1, doc="class-id column (-1: none)"),
+        P("force_suppress", "bool", default=False,
+          doc="suppress across class ids"),
+        P("in_format", "str", default="corner", choices=("corner", "center"),
+          doc="input box encoding"),
+    )
+    attach(
+        "_contrib_Proposal",
+        P("rpn_pre_nms_top_n", "int", default=6000, low=1,
+          doc="candidates entering NMS"),
+        P("rpn_post_nms_top_n", "int", default=300, low=1,
+          doc="static proposal count emitted"),
+        P("threshold", "float", default=0.7, low=0.0, high=1.0,
+          doc="NMS IoU threshold"),
+        P("rpn_min_size", "int", default=16, low=0,
+          doc="min box side in image pixels"),
+        P("scales", "tuple_of_float", default=(4, 8, 16, 32),
+          doc="anchor scales (feature-stride units)"),
+        P("ratios", "tuple_of_float", default=(0.5, 1, 2),
+          doc="anchor aspect ratios"),
+        P("feature_stride", "int", default=16, doc="input stride of the map"),
+        P("output_score", "bool", default=False, doc="also return scores"),
+    )
+    attach(
+        "_contrib_flash_attention",
+        P("causal", "bool", default=False, doc="causal mask"),
+        P("sm_scale", "float", default=None, doc="softmax scale (None: 1/sqrt(D))"),
+        P("block_q", "int", default=128, low=8, doc="query tile"),
+        P("block_k", "int", default=128, low=8, doc="key tile"),
+    )
+    attach(
+        "Embedding",
+        P("input_dim", "int", required=True, low=1, doc="vocabulary size"),
+        P("output_dim", "int", required=True, low=1, doc="embedding width"),
+    )
+    attach(
+        "linear_cross_entropy",
+        P("block_size", "int", default=8192, low=256, doc="vocab tile"),
+        P("ignore_label", "int", default=None, doc="label id with zero loss"),
+    )
+
+
+_install_builtin_schemas()

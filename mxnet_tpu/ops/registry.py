@@ -47,6 +47,8 @@ class Operator:
         "differentiable",
         "mutates_input",
         "aliases",
+        "param_schema",  # typed op-param declarations (ops.params)
+        "self_recording",  # fn manages its own autograd tape entry
     )
 
     def __init__(
@@ -65,6 +67,8 @@ class Operator:
         self.differentiable = differentiable
         self.mutates_input = mutates_input
         self.aliases: List[str] = []
+        self.param_schema = None
+        self.self_recording = False
 
     def __repr__(self):
         return f"<Operator {self.name}>"
@@ -82,6 +86,7 @@ def register(
     namespaces: Sequence[str] = ("nd",),
     differentiable: bool = True,
     mutates_input: Optional[int] = None,
+    self_recording: bool = False,
 ):
     """Decorator registering a pure jax-level function as a framework op."""
 
@@ -97,6 +102,7 @@ def register(
             differentiable=differentiable,
             mutates_input=mutates_input,
         )
+        op.self_recording = self_recording
         _REGISTRY[opname] = op
         for a in aliases:
             alias(a, opname)

@@ -21,9 +21,46 @@ def main():
     import mxnet_tpu as mx
     from mxnet_tpu import nd
 
+    mode = os.environ.get("DIST_TEST_MODE", "basic")
     kv = mx.kv.create("dist_sync")
     rank, nworkers = kv.rank, kv.num_workers
     assert nworkers >= 2, f"expected >=2 workers, got {nworkers}"
+
+    if mode == "crash":
+        # worker 1 dies mid-job; the launcher must propagate the failure
+        # and terminate the others rather than leave them hung
+        kv.init("0", nd.zeros((2,)))
+        if rank == 1:
+            print("worker 1: simulating crash")
+            os._exit(17)
+        import time as _t
+        _t.sleep(30)  # would hang forever without launcher propagation
+        return 0
+
+    if mode == "full":
+        # compression + updater-on-store over dist_sync (the reference's
+        # nightly dist_sync_kvstore coverage at 4 workers)
+        from mxnet_tpu import optimizer as opt
+
+        kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+        kv.set_optimizer(opt.SGD(learning_rate=0.5))
+        kv.init("w", nd.ones((6, 2)))
+        for step in range(3):
+            kv.push("w", nd.ones((6, 2)))  # grad 1 (above threshold)
+            out = nd.zeros((6, 2))
+            kv.pull("w", out=out)
+        # updater-on-store arithmetic is fully deterministic here: the
+        # 2-bit compressor quantizes grad 1.0 (>= threshold 0.5) to +0.5
+        # per worker, the store sums nworkers * 0.5 = 2.0 and applies
+        # w <- w - lr * 2.0 per step: 1 - 3 * 0.5 * 2 = -2 after 3 steps.
+        # Every worker asserting the exact value IS the cross-worker
+        # agreement check (a plain push/pull comparison would itself go
+        # through the updater).
+        expect_w = 1.0 - 3 * 0.5 * (0.5 * nworkers)
+        np.testing.assert_allclose(out.asnumpy(),
+                                   np.full((6, 2), expect_w), rtol=1e-5)
+        print(f"worker {rank}/{nworkers}: full-mode dist kvstore OK")
+        return 0
 
     # init must be identical on all workers (reference requirement)
     kv.init("0", nd.zeros((4, 3)))

@@ -1,0 +1,130 @@
+"""Extension ABI v2 (shape/dtype inference, multi-output, params) and the
+pure-Python CustomOp path (reference ``lib_api.h`` v2 surface +
+``custom.cc`` [unverified])."""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "examples",
+                    "extensions", "custom_ops_v2.cc")
+
+
+@pytest.fixture(scope="module")
+def v2_lib(tmp_path_factory):
+    so = str(tmp_path_factory.mktemp("ext") / "libcustom_v2.so")
+    try:
+        subprocess.run(["g++", "-O2", "-shared", "-fPIC", "-o", so, _SRC],
+                       check=True, capture_output=True, timeout=120)
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"no C++ toolchain: {e}")
+    if "scaled_rowsum" not in [n for n in dir(nd)]:
+        mx.library.load(so, verbose=False)
+    return so
+
+
+class TestAbiV2:
+    def test_shape_inference_and_param(self, v2_lib):
+        x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+        out = nd.scaled_rowsum(x, alpha=2.0)
+        assert out.shape == (3,)  # (N, D) -> (N,): NOT elementwise
+        np.testing.assert_allclose(
+            out.asnumpy(), 2.0 * np.arange(12).reshape(3, 4).sum(1)
+        )
+
+    def test_default_param(self, v2_lib):
+        x = nd.ones((2, 5))
+        np.testing.assert_allclose(nd.scaled_rowsum(x).asnumpy(), [5., 5.])
+
+    def test_multi_output_int_dtype(self, v2_lib):
+        x = nd.array(np.array([7, -3, 12, 0], np.int32), dtype="int32")
+        mn, mx_ = nd.minmax_i32(x)
+        assert mn.asnumpy()[0] == -3
+        assert mx_.asnumpy()[0] == 12
+        assert mn.dtype == np.int32
+
+    def test_backward_through_tape(self, v2_lib):
+        x = nd.array(np.ones((2, 3), np.float32))
+        x.attach_grad()
+        with autograd.record():
+            y = nd.scaled_rowsum(x, alpha=3.0)
+            loss = (y * nd.array(np.array([1.0, 2.0]))).sum()
+        loss.backward()
+        want = np.repeat(np.array([[3.0], [6.0]]), 3, axis=1)
+        np.testing.assert_allclose(x.grad.asnumpy(), want)
+
+
+class TestPythonCustomOp:
+    @classmethod
+    def setup_class(cls):
+        if "sigmoid2x" in mx.operator.get_all_registered():
+            return
+
+        @mx.operator.register("sigmoid2x")
+        class Sigmoid2xProp(mx.operator.CustomOpProp):
+            def create_operator(self, ctx, shapes, dtypes):
+                return _Sigmoid2x()
+
+        class _Sigmoid2x(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                y = 2.0 / (1.0 + np.exp(-in_data[0].asnumpy()))
+                self.assign(out_data[0], req[0], nd.array(y))
+
+            def backward(self, req, out_grad, in_data, out_data, in_grad,
+                         aux):
+                y = out_data[0].asnumpy() / 2.0
+                g = out_grad[0].asnumpy() * 2.0 * y * (1.0 - y)
+                self.assign(in_grad[0], req[0], nd.array(g))
+
+    def test_forward_both_entry_points(self):
+        x = nd.array(np.zeros((2, 2), np.float32))
+        np.testing.assert_allclose(nd.sigmoid2x(x).asnumpy(), 1.0)
+        np.testing.assert_allclose(
+            nd.Custom(x, op_type="sigmoid2x").asnumpy(), 1.0
+        )
+
+    def test_backward(self):
+        rng = np.random.RandomState(0)
+        x = nd.array(rng.randn(3, 4).astype(np.float32))
+        x.attach_grad()
+        with autograd.record():
+            y = nd.sigmoid2x(x)
+            loss = y.sum()
+        loss.backward()
+        s = 1.0 / (1.0 + np.exp(-x.asnumpy()))
+        np.testing.assert_allclose(x.grad.asnumpy(), 2 * s * (1 - s),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_unknown_op_type_raises(self):
+        with pytest.raises(mx.MXNetError, match="unknown op_type"):
+            nd.Custom(nd.zeros((1,)), op_type="nope")
+
+    def test_multi_output_prop(self):
+        if "split_halves" not in mx.operator.get_all_registered():
+            @mx.operator.register("split_halves")
+            class SplitProp(mx.operator.CustomOpProp):
+                def list_outputs(self):
+                    return ["lo", "hi"]
+
+                def infer_shape(self, in_shape):
+                    n = in_shape[0][0] // 2
+                    return in_shape, [[n], [n]], []
+
+                def create_operator(self, ctx, shapes, dtypes):
+                    return _Split()
+
+            class _Split(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    a = in_data[0].asnumpy()
+                    n = len(a) // 2
+                    self.assign(out_data[0], req[0], nd.array(a[:n]))
+                    self.assign(out_data[1], req[1], nd.array(a[n:]))
+
+        lo, hi = nd.split_halves(nd.array(np.arange(6, dtype=np.float32)))
+        np.testing.assert_allclose(lo.asnumpy(), [0, 1, 2])
+        np.testing.assert_allclose(hi.asnumpy(), [3, 4, 5])

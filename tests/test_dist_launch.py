@@ -35,3 +35,42 @@ def test_free_port_is_bindable():
     port = launch.find_free_port()
     with socket.socket() as s:
         s.bind(("localhost", port))
+
+
+def test_four_process_compression_and_updater():
+    """4 workers, 2-bit compression + updater-on-store over dist_sync —
+    the reference's nightly dist_sync_kvstore pattern at 4 ranks."""
+    env = dict(os.environ, DIST_TEST_MODE="full")
+    rc = _launch_with_env(4, [sys.executable, _WORKER], env)
+    assert rc == 0
+
+
+def test_worker_crash_propagates():
+    """A dying worker must fail the whole job quickly (launcher kills the
+    survivors) — not leave them hung in a never-completing collective."""
+    import time
+
+    env = dict(os.environ, DIST_TEST_MODE="crash")
+    t0 = time.time()
+    rc = _launch_with_env(2, [sys.executable, _WORKER], env)
+    took = time.time() - t0
+    assert rc == 17, f"crash exit code not propagated: {rc}"
+    # the surviving worker sleeps 30s; propagation must beat that
+    assert took < 28, f"propagation too slow: {took:.1f}s"
+
+
+def _launch_with_env(n, command, env):
+    """launch_local with a custom base environment for the workers."""
+    import unittest.mock as mock
+
+    def patched_env(coordinator, num_procs, proc_id):
+        e = dict(env)
+        e.update({
+            "MXNET_TPU_COORDINATOR": coordinator,
+            "MXNET_TPU_NUM_PROCS": str(num_procs),
+            "MXNET_TPU_PROC_ID": str(proc_id),
+        })
+        return e
+
+    with mock.patch.object(launch, "worker_env", patched_env):
+        return launch.launch_local(n, command, timeout=240)

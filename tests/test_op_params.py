@@ -1,0 +1,79 @@
+"""Typed op-param schemas (the dmlc::Parameter analogue, ops/params.py)."""
+
+import json
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ops import (P, describe_op, list_documented_ops, op_params,
+                           register, schema_to_json, validate_params)
+
+
+def test_builtin_ops_documented():
+    docs = list_documented_ops()
+    for name in ("Convolution", "Pooling", "BatchNorm", "Dropout",
+                 "_contrib_box_nms", "_contrib_Proposal"):
+        assert name in docs, name
+
+
+def test_describe_and_json_roundtrip():
+    d = describe_op("Convolution")
+    names = [p["name"] for p in d["params"]]
+    assert "kernel" in names and "num_filter" in names
+    j = json.loads(schema_to_json("Pooling"))
+    pool_type = next(p for p in j["params"] if p["name"] == "pool_type")
+    assert pool_type["choices"] == ["max", "avg", "sum", "lp"]
+    assert pool_type["default"] == "max"
+
+
+def test_docstring_gained_parameter_section():
+    from mxnet_tpu.ops.registry import get
+
+    doc = get("Convolution").fn.__doc__
+    assert "Op Parameters" in doc
+    assert "num_filter : int, required" in doc
+
+
+def test_validate_coerces_string_attrs():
+    # symbol-JSON attrs arrive as strings; validation must type them
+    out = validate_params("Convolution", {
+        "kernel": [3, 3], "num_filter": "16", "no_bias": "True",
+        "stride": 2,
+    })
+    assert out["num_filter"] == 16
+    assert out["no_bias"] is True
+    assert out["stride"] == (2,)
+
+
+def test_validate_rejects_bad_values():
+    with pytest.raises(ValueError, match="below minimum"):
+        validate_params("Convolution", {"kernel": (1, 1), "num_filter": 0})
+    with pytest.raises(ValueError, match="not in"):
+        validate_params("Pooling", {"pool_type": "median"})
+    with pytest.raises(ValueError, match="missing required"):
+        validate_params("Convolution", {"stride": 1})
+    with pytest.raises(ValueError, match="unknown param"):
+        validate_params("Pooling", {"bogus": 1}, allow_unknown=False)
+
+
+def test_custom_op_schema_via_decorator():
+    @op_params(
+        P("alpha", "float", default=1.0, low=0.0, doc="scale factor"),
+    )
+    @register("_test_scaled_copy", namespaces=())
+    def _test_scaled_copy(data, alpha=1.0, **kw):
+        """Test op."""
+        return data * alpha
+
+    d = describe_op("_test_scaled_copy")
+    assert d["params"][0]["name"] == "alpha"
+    assert validate_params("_test_scaled_copy", {"alpha": "2.5"}) == \
+        {"alpha": 2.5}
+
+
+def test_env_registry_lists_consulted_vars():
+    from mxnet_tpu.base import env_str, list_env_registry
+
+    env_str("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+    reg = list_env_registry()
+    assert "MXNET_ENGINE_TYPE" in reg

@@ -124,3 +124,29 @@ class TestRowSparsePull:
                                    [[2., 3.], [8., 9.]])
         dense = out.asnumpy()
         assert dense[0].sum() == 0 and dense[2].sum() == 0
+
+
+def test_weight_used_twice_accumulates():
+    # two applications of the same sparse-grad embedding in ONE recorded
+    # graph must SUM their gradients (write semantics reset per step, not
+    # per apply) — regression for the overwrite bug
+    emb = gluon.nn.Embedding(10, 4, sparse_grad=True)
+    emb.initialize()
+    x1 = nd.array(np.array([[1, 2]]), dtype="int32")
+    x2 = nd.array(np.array([[2, 3]]), dtype="int32")
+    with autograd.record():
+        loss = emb(x1).sum() + emb(x2).sum()
+    loss.backward()
+    g = emb.weight.grad()
+    assert isinstance(g, RowSparseNDArray)
+    dense = g.asnumpy()
+    np.testing.assert_allclose(dense[1], 1.0)
+    np.testing.assert_allclose(dense[2], 2.0)  # appears in both uses
+    np.testing.assert_allclose(dense[3], 1.0)
+    # next step's forward drops the stale grad (write semantics)
+    with autograd.record():
+        loss = emb(x1).sum()
+    loss.backward()
+    dense2 = emb.weight.grad().asnumpy()
+    np.testing.assert_allclose(dense2[3], 0.0)
+    np.testing.assert_allclose(dense2[1], 1.0)

@@ -32,6 +32,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 
 
 def find_free_port() -> int:
@@ -58,8 +59,15 @@ def _pump(proc: subprocess.Popen, tag: str):
         sys.stdout.flush()
 
 
-def launch_local(num_procs: int, command, coordinator: str | None = None):
-    """Spawn ``command`` num_procs times locally; returns max exit code."""
+def launch_local(num_procs: int, command, coordinator: str | None = None,
+                 timeout: float | None = None):
+    """Spawn ``command`` num_procs times locally; returns max exit code.
+
+    Failure PROPAGATES: when any worker exits nonzero (or dies on a
+    signal), the remaining workers are terminated instead of being left
+    hung in a collective that will never complete — the reference's
+    tracker killed the job the same way. ``timeout`` (seconds) bounds the
+    whole job; expiry kills all workers and returns 124."""
     coordinator = coordinator or f"localhost:{find_free_port()}"
     procs = []
     pumps = []
@@ -74,10 +82,45 @@ def launch_local(num_procs: int, command, coordinator: str | None = None):
         t.start()
         procs.append(p)
         pumps.append(t)
-    rc = 0
-    try:
+
+    def _kill_all():
         for p in procs:
-            rc = max(rc, p.wait())
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + 5
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    pass
+        # SIGKILL anything that survived the grace period — a worker
+        # ignoring SIGTERM inside a collective must not outlive the job
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+
+    rc = 0
+    start = time.time()
+    try:
+        live = set(range(num_procs))
+        while live:
+            if timeout is not None and time.time() - start > timeout:
+                print(f"launch: job timed out after {timeout}s; killing "
+                      f"workers {sorted(live)}")
+                _kill_all()
+                return 124
+            for pid in sorted(live):
+                code = procs[pid].poll()
+                if code is None:
+                    continue
+                live.discard(pid)
+                if code != 0:
+                    print(f"launch: worker-{pid} exited with {code}; "
+                          f"terminating remaining workers {sorted(live)}")
+                    _kill_all()
+                    return code
+            time.sleep(0.05)
     except KeyboardInterrupt:
         for p in procs:
             p.send_signal(signal.SIGTERM)
