@@ -19,11 +19,17 @@ import time
 import numpy as np
 
 
-def _inputs(shapes, dtype=np.float32, seed=0):
+def _inputs(shapes, dtype=np.float32, seed=0, int_slots=()):
     rng = np.random.RandomState(seed)
     import jax.numpy as jnp
 
-    return [jnp.asarray(rng.rand(*s).astype(dtype) + 0.1) for s in shapes]
+    out = []
+    for i, s in enumerate(shapes):
+        if i in int_slots:
+            out.append(jnp.asarray(rng.randint(0, 64, s), jnp.int32))
+        else:
+            out.append(jnp.asarray(rng.rand(*s).astype(dtype) + 0.1))
+    return out
 
 
 # op name -> (input shapes, static params)
@@ -65,10 +71,70 @@ DEFAULT_SPECS = {
     "_contrib_box_nms": ([(1, 128, 6)], {}),
     "_contrib_ROIAlign": ([(1, 32, 32, 32), (8, 5)],
                           {"pooled_size": (7, 7), "spatial_scale": 1.0}),
+    # trig / rounding / power unary family
+    "sin": ([(256, 256)], {}),
+    "cos": ([(256, 256)], {}),
+    "tanh": ([(256, 256)], {}),
+    "erf": ([(256, 256)], {}),
+    "abs": ([(256, 256)], {}),
+    "floor": ([(256, 256)], {}),
+    "round": ([(256, 256)], {}),
+    "square": ([(256, 256)], {}),
+    "rsqrt": ([(256, 256)], {}),
+    "reciprocal": ([(256, 256)], {}),
+    # binary / comparison broadcasting
+    "broadcast_sub": ([(256, 256), (1, 256)], {}),
+    "broadcast_div": ([(256, 256), (1, 256)], {}),
+    "broadcast_maximum": ([(256, 256), (1, 256)], {}),
+    "broadcast_power": ([(256, 256), (1, 256)], {}),
+    "broadcast_greater": ([(256, 256), (1, 256)], {}),
+    "broadcast_equal": ([(256, 256), (256, 256)], {}),
+    # reductions with axes / norms
+    "prod": ([(256, 256)], {"axis": 1}),
+    "min": ([(256, 256)], {"axis": 0}),
+    "argmax": ([(256, 256)], {"axis": 1}),
+    "argmin": ([(256, 256)], {"axis": 1}),
+    "norm": ([(256, 256)], {}),
+    "L2Normalization": ([(64, 512)], {}),
+    # sorting / indexing / gather-scatter
+    "sort": ([(64, 1024)], {}),
+    "argsort": ([(64, 1024)], {}),
+    "topk": ([(64, 1024)], {"k": 16}),
+    "take": ([(1024, 64), (256,)], {}),
+    "one_hot": ([(4096,)], {"depth": 128}),
+    "where": ([(256, 256), (256, 256), (256, 256)], {}),
+    "clip": ([(256, 256)], {"a_min": 0.2, "a_max": 0.8}),
+    "tile": ([(64, 64)], {"reps": (2, 4)}),
+    "repeat": ([(64, 64)], {"repeats": 4, "axis": 1}),
+    "expand_dims": ([(256, 256)], {"axis": 1}),
+    "slice": ([(256, 256)], {"begin": (32, 32), "end": (224, 224)}),
+    "flip": ([(256, 256)], {"axis": 1}),
+    # NN extras
+    "Embedding": ([(64, 32), (8192, 128)],
+                  {"input_dim": 8192, "output_dim": 128}),
+    "SoftmaxOutput": ([(128, 1000), (128,)], {}),
+    "LeakyReLU": ([(256, 256)], {"act_type": "leaky"}),
+    "Deconvolution": ([(8, 16, 16, 16), (16, 8, 2, 2)],
+                      {"kernel": (2, 2), "stride": (2, 2), "num_filter": 8,
+                       "num_group": 1}),
+    "_contrib_DeformableConvolution": (
+        [(2, 8, 16, 16), (2, 18, 16, 16), (8, 8, 3, 3)],
+        {"kernel": (3, 3), "pad": (1, 1), "num_filter": 8, "no_bias": True}),
+    "_contrib_flash_attention": ([(2, 4, 512, 64)] * 3, {}),
+    "_contrib_AdaptiveAvgPooling2D": ([(8, 16, 32, 32)],
+                                      {"output_size": 7}),
+    "linear_cross_entropy": ([(512, 128), (8192, 128), (512,)], {}),
+    # fused optimizer updates
+    "sgd_update": ([(1024, 1024), (1024, 1024)], {"lr": 0.1}),
+    "adam_update": ([(1024, 1024)] * 4, {"lr": 0.1}),
 }
 
+# ops whose extra inputs must be integer (index) arrays
+_INT_INPUT = {"take": [1], "Embedding": [0], "SoftmaxOutput": [1],
+              "linear_cross_entropy": [2]}
 
-def bench_op(name, shapes, params, warmup=2, runs=20):
+
+def bench_op(name, shapes, params, warmup=2, runs=20, dtype=np.float32):
     import jax
 
     from mxnet_tpu.ops import registry
@@ -76,7 +142,9 @@ def bench_op(name, shapes, params, warmup=2, runs=20):
     op = registry.maybe_get(name)
     if op is None:
         return None
-    args = _inputs(shapes)
+    # linear_cross_entropy takes labels as arg 2 with small vocab index
+    args = _inputs(shapes, dtype=dtype,
+                   int_slots=_INT_INPUT.get(name, ()))
     import functools
 
     fn = functools.partial(op.fn, **params) if params else op.fn
@@ -110,27 +178,50 @@ def bench_op(name, shapes, params, warmup=2, runs=20):
         jit_us = (time.perf_counter() - t0) / runs * 1e6
     except Exception as e:  # noqa: BLE001
         jit_us = None
-    return {"op": name, "eager_us": round(eager_us, 1),
+    return {"op": name, "dtype": np.dtype(dtype).name,
+            "eager_us": round(eager_us, 1),
             "jit_us": round(jit_us, 1) if jit_us is not None else None}
 
 
-def run(ops=None, warmup=2, runs=20):
+def run(ops=None, warmup=2, runs=20, dtypes=("float32",)):
     specs = DEFAULT_SPECS if not ops else {
         k: v for k, v in DEFAULT_SPECS.items()
         if k in ops or k.removeprefix("_contrib_") in ops
     }
+    import jax.numpy as jnp
+
     rows = []
     for name, (shapes, params) in specs.items():
-        row = bench_op(name, shapes, params, warmup, runs)
-        if row is None:
-            continue
-        rows.append(row)
-        if "error" in row:
-            print(f"{name:24s} ERROR {row['error']}")
-        else:
-            j = f"{row['jit_us']:10.1f}" if row["jit_us"] is not None else "       n/a"
-            print(f"{name:24s} eager {row['eager_us']:10.1f} us   jit {j} us")
+        for dt in dtypes:
+            dtype = jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt)
+            row = bench_op(name, shapes, params, warmup, runs, dtype=dtype)
+            if row is None:
+                continue
+            rows.append(row)
+            if "error" in row:
+                print(f"{name:28s} [{dt:8s}] ERROR {row['error']}")
+            else:
+                j = f"{row['jit_us']:10.1f}"                     if row["jit_us"] is not None else "       n/a"
+                print(f"{name:28s} [{dt:8s}] eager "
+                      f"{row['eager_us']:10.1f} us   jit {j} us")
     return rows
+
+
+def write_markdown(rows, path):
+    """Markdown report (the reference harness wrote one per category)."""
+    lines = ["# opperf report", "",
+             "| op | dtype | eager (us) | jit (us) |", "|---|---|---|---|"]
+    for r in rows:
+        if "error" in r:
+            lines.append(f"| {r['op']} | — | ERROR | {r['error']} |")
+        else:
+            j = r["jit_us"] if r["jit_us"] is not None else "n/a"
+            lines.append(
+                f"| {r['op']} | {r.get('dtype', 'float32')} | "
+                f"{r['eager_us']} | {j} |"
+            )
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
 
 
 def main():
@@ -138,12 +229,18 @@ def main():
     ap.add_argument("--ops", nargs="*", default=None)
     ap.add_argument("--runs", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--dtypes", nargs="*", default=["float32"],
+                    help="e.g. --dtypes float32 bfloat16")
     ap.add_argument("--json", action="store_true",
                     help="print one JSON line with all rows")
+    ap.add_argument("--md", default=None,
+                    help="write a markdown report to this path")
     args = ap.parse_args()
-    rows = run(args.ops, args.warmup, args.runs)
+    rows = run(args.ops, args.warmup, args.runs, tuple(args.dtypes))
     if args.json:
         print(json.dumps({"opperf": rows}))
+    if args.md:
+        write_markdown(rows, args.md)
 
 
 if __name__ == "__main__":

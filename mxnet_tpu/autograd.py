@@ -164,6 +164,10 @@ def _mark_output(nd: NDArray, node: _Node, index: int):
 
 
 # ----------------------------------------------------------------- backward
+_BACKWARD_EPOCH = [0]  # bumped per traversal; custom self-recording
+# gradient writers (sparse embedding) use it for 'write' reset semantics
+
+
 def backward(
     heads: Sequence[NDArray],
     head_grads: Optional[Sequence[Optional[NDArray]]] = None,
@@ -171,6 +175,7 @@ def backward(
     train_mode: bool = True,
 ):
     """Reverse pass from ``heads`` (reference: ``Imperative::Backward``)."""
+    _BACKWARD_EPOCH[0] += 1
     heads = list(heads)
     if head_grads is None:
         head_grads = [None] * len(heads)

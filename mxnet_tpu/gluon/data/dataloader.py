@@ -68,6 +68,21 @@ def _np_batchify(data):
     return _np.asarray(data)
 
 
+def _assert_no_device(tree):
+    """A forked worker's batch must be device-free — custom batchify_fns
+    returning NDArrays would otherwise pickle device arrays through the
+    inherited TPU session (the corruption the default path refuses)."""
+    if isinstance(tree, NDArray):
+        raise TypeError(
+            "batchify_fn returned device NDArrays inside a forked "
+            "DataLoader worker; return numpy/python values (the parent "
+            "converts to device arrays), or use thread_pool=True"
+        )
+    if isinstance(tree, (list, tuple)):
+        for t in tree:
+            _assert_no_device(t)
+
+
 def _to_device(batch):
     if isinstance(batch, list):
         return [_to_device(b) for b in batch]
@@ -123,6 +138,7 @@ def _worker_loop(dataset, index_q, data_q, seed, batchify_fn):
         bid, indices = job
         try:
             batch = batchify([dataset[i] for i in indices])
+            _assert_no_device(batch)
             spec, shms = _pack(batch)
             data_q.put((bid, "ok", spec))
             for s in shms:
@@ -293,15 +309,23 @@ class DataLoader:
         pool = getattr(self, "_mp_pool", None)
         if pool is None:
             return
-        workers, index_q, _ = pool
+        workers, index_q, data_q = pool
         for _w in workers:
             try:
                 index_q.put(None)
             except Exception:  # noqa: BLE001 - interpreter shutdown
                 pass
         for p in workers:
+            p.join(timeout=0.5)
             if p.is_alive():
                 p.terminate()
+        # unlink any results still queued — their segments were already
+        # deregistered from the workers' resource trackers, so nobody
+        # else will ever free them
+        try:
+            self._drain_stale(data_q)
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
 
     @staticmethod
     def _discard(spec):
@@ -337,22 +361,19 @@ class DataLoader:
         ahead = min(len(batches), self._num_workers + self._prefetch)
         for i in range(ahead):
             index_q.put((base + i, batches[i]))
-        next_submit = ahead
-        pending = {}
-        import time as _time
-
         try:
-            yield from self._mp_consume(
-                workers, index_q, data_q, batches, base, ahead, pending,
-                _time)
+            yield from self._mp_consume(workers, index_q, data_q, batches,
+                                        base, ahead)
         finally:
             # abandoned mid-epoch (break/exception): results already on
             # the queue would leak their shm segments; reap them now (a
             # worker still computing is reaped by the next epoch's drain)
             self._drain_stale(data_q)
 
-    def _mp_consume(self, workers, index_q, data_q, batches, base, ahead,
-                    pending, _time):
+    def _mp_consume(self, workers, index_q, data_q, batches, base, ahead):
+        import time as _time
+
+        pending = {}
         next_submit = ahead
         for want_i in range(len(batches)):
             want = base + want_i

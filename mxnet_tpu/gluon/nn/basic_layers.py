@@ -188,12 +188,6 @@ def _sparse_embedding_apply(x, weight_param, input_dim, output_dim):
     import numpy as _np2
 
     weight_nd = weight_param.data()
-    # 'write' semantics reset at APPLY time (all applies of one recorded
-    # graph run before any backward), so multiple uses of the same weight
-    # in one graph ACCUMULATE in the backward — matching the dense tape —
-    # while the next iteration's forward drops the stale gradient
-    if weight_param.grad_req == "write":
-        weight_nd._grad = None
 
     class _Apply(autograd.Function):
         def forward(self, x_nd, w_nd):
@@ -206,9 +200,22 @@ def _sparse_embedding_apply(x, weight_param, input_dim, output_dim):
             g = RowSparseNDArray.from_pair(
                 ids, vals, (input_dim, output_dim)
             )
-            if isinstance(weight_nd._grad, RowSparseNDArray) and \
-                    weight_nd._grad._pair:
-                g = weight_nd._grad + g
+            # 'write' semantics reset PER BACKWARD TRAVERSAL (epoch stamp
+            # bumped by autograd.backward): contributions from multiple
+            # uses of this weight inside one traversal accumulate, a new
+            # traversal overwrites, and a recorded forward whose backward
+            # never runs cannot destroy a pending gradient
+            epoch = autograd._BACKWARD_EPOCH[0]
+            prev = weight_nd._grad
+            same_pass = (
+                isinstance(prev, RowSparseNDArray) and prev._pair
+                and getattr(prev, "_rs_epoch", None) == epoch
+            )
+            accumulate = same_pass or weight_param.grad_req == "add"
+            if accumulate and isinstance(prev, RowSparseNDArray) \
+                    and prev._pair:
+                g = prev + g
+            g._rs_epoch = epoch
             weight_nd._grad = g
             # float0 cotangents: the tape must NOT accumulate a dense
             # gradient for the weight (that's the whole point) — the

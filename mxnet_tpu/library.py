@@ -245,8 +245,7 @@ def _make_v2_backward(lib, op_id, nin, nout):
             in_dtypes[i] = _dtype_code(a.dtype)
             for d, s in enumerate(a.shape):
                 in_shapes[i * _MAX_NDIM + d] = s
-        grads = [_np.zeros_like(a) if a.dtype.kind == "f"
-                 else _np.zeros_like(a) for a in ins]
+        grads = [_np.zeros_like(a) for a in ins]
         og_ptrs = (ctypes.c_void_p * nout)(
             *[g.ctypes.data_as(ctypes.c_void_p) for g in ogs])
         in_ptrs = (ctypes.c_void_p * nin)(

@@ -299,3 +299,78 @@ def test_softmax_output_ignore_label():
     g = data.grad.asnumpy()
     assert np.allclose(g[2], 0.0)
     assert not np.allclose(g[0], 0.0)
+
+
+class TestDeformableConvolution:
+    def test_zero_offset_matches_standard_conv(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 3, 8, 8).astype(np.float32)
+        w = rng.randn(5, 3, 3, 3).astype(np.float32)
+        off = np.zeros((2, 2 * 9, 8, 8), np.float32)
+        out_d = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+            pad=(1, 1), num_filter=5, no_bias=True)
+        out_c = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                               pad=(1, 1), num_filter=5, no_bias=True)
+        assert_close(out_d, out_c.asnumpy(), rtol=1e-4)
+
+    def test_integer_shift_offset(self):
+        # constant (dy=0, dx=1) offset == convolving the left-shifted image
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, 2, 6, 6).astype(np.float32)
+        w = rng.randn(4, 2, 3, 3).astype(np.float32)
+        off = np.zeros((1, 18, 6, 6), np.float32)
+        off[:, 1::2] = 1.0  # dx for every tap
+        out_d = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+            pad=(1, 1), num_filter=4, no_bias=True)
+        x_shift = np.zeros_like(x)
+        x_shift[..., :-1] = x[..., 1:]  # shift left, zero-pad right edge
+        out_c = nd.Convolution(nd.array(x_shift), nd.array(w), kernel=(3, 3),
+                               pad=(1, 1), num_filter=4, no_bias=True)
+        # interior columns match exactly; both boundaries differ (the
+        # deformed sample stays in-bounds where the shifted image hits
+        # conv zero-padding), so compare away from them
+        assert_close(out_d.asnumpy()[..., 1:-2], out_c.asnumpy()[..., 1:-2],
+                     rtol=1e-4)
+
+    def test_gradients_flow_to_offsets(self):
+        rng = np.random.RandomState(2)
+        x = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+        w = nd.array(rng.randn(3, 2, 3, 3).astype(np.float32))
+        off = nd.array((rng.rand(1, 18, 5, 5) * 0.3).astype(np.float32))
+        for v in (x, w, off):
+            v.attach_grad()
+        with autograd.record():
+            out = nd.DeformableConvolution(x, off, w, kernel=(3, 3),
+                                           pad=(1, 1), num_filter=3,
+                                           no_bias=True)
+            loss = (out * out).sum()
+        loss.backward()
+        for v, name in ((x, "data"), (w, "weight"), (off, "offset")):
+            g = v.grad.asnumpy()
+            assert np.isfinite(g).all(), name
+            assert np.abs(g).sum() > 0, f"no gradient reached {name}"
+
+    def test_stride_and_deformable_groups(self):
+        rng = np.random.RandomState(3)
+        x = rng.randn(1, 4, 9, 9).astype(np.float32)
+        w = rng.randn(2, 4, 3, 3).astype(np.float32)
+        off = np.zeros((1, 2 * 2 * 9, 5, 5), np.float32)  # G=2, (Ho, Wo)
+        out = nd.DeformableConvolution(
+            nd.array(x), nd.array(off), nd.array(w), kernel=(3, 3),
+            stride=(2, 2), pad=(1, 1), num_filter=2,
+            num_deformable_group=2, no_bias=True)
+        ref = nd.Convolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                             stride=(2, 2), pad=(1, 1), num_filter=2,
+                             no_bias=True)
+        assert out.shape == (1, 2, 5, 5)
+        assert_close(out, ref.asnumpy(), rtol=1e-4)
+        # offset map at input resolution must be rejected (stride
+        # misalignment would otherwise be silent)
+        bad = np.zeros((1, 2 * 2 * 9, 9, 9), np.float32)
+        with pytest.raises(ValueError, match="OUTPUT spatial"):
+            nd.DeformableConvolution(
+                nd.array(x), nd.array(bad), nd.array(w), kernel=(3, 3),
+                stride=(2, 2), pad=(1, 1), num_filter=2,
+                num_deformable_group=2, no_bias=True)
