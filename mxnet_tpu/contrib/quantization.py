@@ -27,13 +27,27 @@ from ..base import MXNetError
 from ..ops.registry import register, maybe_get
 
 __all__ = ["quantize_v2", "dequantize", "quantize_net", "QuantizedDense",
-           "calib_ranges"]
+           "QuantizedConv2D", "calib_ranges", "entropy_threshold"]
 
 
 def _scale_from_range(min_val, max_val):
     # symmetric per-tensor: scale maps [-amax, amax] -> [-127, 127]
     amax = jnp.maximum(jnp.abs(min_val), jnp.abs(max_val))
     return jnp.maximum(amax, 1e-8) / 127.0
+
+
+def _quantize_symmetric(arr):
+    """Per-tensor symmetric int8: (q, scale). The ONE place the epsilon
+    and clip bounds live — Dense and Conv paths share it."""
+    scale = float(_np.asarray(
+        jnp.maximum(jnp.abs(arr).max(), 1e-8) / 127.0
+    ))
+    q = jnp.clip(jnp.round(arr / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _quantize_act(x, scale):
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
 
 
 if maybe_get("_contrib_quantize_v2") is None:
@@ -87,12 +101,8 @@ class QuantizedDense:
         if not isinstance(dense, Dense):
             raise MXNetError("QuantizedDense wraps a gluon Dense layer")
         w = dense.weight.data().data  # (units, in)
-        self._w_scale = float(_np.asarray(
-            jnp.maximum(jnp.abs(w).max(), 1e-8) / 127.0
-        ))
-        self._w_q_t = jnp.clip(
-            jnp.round(w / self._w_scale), -127, 127
-        ).astype(jnp.int8).T  # (in, units)
+        w_q, self._w_scale = _quantize_symmetric(w)
+        self._w_q_t = w_q.T  # (in, units)
         self._bias = dense.bias.data().data if dense.bias is not None else None
         self._act_scale = float(_np.asarray(
             _scale_from_range(jnp.asarray(act_min), jnp.asarray(act_max))
@@ -109,9 +119,7 @@ class QuantizedDense:
                 xd = xd.reshape(shape[0], -1)
             elif xd.ndim > 2:
                 xd = xd.reshape(-1, shape[-1])
-            x_q = jnp.clip(
-                jnp.round(xd / self._act_scale), -127, 127
-            ).astype(jnp.int8)
+            x_q = _quantize_act(xd, self._act_scale)
             out = _int8_matmul(x_q, self._w_q_t, self._act_scale,
                                self._w_scale)
             if self._bias is not None:
@@ -126,11 +134,116 @@ class QuantizedDense:
         return out
 
 
-def calib_ranges(net, calib_data, layers) -> Dict[int, tuple]:
-    """Min/max of each target layer's INPUT over the calibration batches
-    (reference 'naive' calibration). ``layers``: list of Dense blocks."""
+def entropy_threshold(abs_hist, bin_width, num_quantized_bins=255):
+    """KL-divergence-optimal clipping threshold over an |x| histogram
+    (the reference's 'entropy' calibration, ``calibrate.py``'s
+    _get_optimal_threshold [unverified]): for every candidate threshold,
+    compare the clipped reference distribution P with its
+    num_quantized_bins-level quantization Q and keep the argmin."""
+    nbins = len(abs_hist)
+    best_kl, best_t = _np.inf, nbins * bin_width
+    hist = abs_hist.astype(_np.float64)
+    start = max(num_quantized_bins // 2, 32)
+    for i in range(start, nbins + 1, max(1, nbins // 128)):
+        p = hist[:i].copy()
+        p[i - 1] += hist[i:].sum()  # clip outliers into the last bin
+        if p.sum() <= 0:
+            continue
+        # quantize the i bins down to num_quantized_bins levels
+        factor = i / num_quantized_bins
+        q = _np.zeros(i)
+        for j in range(num_quantized_bins):
+            lo = int(_np.floor(j * factor))
+            hi = int(_np.ceil((j + 1) * factor))
+            chunk = hist[lo:hi]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[lo:hi] = _np.where(chunk > 0, chunk.sum() / nz, 0)
+        pn = p / p.sum()
+        qs = q.sum()
+        if qs <= 0:
+            continue
+        qn = q / qs
+        mask = pn > 0
+        kl = float(_np.sum(
+            pn[mask] * _np.log(pn[mask] / _np.maximum(qn[mask], 1e-12))
+        ))
+        if kl < best_kl:
+            best_kl, best_t = kl, i * bin_width
+    return best_t
+
+
+class QuantizedConv2D:
+    """INT8 replacement for a trained ``gluon.nn.Conv2D`` (closes the
+    round-2 gap: quantization now reaches the CV models).
+
+    Per-tensor symmetric weights quantized once; activations per-call at
+    the calibrated static scale; the convolution itself runs
+    int8 x int8 -> int32 through ``lax.conv_general_dilated`` with a wide
+    accumulator (the MXU's native low-precision path), dequantized by the
+    product of scales."""
+
+    def __init__(self, conv, act_min, act_max):
+        from ..gluon.nn.conv_layers import _Conv
+
+        if not isinstance(conv, _Conv):
+            raise MXNetError("QuantizedConv2D wraps a gluon Conv layer")
+        kw = conv._kwargs
+        if kw.get("layout", "NCHW")[-1] == "C":
+            raise MXNetError(
+                "QuantizedConv2D supports channel-first layouts only"
+            )
+        w = conv.weight.data().data  # (O, I/g, kh, kw)
+        self._w_q, self._w_scale = _quantize_symmetric(w)
+        self._bias = conv.bias.data().data if conv.bias is not None else None
+        self._act_scale = float(_np.asarray(
+            _scale_from_range(jnp.asarray(act_min), jnp.asarray(act_max))
+        ))
+        self._kw = dict(kw)
+        self._act = conv.act
+
+    def __call__(self, x):
+        from ..imperative import invoke_fn
+
+        kw = self._kw
+
+        def fwd(xd):
+            x_q = _quantize_act(xd, self._act_scale)
+            nd_sp = x_q.ndim - 2
+            spatial = "DHW"[-nd_sp:]
+            stride = kw.get("stride") or (1,) * nd_sp
+            dilate = kw.get("dilate") or (1,) * nd_sp
+            pad = kw.get("pad") or (0,) * nd_sp
+            out32 = jax.lax.conv_general_dilated(
+                x_q, self._w_q,
+                window_strides=tuple(stride),
+                padding=[(p, p) for p in pad],
+                rhs_dilation=tuple(dilate),
+                dimension_numbers=("NC" + spatial, "OI" + spatial,
+                                   "NC" + spatial),
+                feature_group_count=kw.get("num_group", 1),
+                preferred_element_type=jnp.int32,
+            )
+            out = out32.astype(jnp.float32) * (self._act_scale
+                                               * self._w_scale)
+            if self._bias is not None:
+                out = out + self._bias.reshape((1, -1) + (1,) * nd_sp)
+            return out
+
+        out = invoke_fn(fwd, x)
+        if self._act is not None:
+            out = self._act(out)
+        return out
+
+
+def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
+    """Activation ranges of each target layer's INPUT over the
+    calibration batches. ``mode``: 'naive' (min/max, the reference
+    default) or 'entropy' (KL-optimal symmetric threshold).
+    ``layers``: list of Dense/Conv2D blocks."""
     ranges: Dict[int, List[float]] = {}
-    hooks = []
+    hists: Dict[int, _np.ndarray] = {}
+    NBINS, hooks = 2048, []
 
     def make_hook(key):
         def hook(block, inputs):
@@ -142,6 +255,15 @@ def calib_ranges(net, calib_data, layers) -> Dict[int, tuple]:
                 ranges[key][1] = max(ranges[key][1], hi)
             else:
                 ranges[key] = [lo, hi]
+            if mode == "entropy":
+                amax = max(abs(lo), abs(hi), 1e-8)
+                h, _ = _np.histogram(_np.abs(arr), bins=NBINS,
+                                     range=(0, amax))
+                # keep per-batch (hist, amax) pairs; they are re-binned
+                # to the layer's GLOBAL range at the end — batches with
+                # different dynamic ranges must not be summed bin-wise
+                hists.setdefault(key, []).append(
+                    (h.astype(_np.float64), amax))
 
         return hook
 
@@ -154,36 +276,63 @@ def calib_ranges(net, calib_data, layers) -> Dict[int, tuple]:
     finally:
         for h in hooks:
             h.detach()
-    return {k: tuple(v) for k, v in ranges.items()}
+    if mode == "entropy":
+        out = {}
+        for k, v in ranges.items():
+            parts = hists[k]
+            gmax = max(a for _, a in parts)
+            merged = _np.zeros(NBINS)
+            for h, a in parts:
+                # map each batch bin center onto the global-width grid
+                idx = _np.minimum(
+                    (( _np.arange(NBINS) + 0.5) * (a / gmax)).astype(int),
+                    NBINS - 1,
+                )
+                _np.add.at(merged, idx, h)
+            t = entropy_threshold(merged, gmax / NBINS)
+            out[k] = (-t, t)
+        return out
+    return {k: (v[0], v[1]) for k, v in ranges.items()}
 
 
-def quantize_net(net, calib_data=None, exclude=()):
-    """Replace every calibrated ``Dense`` child with ``QuantizedDense``
-    in-place; returns the rewritten net (reference: ``quantize_model``'s
-    graph rewrite, gluon-style). Runs ``calib_data`` through the net for
-    activation ranges (required)."""
+def quantize_net(net, calib_data=None, exclude=(), calib_mode="naive"):
+    """Replace every calibrated ``Dense``/``Conv2D`` child with its INT8
+    twin in-place; returns the rewritten net (reference:
+    ``quantize_model``'s graph rewrite, gluon-style). Runs ``calib_data``
+    through the net for activation ranges (required);
+    ``calib_mode``: 'naive' min/max or 'entropy' KL-optimal."""
     from ..gluon.nn import Dense
+    from ..gluon.nn.conv_layers import Conv2D
 
-    dense_layers = []
+    target_layers = []
 
     def collect(block):
         for child in block._children.values():
-            if isinstance(child, Dense) and child not in exclude:
-                dense_layers.append(child)
+            if isinstance(child, (Dense, Conv2D)) and child not in exclude:
+                if isinstance(child, Conv2D) and \
+                        child._kwargs.get("layout", "NCHW")[-1] == "C":
+                    pass  # channel-last conv: left in float (unsupported)
+                else:
+                    target_layers.append(child)
             collect(child)
 
     collect(net)
-    if not dense_layers:
-        raise MXNetError("quantize_net: no Dense layers found to quantize")
+    if not target_layers:
+        raise MXNetError("quantize_net: no Dense/Conv2D layers to quantize")
     if calib_data is None:
         raise MXNetError("quantize_net needs calibration data")
-    ranges = calib_ranges(net, calib_data, dense_layers)
+    ranges = calib_ranges(net, calib_data, target_layers, mode=calib_mode)
 
     def rewrite(block):
         for name, child in list(block._children.items()):
-            if isinstance(child, Dense) and id(child) in ranges:
+            if id(child) in ranges and isinstance(child, (Dense, Conv2D)):
                 lo, hi = ranges[id(child)]
-                newb = _QuantizedDenseBlock(QuantizedDense(child, lo, hi))
+                if isinstance(child, Dense):
+                    newb = _QuantizedDenseBlock(
+                        QuantizedDense(child, lo, hi))
+                else:
+                    newb = _QuantizedDenseBlock(
+                        QuantizedConv2D(child, lo, hi))
                 block._children[name] = newb
                 # attribute-style blocks (self.fc = Dense(...)) call the
                 # child through the instance attribute, not _children —

@@ -78,7 +78,10 @@ def _assert_no_device(tree):
             "DataLoader worker; return numpy/python values (the parent "
             "converts to device arrays), or use thread_pool=True"
         )
-    if isinstance(tree, (list, tuple)):
+    if isinstance(tree, dict):
+        for t in tree.values():
+            _assert_no_device(t)
+    elif isinstance(tree, (list, tuple)):
         for t in tree:
             _assert_no_device(t)
 
