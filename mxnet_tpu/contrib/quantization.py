@@ -241,6 +241,10 @@ def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
     calibration batches. ``mode``: 'naive' (min/max, the reference
     default) or 'entropy' (KL-optimal symmetric threshold).
     ``layers``: list of Dense/Conv2D blocks."""
+    if mode not in ("naive", "entropy"):
+        raise MXNetError(
+            f"unknown calibration mode {mode!r}; use 'naive' or 'entropy'"
+        )
     ranges: Dict[int, List[float]] = {}
     hists: Dict[int, _np.ndarray] = {}
     NBINS, hooks = 2048, []

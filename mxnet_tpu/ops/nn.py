@@ -317,6 +317,76 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, cudnn_off=Fal
 
 
 # ------------------------------------------------------------ normalization
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(data, gamma, beta, eps, axis):
+    return _bn_train_fwd_rule(data, gamma, beta, eps, axis)[0]
+
+
+def _bn_stats(data, axis):
+    red = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    # two-pass statistics, f32 accumulators, nothing materialized: the
+    # one-pass E[x^2]-E[x]^2 form cancels catastrophically whenever
+    # |mean| >> std (even in f32: at mean/std=200 the f32 rounding of
+    # E[x^2] is the size of the true variance), so the centered form is
+    # required. XLA fuses the convert/subtract/square into the reduction,
+    # so the cost is one extra READ of the bf16 activation.
+    mean = jnp.mean(data, axis=red, dtype=jnp.float32)
+    cdiff = data.astype(jnp.float32) - mean.reshape(bshape)
+    var = jnp.mean(jnp.square(cdiff), axis=red)
+    return mean, var, red, bshape
+
+
+def _bn_apply(data, mean, var, gamma, beta, eps, bshape):
+    # normalize as ONE fma in the activation dtype: precompute per-channel
+    # scale/shift in f32, cast once — the (B,H,W)-sized math stays bf16
+    # under AMP instead of promoting to f32 through a broadcast subtract
+    inv = jax.lax.rsqrt(var + eps)
+    scale = inv * gamma.astype(jnp.float32)
+    shift = beta.astype(jnp.float32) - mean * scale
+    out = data * scale.astype(data.dtype).reshape(bshape) \
+        + shift.astype(data.dtype).reshape(bshape)
+    return out, inv
+
+
+def _bn_train_fwd_rule(data, gamma, beta, eps, axis):
+    mean, var, red, bshape = _bn_stats(data, axis)
+    out, inv = _bn_apply(data, mean, var, gamma, beta, eps, bshape)
+    return out, (data, gamma, mean, inv, beta)
+
+
+def _bn_train_bwd_rule(eps, axis, res, dy):
+    """Closed-form fused BN backward (the hand-derived 2-pass kernel the
+    reference wrote in CUDA): one fused pass for the two reductions
+    (sum dy, sum dy*xhat — XLA merges them into a single read of dy and
+    x), one pass for dx. XLA's autodiff of the forward chain emits ~6
+    reduction/elementwise passes instead."""
+    data, gamma, mean, inv, beta = res
+    red = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
+    bshape = [1] * data.ndim
+    bshape[axis] = data.shape[axis]
+    n = 1
+    for i in red:
+        n *= data.shape[i]
+    dyf = dy.astype(jnp.float32)
+    xhat = (data.astype(jnp.float32) - mean.reshape(bshape)) \
+        * inv.reshape(bshape)
+    sum_dy = jnp.sum(dyf, axis=red)
+    sum_dy_xhat = jnp.sum(dyf * xhat, axis=red)
+    gscale = (gamma.astype(jnp.float32) * inv).reshape(bshape)
+    dx = gscale * (
+        dyf - (sum_dy / n).reshape(bshape)
+        - xhat * (sum_dy_xhat / n).reshape(bshape)
+    )
+    dgamma = sum_dy_xhat.astype(gamma.dtype)
+    dbeta = sum_dy.astype(beta.dtype)
+    return dx.astype(data.dtype), dgamma, dbeta
+
+
+_bn_train.defvjp(_bn_train_fwd_rule, _bn_train_bwd_rule)
+
+
 @register("BatchNorm", num_outputs=None)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.9,
                fix_gamma=True, use_global_stats=False, output_mean_var=False,
@@ -325,33 +395,23 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
 
     Pure: returns (out, batch_mean, batch_var); the caller (gluon BatchNorm
     layer / CachedOp state threading) applies the moving-average update the
-    reference performed in-place on aux states.
+    reference performed in-place on aux states. Training gradients use the
+    closed-form fused backward (``_bn_train_bwd_rule``).
     """
     g = jnp.ones_like(gamma) if fix_gamma else gamma
-    red = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
     if training and not use_global_stats:
-        # two-pass statistics, f32 accumulators, nothing materialized: the
-        # one-pass E[x^2]-E[x]^2 form cancels catastrophically whenever
-        # |mean| >> std (even in f32: at mean/std=200 the f32 rounding of
-        # E[x^2] is the size of the true variance), so the centered form
-        # is required. XLA fuses the convert/subtract/square into the
-        # reduction, so the cost is one extra READ of the bf16 activation.
-        mean = jnp.mean(data, axis=red, dtype=jnp.float32)
-        cdiff = data.astype(jnp.float32) - mean.reshape(bshape)
-        var = jnp.mean(jnp.square(cdiff), axis=red)
-    else:
-        mean = moving_mean.astype(jnp.float32)
-        var = moving_var.astype(jnp.float32)
-    # normalize as ONE fma in the activation dtype: precompute per-channel
-    # scale/shift in f32, cast once — the (B,H,W)-sized math stays bf16
-    # under AMP instead of promoting to f32 through a broadcast subtract
-    inv = jax.lax.rsqrt(var + eps)
-    scale = inv * g.astype(jnp.float32)
-    shift = beta.astype(jnp.float32) - mean * scale
-    out = data * scale.astype(data.dtype).reshape(bshape) \
-        + shift.astype(data.dtype).reshape(bshape)
+        mean, var, _, _ = _bn_stats(data, axis)
+        out = _bn_train(data, g, beta, float(eps), axis % data.ndim)
+        # the duplicate stats computation above is CSE'd away by XLA (the
+        # custom_vjp forward computes the identical reductions); eagerly
+        # it costs one extra pair of reductions only in unstaged code
+        return (out, mean.astype(moving_mean.dtype),
+                var.astype(moving_var.dtype))
+    mean = moving_mean.astype(jnp.float32)
+    var = moving_var.astype(jnp.float32)
+    out, _ = _bn_apply(data, mean, var, g, beta, eps, bshape)
     return out, mean.astype(moving_mean.dtype), var.astype(moving_var.dtype)
 
 

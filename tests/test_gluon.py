@@ -348,3 +348,31 @@ def test_mlp_training_converges():
             first = float(L.mean().asscalar())
     last = float(L.mean().asscalar())
     assert last < first * 0.1, (first, last)
+
+
+def test_batchnorm_eager_training_grads():
+    # regression: the fused BN backward must work through the EAGER tape
+    # (jax.vjp), not only under hybridize/TrainStep tracing — a non-array
+    # residual in the custom_vjp broke exactly (and only) this path
+    import numpy as np
+
+    from mxnet_tpu import autograd, gluon, nd
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(4, 3, padding=1))
+        net.add(gluon.nn.BatchNorm())
+        net.add(gluon.nn.Dense(2))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd", {"learning_rate": 0.1})
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.RandomState(0).rand(4, 3, 6, 6)
+                 .astype(np.float32))
+    y = nd.array(np.array([0, 1, 0, 1]))
+    with autograd.record():
+        loss = ce(net(x), y).mean()
+    loss.backward()
+    bn = net[1]
+    assert float(abs(bn.gamma.grad()).sum().asscalar()) > 0
+    assert float(abs(bn.beta.grad()).sum().asscalar()) > 0
+    tr.step(4)
