@@ -159,6 +159,37 @@ def _record(fn: Callable, args, datas):
     return outs, node
 
 
+def _has_float0(ct):
+    cts = ct if isinstance(ct, (tuple, list)) else (ct,)
+    return any(getattr(c, "dtype", None) == jax.dtypes.float0 for c in cts)
+
+
+def _record_cached(fwd, bwd, fn, args, datas):
+    """Tape node over CACHED jitted callables (imperative._fwd_jit /
+    _bwd_jit): the forward is one pjit fast-path call, and the backward
+    recomputes the forward inside one cached pjit instead of holding a
+    per-call ``jax.vjp`` residual closure — eliminating the per-op
+    linearization that profiled as the eager hot-loop bottleneck. The
+    recompute trade is right for the dispatch-bound imperative path; a
+    compute-bound training loop belongs in hybridize()/TrainStep."""
+    outs = fwd(*datas)
+    multi = isinstance(outs, (tuple, list))
+    outs_t = tuple(outs) if multi else (outs,)
+    avals = [(o.shape, o.dtype) for o in outs_t]
+    inputs = [a if _is_tracked(a) else None for a in args]
+    xs = tuple(datas)
+
+    def vjp(ct):
+        if _has_float0(ct):
+            # float0 cotangents (int outputs) are host values jit cannot
+            # take as operands — use the direct path for this rare case
+            return jax.vjp(fn, *xs)[1](ct)
+        return bwd(xs, ct)
+
+    node = _Node(vjp, inputs, avals, multi)
+    return outs, node
+
+
 def _mark_output(nd: NDArray, node: _Node, index: int):
     nd._ag = _AGInfo(node, index)
 

@@ -92,6 +92,37 @@ def _to_device(batch):
     return nd_array(batch)
 
 
+_FORK_WARNED = [False]
+
+
+def _warn_fork_after_runtime():
+    """One-time warning when worker processes fork AFTER the JAX runtime
+    initialized: locked runtime mutexes are copied into the child and can
+    deadlock it (advisor round 3; the reference kept engine fork-handlers
+    for the same hazard)."""
+    if _FORK_WARNED[0]:
+        return
+    try:
+        from jax._src import xla_bridge as _xb
+
+        initialized = bool(getattr(_xb, "_backends", None))
+    except Exception:  # noqa: BLE001 - private API moved
+        initialized = False
+    if initialized:
+        import warnings
+
+        warnings.warn(
+            "DataLoader is forking worker processes after the JAX runtime "
+            "started; mutexes held by runtime threads at fork time are "
+            "copied locked into the children and may deadlock them. "
+            "Create DataLoaders before the first device computation, or "
+            "use thread_pool=True.",
+            RuntimeWarning,
+            stacklevel=4,
+        )
+        _FORK_WARNED[0] = True
+
+
 # ---------------------------------------------------------------- mp worker
 def _pack(tree):
     """numpy tree -> (spec, shm list): arrays ride shared memory, not the
@@ -159,12 +190,34 @@ def _worker_loop(dataset, index_q, data_q, seed, batchify_fn):
 
 
 class DataLoader:
+    """See module docstring for backend selection.
+
+    Fork hazards (advisor round 3): ``num_workers > 0`` without
+    ``thread_pool`` fork()s the parent. Forking AFTER the JAX/TPU
+    runtime has started is dangerous beyond device access: any mutex a
+    runtime thread holds at fork time (allocator, logging, XLA
+    compilation) is copied LOCKED into the child and can deadlock it.
+    Create your DataLoaders (or take one batch) before the first device
+    computation, or pass ``thread_pool=True``. A one-time warning fires
+    when the fork pool is created after runtime init.
+
+    ``persistent_workers=True`` (default) forks ONCE and reuses the pool
+    across epochs — the dataset is snapshotted at the first fork, so
+    datasets must be immutable across epochs (epoch-dependent state like
+    ``set_epoch`` patterns is silently ignored). Pass
+    ``persistent_workers=False`` for the reference's re-fork-per-iterator
+    semantics: each epoch sees the dataset's current state, at the cost
+    of a fork per epoch.
+    """
+
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False, timeout=120):
+                 prefetch=None, thread_pool=False, timeout=120,
+                 persistent_workers=True):
         self._dataset = dataset
         self._timeout = timeout
+        self._persistent_workers = bool(persistent_workers)
         if batch_sampler is None:
             if batch_size is None:
                 raise ValueError(
@@ -289,6 +342,7 @@ class DataLoader:
                 p.join(timeout=0.5)
                 if p.is_alive():
                     p.terminate()
+        _warn_fork_after_runtime()
         ctx = _mp.get_context("fork")
         index_q = ctx.Queue()
         data_q = ctx.Queue()
@@ -308,7 +362,7 @@ class DataLoader:
         self._mp_next_id = 0
         return self._mp_pool
 
-    def __del__(self):
+    def _shutdown_pool(self):
         pool = getattr(self, "_mp_pool", None)
         if pool is None:
             return
@@ -329,6 +383,10 @@ class DataLoader:
             self._drain_stale(data_q)
         except Exception:  # noqa: BLE001 - interpreter shutdown
             pass
+        self._mp_pool = None
+
+    def __del__(self):
+        self._shutdown_pool()
 
     @staticmethod
     def _discard(spec):
@@ -356,6 +414,10 @@ class DataLoader:
                 self._discard(payload)
 
     def _iter_mp(self):
+        if not self._persistent_workers:
+            # reference semantics: a fresh fork per iterator, so the
+            # workers see the dataset's CURRENT state each epoch
+            self._shutdown_pool()
         workers, index_q, data_q = self._ensure_pool()
         self._drain_stale(data_q)
         batches = list(self._batch_sampler)

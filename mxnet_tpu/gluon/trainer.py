@@ -10,14 +10,50 @@ step needs no Trainer-level sync at all (the collective is compiled in).
 
 from __future__ import annotations
 
+import functools
 from typing import Optional
+
+import numpy as _np
+import jax.numpy as jnp
 
 from ..base import MXNetError
 from .. import optimizer as opt
 from ..kvstore import KVStore as _KV
+from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
 
 __all__ = ["Trainer"]
+
+
+def _fused_jit_enabled() -> bool:
+    import os
+
+    return os.environ.get("MXTPU_EAGER_JIT", "1") != "0"
+
+
+@functools.lru_cache(maxsize=64)
+def _fused_sgd_fn(n: int, momentum: float, clip: float):
+    import jax
+
+    # the per-tensor math is the op library's (_apply_wd_rescale is the
+    # single source of rescale/clip/wd ordering — shared with
+    # sgd_update/multi_sgd_update so the three paths cannot diverge)
+    from ..ops.optimizer_op import _apply_wd_rescale
+
+    def apply(ws, gs, ms, lrs, wds, rescale):
+        new_w, new_m = [], []
+        for i in range(n):
+            g = _apply_wd_rescale(ws[i], gs[i], wds[i], rescale,
+                                  clip if clip >= 0 else None)
+            if momentum:
+                m = momentum * ms[i] - lrs[i] * g
+                new_m.append(m)
+                new_w.append(ws[i] + m)
+            else:
+                new_w.append(ws[i] - lrs[i] * g)
+        return tuple(new_w), tuple(new_m) if momentum else None
+
+    return jax.jit(apply)
 
 
 class Trainer:
@@ -163,10 +199,71 @@ class Trainer:
                 self._kvstore.push(i, param.grad())
                 self._kvstore.pull(i, param.data())
             return
+        if self._fused_sgd_update(updater):
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
             updater(i, param.grad(), param.data())
+
+    def _fused_sgd_update(self, updater) -> bool:
+        """Multi-tensor apply (reference ``multi_sgd_(mom_)update``,
+        ``src/operator/optimizer_op.cc`` [unverified]): ONE jitted call
+        updates every parameter — the whole optimizer step is a single
+        dispatch instead of one per param, the same launch-amortization
+        the reference's multi-tensor CUDA kernels bought. lr/wd arrive as
+        device vectors so lr-schedule changes never retrigger a compile.
+
+        Engages only for the plain dense f32 SGD(+momentum) case with
+        the exact SGD class; anything else falls back to per-param
+        updates."""
+        opt_ = self._optimizer
+        if type(opt_) is not opt.SGD or not _fused_jit_enabled():
+            return False
+        idxs, ws, gs, ms = [], [], [], []
+        from ..ndarray.sparse import RowSparseNDArray
+
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            w, g = param.data(), param.grad()
+            if isinstance(g, RowSparseNDArray) or w.dtype != _np.float32:
+                return False
+            if i not in updater.states:
+                updater.states[i] = opt_.create_state_multi_precision(i, w)
+                updater.states_synced[i] = True
+            st = updater.states[i]
+            if st is not None and not isinstance(st, NDArray):
+                return False  # multi-precision tuple state: fallback
+            if (st is None) != (opt_.momentum == 0.0):
+                return False
+            idxs.append(i)
+            ws.append(w)
+            gs.append(g)
+            ms.append(st)
+        if not idxs:
+            return False
+        for i in idxs:
+            opt_._update_count(i)
+        lrs = jnp.asarray([opt_._get_lr(i) for i in idxs], jnp.float32)
+        wds = jnp.asarray([opt_._get_wd(i) for i in idxs], jnp.float32)
+        rescale = jnp.float32(opt_.rescale_grad)
+        clip = opt_.clip_gradient if opt_.clip_gradient is not None else -1.0
+        fn = _fused_sgd_fn(len(idxs), float(opt_.momentum), float(clip))
+        if opt_.momentum:
+            new_w, new_m = fn(
+                tuple(w.data for w in ws), tuple(g.data for g in gs),
+                tuple(m.data for m in ms), lrs, wds, rescale)
+            for w, m, nw, nm in zip(ws, ms, new_w, new_m):
+                w._rebind(nw)
+                m._rebind(nm)
+        else:
+            new_w, _ = fn(
+                tuple(w.data for w in ws), tuple(g.data for g in gs),
+                None, lrs, wds, rescale)
+            for w, nw in zip(ws, new_w):
+                w._rebind(nw)
+        return True
 
     # ---------------------------------------------------------------- state
     def save_states(self, fname):

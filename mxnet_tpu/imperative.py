@@ -49,22 +49,183 @@ def _wrap_outputs(outs, rec_nodes=None):
     return wrapped[0] if single else tuple(wrapped)
 
 
-def invoke_fn(fn: Callable, *args, **static_params):
+_DERIVE = object()  # sentinel: derive the jit key from fn itself
+
+
+def invoke_fn(fn: Callable, *args, _jit_key=_DERIVE, **static_params):
     """Dispatch ``fn(*arrays, **static_params)`` eagerly with autograd support.
 
     ``args`` may contain NDArrays (tracked for autograd), jax arrays, numpy
     arrays, or python scalars. ``static_params`` are closed over (never
-    differentiated).
+    differentiated). ``_jit_key`` (private): hashable key for the per-op
+    jit cache, ``None`` to force the un-jitted path, or left at the
+    sentinel to derive one from ``fn``'s code identity.
     """
     from . import autograd
 
     if static_params:
         fn = functools.partial(fn, **static_params)
+    if _jit_key is _DERIVE:
+        _jit_key = _fn_jit_key(fn)
+    if _jit_key is not None and _EAGER_FWD_CACHE.get(_jit_key) is _FAILED:
+        _jit_key = None
     datas = [a.data if isinstance(a, NDArray) else a for a in args]
     if autograd._should_record(args):
+        if _jit_key is not None:
+            try:
+                outs, node = autograd._record_cached(
+                    _fwd_jit(_jit_key, fn), _bwd_jit(_jit_key, fn),
+                    fn, args, datas)
+                return _wrap_outputs(outs, rec_nodes=node)
+            except Exception:
+                outs, node = autograd._record(fn, args, datas)
+                # the plain path succeeded: the failure was jit-specific
+                # (trace-hostile fn) — blacklist. A user error would have
+                # raised again just above, leaving the cache untouched.
+                _EAGER_FWD_CACHE[_jit_key] = _FAILED
+                return _wrap_outputs(outs, rec_nodes=node)
         outs, node = autograd._record(fn, args, datas)
         return _wrap_outputs(outs, rec_nodes=node)
+    if _jit_key is not None:
+        try:
+            return _wrap_outputs(_fwd_jit(_jit_key, fn)(*datas))
+        except Exception:
+            out = _wrap_outputs(fn(*datas))  # user errors re-raise here
+            _EAGER_FWD_CACHE[_jit_key] = _FAILED  # jit-specific failure
+            return out
     return _wrap_outputs(fn(*datas))
+
+
+# ------------------------------------------------- per-op jit cache (eager)
+# The reference engineered its imperative hot loop around engine-push cost
+# (SURVEY section 3.1); ours is per-op dispatch overhead: an eager op body
+# of K jnp calls costs K XLA executions plus, under autograd.record, a
+# fresh Python linearization through jax.vjp EVERY call (~ms of host work
+# per op — profiled as THE eager bottleneck). The cure is one cached pair
+# of jitted callables per (op, params) key:
+#   fwd(key):  jit(fn)                      — primal, C++ cache fast path
+#   bwd(key):  jit(lambda xs, ct: vjp(fn, *xs)[1](ct))
+#              — recomputes the (tiny, dispatch-bound) forward inside the
+#                backward instead of keeping per-call residual closures;
+#                host cost collapses to a cached pjit call
+# Keyed on hashable params only; ops whose bodies consume global RNG or
+# produce data-dependent shapes are denied (a failed trace blacklists the
+# key and falls back to the un-jitted path). MXTPU_EAGER_JIT=0 disables.
+_EAGER_FWD_CACHE: dict = {}
+_EAGER_BWD_CACHE: dict = {}
+_EAGER_JIT_DENY = {
+    "Dropout",   # draws from mx.random inside the body: jit would freeze
+    "shuffle",   # the key as a compile-time constant
+    "RNN",       # dropout path inside the scan body
+    "Custom",    # python-callback custom ops manage their own tape/state
+    "unique",    # data-dependent output shape
+}
+_FAILED = object()
+
+
+def _freeze(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze(x)) for k, x in v.items()))
+    return v
+
+
+def _jit_enabled() -> bool:
+    import os
+
+    return os.environ.get("MXTPU_EAGER_JIT", "1") != "0" \
+        and engine().is_async()
+
+
+def _op_jit_key(op, params):
+    """Cache key for a registered-op dispatch; None = do not jit."""
+    if not _jit_enabled() or op.name in _EAGER_JIT_DENY \
+            or getattr(op, "self_recording", False):
+        return None
+    for v in params.values():
+        if isinstance(v, NDArray) or hasattr(v, "shape"):
+            # array-valued params would be baked in as constants (and
+            # NDArray rebinding would silently stale them) — stay eager
+            return None
+    try:
+        key = ("op", op.name, _freeze(tuple(sorted(params.items()))))
+        hash(key)
+    except TypeError:
+        return None
+    return key
+
+
+def _fn_jit_key(fn):
+    """Cache key for a bare function/lambda dispatch (NDArray method
+    lambdas): the code object identity + closure values. The code object
+    itself is part of the key (kept alive by the cache), so id reuse
+    after GC cannot alias two different functions."""
+    if not _jit_enabled():
+        return None
+    if isinstance(fn, functools.partial):
+        inner = _fn_jit_key(fn.func)
+        if inner is None:
+            return None
+        try:
+            key = ("partial", inner, _freeze(tuple(sorted(fn.keywords.items()))),
+                   _freeze(fn.args))
+            hash(key)
+        except TypeError:
+            return None
+        return key
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        return None
+    cells = ()
+    if fn.__closure__:
+        try:
+            cells = tuple(c.cell_contents for c in fn.__closure__)
+            cells = _freeze(cells)
+            hash(cells)
+        except (TypeError, ValueError):
+            return None
+    try:
+        key = ("code", code, cells)
+        hash(key)
+    except TypeError:
+        return None
+    return key
+
+
+_EAGER_CACHE_CAP = 2048  # keys; value-varying closures (loop-dependent
+# slice bounds, schedules passed as op params) would otherwise mint
+# wrappers + compiled executables without bound. FIFO eviction: dropping
+# a wrapper frees its executables; a re-hit just re-jits.
+
+
+def _cache_put(cache, key, value):
+    if len(cache) >= _EAGER_CACHE_CAP:
+        cache.pop(next(iter(cache)))
+    cache[key] = value
+    return value
+
+
+def _fwd_jit(key, fn):
+    j = _EAGER_FWD_CACHE.get(key)
+    if j is None:
+        import jax
+
+        j = _cache_put(_EAGER_FWD_CACHE, key, jax.jit(fn))
+    return j
+
+
+def _bwd_jit(key, fn):
+    j = _EAGER_BWD_CACHE.get(key)
+    if j is None:
+        import jax
+
+        def bwd(xs, ct):
+            _, vjp_fn = jax.vjp(fn, *xs)
+            return vjp_fn(ct)
+
+        j = _cache_put(_EAGER_BWD_CACHE, key, jax.jit(bwd))
+    return j
 
 
 def invoke(op, *args, out=None, **params):
@@ -72,11 +233,25 @@ def invoke(op, *args, out=None, **params):
     if not isinstance(op, Operator):
         op = get_op(op)
     fn = functools.partial(op.fn, **params) if params else op.fn
+    key = _op_jit_key(op, params)
+    return _invoke_with(op, fn, key, args, out)
+
+
+def _invoke_with(op, fn, key, args, out):
     if op.mutates_input is not None:
         # fused in-place update ops (optimizers): run unrecorded, rebind input
         target = args[op.mutates_input]
         datas = [a.data if isinstance(a, NDArray) else a for a in args]
-        outs = fn(*datas)
+        call = fn
+        if key is not None and _EAGER_FWD_CACHE.get(key) is not _FAILED:
+            call = _fwd_jit(key, fn)
+        try:
+            outs = call(*datas)
+        except Exception:
+            if call is fn:
+                raise
+            outs = fn(*datas)  # user errors re-raise here, no blacklist
+            _EAGER_FWD_CACHE[key] = _FAILED  # jit-specific failure
         outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
         if isinstance(target, NDArray):
             target._rebind(outs_t[0])
@@ -89,7 +264,7 @@ def invoke(op, *args, out=None, **params):
         # ORIGINAL NDArrays so its Function links to the caller's graph
         result = _wrap_outputs(fn(*args))
     else:
-        result = invoke_fn(fn, *args)
+        result = invoke_fn(fn, *args, _jit_key=key)
     if out is not None:
         _bind_out(out, result)
         return out

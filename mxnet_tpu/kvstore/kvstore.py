@@ -216,6 +216,9 @@ class KVStore(KVStoreBase):
         pass
 
 
+_ASYNC_WARNED = [False]
+
+
 def create(name="local") -> KVStore:
     """Create a KVStore (reference: ``mx.kv.create``).
 
@@ -242,5 +245,20 @@ def create(name="local") -> KVStore:
                 "horovod", "byteps"):
         from .kvstore_dist import KVStoreDist
 
+        if kind == "dist_async" and not _ASYNC_WARNED[0]:
+            # runtime signal, not just a docstring (advisor round 3):
+            # ported scripts get different throughput/staleness behavior
+            import warnings
+
+            warnings.warn(
+                "kv.create('dist_async') runs with dist_sync semantics on "
+                "this backend: XLA collectives are bulk-synchronous and "
+                "there is no parameter server to be asynchronous against. "
+                "Results are correct; the async staleness/throughput trade "
+                "does not exist here.",
+                RuntimeWarning,
+                stacklevel=2,
+            )
+            _ASYNC_WARNED[0] = True
         return KVStoreDist(kind)
     raise MXNetError(f"unknown KVStore type {name!r}")

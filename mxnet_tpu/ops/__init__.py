@@ -14,6 +14,8 @@ from . import nn  # noqa: F401 - registers nn ops
 from . import contrib  # noqa: F401 - registers contrib ops
 from . import optimizer_op  # noqa: F401 - registers fused optimizer updates
 from . import fused_loss  # noqa: F401 - registers blocked vocab-proj + CE
+from . import linalg  # noqa: F401 - registers linalg_* (la_op family)
+from . import spatial  # noqa: F401 - registers spatial transformer group
 from . import params  # noqa: F401 - typed op-param schemas (dmlc::Parameter)
 from .params import P, op_params, describe_op, validate_params, \
     schema_to_json, list_documented_ops

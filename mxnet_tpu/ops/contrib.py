@@ -372,7 +372,10 @@ def count_sketch(data, h, s, out_dim=None, **kw):  # rarely used; minimal
 # the whole (B,H,Sq,Sk) score tile fits comfortably in HBM/VMEM and XLA
 # fuses qk->softmax->pv better than the kernel's block machinery amortizes
 # (measured on v5e-lite, BERT b64 s128: dense 50.6 ms/step vs flash 57.3).
-_DENSE_MAX_SEQ = int(_os.environ.get("MXTPU_ATTN_DENSE_MAX", "256"))
+def _dense_max_seq() -> int:
+    # read per call (advisor round-3): setting the var after import must
+    # take effect; jit caching keys on the resulting branch anyway
+    return int(_os.environ.get("MXTPU_ATTN_DENSE_MAX", "256"))
 
 
 def _dense_attention(q, k, v, valid_length, causal, sm_scale):
@@ -403,11 +406,13 @@ def _flash_attention_op(query, key, value, valid_length=None, causal=False,
     the long-context path). Shapes (B, H, S, D); ``valid_length`` (B,)
     masks padding keys (reference softmax ``use_length`` semantics).
 
-    Short sequences (Sk <= MXTPU_ATTN_DENSE_MAX, default 256) take an exact
-    dense path — at these sizes the score tile is small and XLA's fusion
-    beats the flash kernel's block overhead; long sequences take the
-    O(S)-memory Pallas flash kernel. Both are numerically exact softmax
-    attention."""
+    Short sequences (Sk <= MXTPU_ATTN_DENSE_MAX, default 256; read per
+    call) take an exact dense path — at these sizes the score tile is
+    small and XLA's fusion beats the flash kernel's block overhead; long
+    sequences take the O(S)-memory Pallas flash kernel. Both are
+    numerically exact softmax attention. NOTE the dense path materializes
+    the O(Sq*Sk) score tensor: callers choosing this op specifically for
+    O(S) memory at short S should set MXTPU_ATTN_DENSE_MAX=0."""
     from .pallas import flash_attention as _fa
 
     # keyword args bypass invoke()'s NDArray unwrapping — accept both
@@ -416,7 +421,7 @@ def _flash_attention_op(query, key, value, valid_length=None, causal=False,
         valid_length = valid_length.data
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(query.shape[-1])
-    if max(query.shape[2], key.shape[2]) <= _DENSE_MAX_SEQ:
+    if max(query.shape[2], key.shape[2]) <= _dense_max_seq():
         return _dense_attention(query, key, value, valid_length,
                                 bool(causal), float(sm_scale))
     return _fa(query, key, value, valid_length, bool(causal), sm_scale,
@@ -662,7 +667,8 @@ def _rcnn_decode(anchors, deltas, clip_hw=None):
 def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
              rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
              scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
-             feature_stride=16, output_score=False, **kw):
+             feature_stride=16, output_score=False, layout="batched",
+             **kw):
     """RPN proposal generation (reference ``proposal.cc`` [unverified]).
 
     cls_prob (B, 2A, H, W) — [:, :A] background, [:, A:] foreground
@@ -717,6 +723,14 @@ def proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
         (B, rois.shape[1], 1),
     )
     rois = jnp.concatenate([bidx, rois], axis=-1)
+    if layout == "flat":
+        # reference proposal.cc emitted flat (B*N, 5) rows — one reshape
+        # away from the batched form (advisor round 3: ported consumers
+        # index this layout)
+        rois = rois.reshape(-1, 5)
+        if output_score:
+            return rois, scores.reshape(-1, 1)
+        return rois
     if output_score:
         return rois, scores[..., None]
     return rois

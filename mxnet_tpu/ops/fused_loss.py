@@ -131,9 +131,23 @@ _linear_ce.defvjp(_linear_ce_fwd, _linear_ce_bwd)
 
 @register("linear_cross_entropy", namespaces=("nd", "npx"))
 def linear_cross_entropy(x, weight, labels, block_size=8192,
-                         ignore_label: Optional[int] = None, **kw):
-    """Cross-entropy of ``softmax(x @ weight.T)`` against integer ``labels``
-    without materializing the (N, V) logits.
+                         ignore_label: Optional[int] = None, mode="auto",
+                         **kw):
+    """Cross-entropy of ``softmax(x @ weight.T)`` against integer ``labels``.
+
+    ``mode`` selects the implementation (round-4 regime sweep,
+    ``benchmarks.bench_linear_ce`` on v5e):
+
+    - ``"dense"``: materialize the (N, V) f32 logits — measured 2.5-3x
+      FASTER than the blocked scan whenever they fit (XLA pipelines the
+      big matmul + fused logsumexp better than a scan amortizes;
+      V=30k N=8k: 7.5 vs 21.7 ms; V=262k N=8k: 68.7 vs 174.3 ms).
+    - ``"blocked"``: online-logsumexp scan, O(N*block) memory — the only
+      feasible path once logits exceed HBM (naive OOMs at V=131k N=32k
+      on the 16 GB chip; blocked runs it at 344 ms).
+    - ``"auto"`` (default): dense while the transient logits footprint
+      (~3 copies of N*V f32: logits + grad + workspace) stays under
+      ``MXTPU_CE_DENSE_MAX_BYTES`` (default 6e9), else blocked.
 
     Args:
         x: (..., H) activations (any leading shape; flattened internally).
@@ -150,6 +164,20 @@ def linear_cross_entropy(x, weight, labels, block_size=8192,
     h = x.shape[-1]
     xf = x.reshape(-1, h)
     lf = labels.reshape(-1).astype(jnp.int32)
+    if mode == "auto":
+        import os
+
+        budget = float(os.environ.get("MXTPU_CE_DENSE_MAX_BYTES", 6e9))
+        dense_bytes = 3.0 * xf.shape[0] * weight.shape[0] * 4
+        mode = "dense" if dense_bytes <= budget else "blocked"
+    if mode == "dense":
+        logits = jnp.dot(xf, weight.T, preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        lab = jnp.take_along_axis(logits, lf[:, None], 1)[:, 0]
+        loss = lse - lab
+        if ignore_label is not None:
+            loss = jnp.where(lf == ignore_label, 0.0, loss)
+        return loss.reshape(lead)
     block = int(min(block_size, max(256, weight.shape[0])))
     loss = _linear_ce(xf, weight, lf, block, ignore_label)
     return loss.reshape(lead)

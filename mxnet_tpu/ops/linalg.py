@@ -1,0 +1,191 @@
+"""Linear-algebra operators (the reference ``linalg_*`` family).
+
+Reference: ``src/operator/tensor/la_op.cc`` [unverified] — thin wrappers
+over LAPACK/cuSOLVER. Here each op lowers to the corresponding
+``jax.numpy.linalg`` / ``jax.scipy.linalg`` primitive, which XLA maps to
+its TPU-side QR/Cholesky/triangular-solve custom calls; batching comes
+from the leading dimensions exactly as the reference's batched mode did.
+
+All ops accept stacked batches: a (..., m, n) operand applies the
+operation to every trailing matrix. Gradients flow through jax's
+built-in JVP/transpose rules for the decompositions (the reference
+hand-wrote these backward kernels).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _t(x):
+    return jnp.swapaxes(x, -1, -2)
+
+
+@register("linalg_gemm", aliases=["_linalg_gemm"])
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2, **kw):
+    """C <- alpha * op(A) @ op(B) + beta * C (reference linalg_gemm)."""
+    if axis != -2:
+        raise NotImplementedError(
+            "linalg_gemm: only the default axis=-2 (trailing-matrix) "
+            "layout is implemented; transpose your operands instead of "
+            "passing axis"
+        )
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b) + beta * C
+
+
+@register("linalg_gemm2", aliases=["_linalg_gemm2"])
+def linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0,
+                 **kw):
+    """alpha * op(A) @ op(B) (reference linalg_gemm2)."""
+    a = _t(A) if transpose_a else A
+    b = _t(B) if transpose_b else B
+    return alpha * jnp.matmul(a, b)
+
+
+@register("linalg_potrf", aliases=["_linalg_potrf"])
+def linalg_potrf(A, **kw):
+    """Cholesky factor L of a symmetric positive-definite A (lower)."""
+    return jnp.linalg.cholesky(A)
+
+
+@register("linalg_potri", aliases=["_linalg_potri"])
+def linalg_potri(A, **kw):
+    """Inverse of the SPD matrix whose Cholesky factor is A:
+    potri(L) = (L @ L^T)^-1 (reference semantics: input IS the factor)."""
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    linv = jax.scipy.linalg.solve_triangular(A, eye, lower=True)
+    return jnp.matmul(_t(linv), linv)
+
+
+@register("linalg_trsm", aliases=["_linalg_trsm"])
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kw):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B with rightside)."""
+    if rightside:
+        # X op(A) = aB  <=>  op(A)^T X^T = a B^T; with op = id that is
+        # A^T Y = aB^T (trans=1), with op = T it is A Y = aB^T (trans=0)
+        sol = jax.scipy.linalg.solve_triangular(
+            A, _t(alpha * B), lower=lower, trans=0 if transpose else 1)
+        return _t(sol)
+    return jax.scipy.linalg.solve_triangular(
+        A, alpha * B, lower=lower, trans=1 if transpose else 0)
+
+
+@register("linalg_trmm", aliases=["_linalg_trmm"])
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0, **kw):
+    """Triangular matrix multiply: alpha op(A) @ B (or B @ op(A))."""
+    tri = jnp.tril(A) if lower else jnp.triu(A)
+    op_a = _t(tri) if transpose else tri
+    return alpha * (jnp.matmul(B, op_a) if rightside else jnp.matmul(op_a, B))
+
+
+@register("linalg_syrk", aliases=["_linalg_syrk"])
+def linalg_syrk(A, transpose=False, alpha=1.0, **kw):
+    """alpha * A @ A^T (or A^T @ A with transpose)."""
+    return alpha * (jnp.matmul(_t(A), A) if transpose
+                    else jnp.matmul(A, _t(A)))
+
+
+@register("linalg_sumlogdiag", aliases=["_linalg_sumlogdiag"])
+def linalg_sumlogdiag(A, **kw):
+    """sum(log(diag(A))) per trailing matrix (log-det of a Cholesky)."""
+    return jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1)
+
+
+@register("linalg_extractdiag", aliases=["_linalg_extractdiag"])
+def linalg_extractdiag(A, offset=0, **kw):
+    return jnp.diagonal(A, offset=offset, axis1=-2, axis2=-1)
+
+
+@register("linalg_makediag", aliases=["_linalg_makediag"])
+def linalg_makediag(A, offset=0, **kw):
+    n = A.shape[-1] + abs(offset)
+    eye = jnp.eye(n, k=offset, dtype=A.dtype)
+    idx = jnp.arange(A.shape[-1])
+    rows = idx + max(-offset, 0)
+    cols = idx + max(offset, 0)
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return out.at[..., rows, cols].set(A)
+
+
+@register("linalg_extracttrian", aliases=["_linalg_extracttrian"])
+def linalg_extracttrian(A, offset=0, lower=True, **kw):
+    """Pack the (lower/upper) triangle into a vector, row-major over the
+    kept entries (reference layout)."""
+    n = A.shape[-1]
+    r, c = jnp.tril_indices(n, k=offset) if lower \
+        else jnp.triu_indices(n, k=offset)
+    return A[..., r, c]
+
+
+def _trian_count(n, offset, lower):
+    """Entries kept by tril/triu_indices(n, k=offset)."""
+    if not lower:
+        # triu(n, k) keeps what tril(n, -k) keeps, mirrored
+        return _trian_count(n, -offset, True)
+    total = 0
+    for r in range(n):
+        total += max(0, min(n, r + offset + 1))
+    return total
+
+
+@register("linalg_maketrian", aliases=["_linalg_maketrian"])
+def linalg_maketrian(A, offset=0, lower=True, **kw):
+    """Inverse of ``linalg_extracttrian``: scatter the packed vector back
+    into an (n, n) triangle. The matrix size is recovered by searching
+    the (strictly increasing in n) kept-entry count — exact for every
+    offset the extract side supports, in both band directions."""
+    k = A.shape[-1]
+    n = 1
+    while _trian_count(n, offset, lower) < k:
+        n += 1
+    if _trian_count(n, offset, lower) != k:
+        raise ValueError(
+            f"linalg_maketrian: {k} entries do not fill any triangle "
+            f"with offset={offset}"
+        )
+    r, c = (jnp.tril_indices(n, k=offset) if lower
+            else jnp.triu_indices(n, k=offset))
+    out = jnp.zeros(A.shape[:-1] + (n, n), dtype=A.dtype)
+    return out.at[..., r, c].set(A)
+
+
+@register("linalg_inverse", aliases=["_linalg_inverse"])
+def linalg_inverse(A, **kw):
+    return jnp.linalg.inv(A)
+
+
+@register("linalg_det", aliases=["_linalg_det"])
+def linalg_det(A, **kw):
+    return jnp.linalg.det(A)
+
+
+@register("linalg_slogdet", aliases=["_linalg_slogdet"], num_outputs=2)
+def linalg_slogdet(A, **kw):
+    sign, logabs = jnp.linalg.slogdet(A)
+    return sign, logabs
+
+
+@register("linalg_syevd", aliases=["_linalg_syevd"], num_outputs=2)
+def linalg_syevd(A, **kw):
+    """Symmetric eigendecomposition; returns (U, lambda) with rows of U
+    the eigenvectors (reference layout: A = U^T diag(L) U)."""
+    w, v = jnp.linalg.eigh(A)
+    return _t(v), w
+
+
+@register("linalg_gelqf", aliases=["_linalg_gelqf"], num_outputs=2)
+def linalg_gelqf(A, **kw):
+    """LQ factorization of a full-rank (m, n) A, m <= n: A = L Q with Q
+    orthonormal rows (reference gelqf)."""
+    q, r = jnp.linalg.qr(_t(A), mode="reduced")
+    return _t(r), _t(q)

@@ -319,19 +319,66 @@ def pooling(data, kernel=None, pool_type="max", global_pool=False, cudnn_off=Fal
 # ------------------------------------------------------------ normalization
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
 def _bn_train(data, gamma, beta, eps, axis):
+    """Training BN returning (out, batch_mean, batch_var); the stat
+    outputs are moving-average side products and carry no gradient (the
+    reference treated them as aux states)."""
     return _bn_train_fwd_rule(data, gamma, beta, eps, axis)[0]
+
+
+def _bn_mode() -> str:
+    """MXTPU_FUSED_BN: '1' shifted one-pass jnp (default), 'pallas' the
+    Pallas kernels (channel-last only), '0' round-3 two-pass jnp. Read
+    per call."""
+    import os
+
+    return os.environ.get("MXTPU_FUSED_BN", "1").lower()
+
+
+def _bn_fused_ok(data, axis):
+    from .pallas import batch_norm as _pbn
+
+    return _bn_mode() == "pallas" and _pbn.supports(data, axis)
 
 
 def _bn_stats(data, axis):
     red = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
-    # two-pass statistics, f32 accumulators, nothing materialized: the
-    # one-pass E[x^2]-E[x]^2 form cancels catastrophically whenever
-    # |mean| >> std (even in f32: at mean/std=200 the f32 rounding of
-    # E[x^2] is the size of the true variance), so the centered form is
-    # required. XLA fuses the convert/subtract/square into the reduction,
-    # so the cost is one extra READ of the bf16 activation.
+    if _bn_fused_ok(data, axis):
+        # Pallas one-read stats (channel-last layers only; opt-in — on
+        # the v5e trace the jnp form below compiles to the same single
+        # pass WITHOUT the layout copies Pallas operands force)
+        from .pallas import batch_norm as _pbn
+
+        C = data.shape[-1]
+        mean, var = _pbn.bn_stats(data.reshape(-1, C))
+        return mean, var, red, bshape
+    mode = _bn_mode()
+    if mode != "0":
+        # SHIFTED one-pass statistics, f32 accumulators: subtract a
+        # per-channel sample s (one element of the channel) before the
+        # sum/sumsq — XLA's multi-output fusion computes both reductions
+        # in a single read of x (measured round 3: 26.86 vs 29.28 ms on
+        # the ResNet-50 step). The raw one-pass E[x^2]-E[x]^2 form was
+        # REVERTED in round 3: it cancels catastrophically whenever
+        # |mean| >> std. With the shift, E[x-s] is ~std-sized (s sits
+        # within a few std of the mean with overwhelming probability),
+        # so E[(x-s)^2] - E[x-s]^2 only cancels O(1) bits — safe in f32
+        # for any channel distribution.
+        n = 1
+        for i in red:
+            n *= data.shape[i]
+        idx = tuple(slice(None) if i == (axis % data.ndim) else 0
+                    for i in range(data.ndim))
+        s = jax.lax.stop_gradient(data[idx]).astype(jnp.float32)
+        xs = data.astype(jnp.float32) - s.reshape(bshape)
+        s1 = jnp.sum(xs, axis=red)
+        s2 = jnp.sum(jnp.square(xs), axis=red)
+        mean = s + s1 / n
+        var = s2 / n - jnp.square(s1 / n)
+        return mean, var, red, bshape
+    # two-pass statistics, f32 accumulators, nothing materialized;
+    # one READ of the activation more than the shifted form above
     mean = jnp.mean(data, axis=red, dtype=jnp.float32)
     cdiff = data.astype(jnp.float32) - mean.reshape(bshape)
     var = jnp.mean(jnp.square(cdiff), axis=red)
@@ -353,16 +400,23 @@ def _bn_apply(data, mean, var, gamma, beta, eps, bshape):
 def _bn_train_fwd_rule(data, gamma, beta, eps, axis):
     mean, var, red, bshape = _bn_stats(data, axis)
     out, inv = _bn_apply(data, mean, var, gamma, beta, eps, bshape)
-    return out, (data, gamma, mean, inv, beta)
+    return (out, mean, var), (data, gamma, mean, inv, beta)
 
 
-def _bn_train_bwd_rule(eps, axis, res, dy):
+def _bn_train_bwd_rule(eps, axis, res, cts):
     """Closed-form fused BN backward (the hand-derived 2-pass kernel the
     reference wrote in CUDA): one fused pass for the two reductions
-    (sum dy, sum dy*xhat — XLA merges them into a single read of dy and
-    x), one pass for dx. XLA's autodiff of the forward chain emits ~6
-    reduction/elementwise passes instead."""
+    (sum dy, sum dy*xhat — through the Pallas ``bn_bwd_reduce`` kernel
+    when the layout supports it, guaranteeing the single joint read of
+    (x, dy) rather than hoping XLA's multi-output fusion merges them),
+    one jnp pass for dx that XLA fuses with neighbors. XLA's autodiff of
+    the forward chain emits ~6 reduction/elementwise passes instead.
+
+    Cotangents for the mean/var outputs are ignored: they are
+    moving-average aux products, not differentiable paths (reference
+    semantics)."""
     data, gamma, mean, inv, beta = res
+    dy = cts[0]
     red = tuple(i for i in range(data.ndim) if i != (axis % data.ndim))
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
@@ -372,8 +426,15 @@ def _bn_train_bwd_rule(eps, axis, res, dy):
     dyf = dy.astype(jnp.float32)
     xhat = (data.astype(jnp.float32) - mean.reshape(bshape)) \
         * inv.reshape(bshape)
-    sum_dy = jnp.sum(dyf, axis=red)
-    sum_dy_xhat = jnp.sum(dyf * xhat, axis=red)
+    if _bn_fused_ok(data, axis):
+        from .pallas import batch_norm as _pbn
+
+        C = data.shape[-1]
+        sum_dy, sum_dy_xhat = _pbn.bn_bwd_reduce(
+            data.reshape(-1, C), dy.reshape(-1, C), mean, inv)
+    else:
+        sum_dy = jnp.sum(dyf, axis=red)
+        sum_dy_xhat = jnp.sum(dyf * xhat, axis=red)
     gscale = (gamma.astype(jnp.float32) * inv).reshape(bshape)
     dx = gscale * (
         dyf - (sum_dy / n).reshape(bshape)
@@ -402,11 +463,10 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3, momentum=0.
     bshape = [1] * data.ndim
     bshape[axis] = data.shape[axis]
     if training and not use_global_stats:
-        mean, var, _, _ = _bn_stats(data, axis)
-        out = _bn_train(data, g, beta, float(eps), axis % data.ndim)
-        # the duplicate stats computation above is CSE'd away by XLA (the
-        # custom_vjp forward computes the identical reductions); eagerly
-        # it costs one extra pair of reductions only in unstaged code
+        out, mean, var = _bn_train(data, g, beta, float(eps),
+                                   axis % data.ndim)
+        mean = jax.lax.stop_gradient(mean)
+        var = jax.lax.stop_gradient(var)
         return (out, mean.astype(moving_mean.dtype),
                 var.astype(moving_var.dtype))
     mean = moving_mean.astype(jnp.float32)

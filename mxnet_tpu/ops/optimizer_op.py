@@ -192,3 +192,105 @@ def mp_sgd_mom_update(weight, grad, mom, weight32, lr=0.01, momentum=0.0,
     new_mom = momentum * mom - lr * g
     new_w32 = weight32 + new_mom
     return new_w32.astype(weight.dtype), new_mom, new_w32
+
+
+# ------------------------------------------------- multi-tensor kernels
+# Reference: multi_sgd_update / multi_sum_sq / multi_lars in
+# ``src/operator/optimizer_op.cc`` and ``contrib/multi_sum_sq.cc``
+# [unverified] — one CUDA kernel walking many tensors to kill per-op
+# launch overhead. Here each op takes the flat variadic tensor list the
+# reference took; called under one jit, XLA compiles the whole update
+# into a single executable, which is the same dispatch-amortization win
+# (the eager Trainer's fused path feeds these).
+
+def _norm_seq(v, n):
+    if isinstance(v, (tuple, list)):
+        return [float(x) for x in v]
+    return [float(v)] * n
+
+
+@register("multi_sum_sq", differentiable=False, num_outputs=None)
+def multi_sum_sq(*arrays, num_arrays=None, **kw):
+    """Per-array sum of squares, returned as one (num_arrays,) vector."""
+    return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                      for a in arrays])
+
+
+@register("multi_lars", differentiable=False)
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0, **kw):
+    """LARS layer-wise lr scaling on stacked per-layer scalars
+    (reference multi_lars): lr_i *= eta*||w||/(||g||+wd*||w||+eps)."""
+    wn = jnp.sqrt(weights_sum_sq)
+    gn = jnp.sqrt(grads_sum_sq) * rescale_grad
+    coef = eta * wn / (gn + wds * wn + eps)
+    return jnp.where(jnp.logical_and(wn > 0, gn > 0), lrs * coef, lrs)
+
+
+@register("multi_sgd_update", differentiable=False, num_outputs=None)
+def multi_sgd_update(*weights_grads, lrs=0.01, wds=0.0, rescale_grad=1.0,
+                     clip_gradient=-1.0, num_weights=None, **kw):
+    """Interleaved (w0, g0, w1, g1, ...) -> tuple of updated weights."""
+    n = num_weights or len(weights_grads) // 2
+    lrs, wds = _norm_seq(lrs, n), _norm_seq(wds, n)
+    clip = clip_gradient if clip_gradient >= 0 else None
+    out = []
+    for i in range(n):
+        w, g = weights_grads[2 * i], weights_grads[2 * i + 1]
+        gg = _apply_wd_rescale(w, g, wds[i], rescale_grad, clip)
+        out.append(w - lrs[i] * gg)
+    return tuple(out)
+
+
+@register("multi_sgd_mom_update", differentiable=False, num_outputs=None)
+def multi_sgd_mom_update(*wgm, lrs=0.01, wds=0.0, momentum=0.0,
+                         rescale_grad=1.0, clip_gradient=-1.0,
+                         num_weights=None, **kw):
+    """Interleaved (w0, g0, m0, ...) -> (w0', m0', w1', m1', ...)."""
+    n = num_weights or len(wgm) // 3
+    lrs, wds = _norm_seq(lrs, n), _norm_seq(wds, n)
+    clip = clip_gradient if clip_gradient >= 0 else None
+    out = []
+    for i in range(n):
+        w, g, m = wgm[3 * i], wgm[3 * i + 1], wgm[3 * i + 2]
+        gg = _apply_wd_rescale(w, g, wds[i], rescale_grad, clip)
+        nm = momentum * m - lrs[i] * gg
+        out.extend([w + nm, nm])
+    return tuple(out)
+
+
+@register("multi_mp_sgd_update", differentiable=False, num_outputs=None)
+def multi_mp_sgd_update(*wgw32, lrs=0.01, wds=0.0, rescale_grad=1.0,
+                        clip_gradient=-1.0, num_weights=None, **kw):
+    """Interleaved (w0, g0, w32_0, ...) -> (w0', w32_0', ...)."""
+    n = num_weights or len(wgw32) // 3
+    lrs, wds = _norm_seq(lrs, n), _norm_seq(wds, n)
+    clip = clip_gradient if clip_gradient >= 0 else None
+    out = []
+    for i in range(n):
+        w, g, w32 = wgw32[3 * i], wgw32[3 * i + 1], wgw32[3 * i + 2]
+        gg = _apply_wd_rescale(w32, g.astype(jnp.float32), wds[i],
+                               rescale_grad, clip)
+        nw32 = w32 - lrs[i] * gg
+        out.extend([nw32.astype(w.dtype), nw32])
+    return tuple(out)
+
+
+@register("multi_mp_sgd_mom_update", differentiable=False,
+          num_outputs=None)
+def multi_mp_sgd_mom_update(*wgmw32, lrs=0.01, wds=0.0, momentum=0.0,
+                            rescale_grad=1.0, clip_gradient=-1.0,
+                            num_weights=None, **kw):
+    """Interleaved (w0, g0, m0, w32_0, ...) -> (w', m', w32', ...)."""
+    n = num_weights or len(wgmw32) // 4
+    lrs, wds = _norm_seq(lrs, n), _norm_seq(wds, n)
+    clip = clip_gradient if clip_gradient >= 0 else None
+    out = []
+    for i in range(n):
+        w, g, m, w32 = wgmw32[4 * i:4 * i + 4]
+        gg = _apply_wd_rescale(w32, g.astype(jnp.float32), wds[i],
+                               rescale_grad, clip)
+        nm = momentum * m - lrs[i] * gg
+        nw32 = w32 + nm
+        out.extend([nw32.astype(w.dtype), nm, nw32])
+    return tuple(out)

@@ -33,6 +33,15 @@ from .registry import get as _get_op, maybe_get as _maybe_get
 __all__ = ["P", "op_params", "describe_op", "validate_params",
            "schema_to_json", "list_documented_ops"]
 
+def _parse_seq(v):
+    """Accept '(1, 2)' / '[1,2]' strings (symbol-JSON attrs) as sequences."""
+    if isinstance(v, str):
+        import ast
+
+        v = ast.literal_eval(v)
+    return v
+
+
 # name -> coercion callable; mirrors the dmlc type names the reference
 # printed in docstrings
 _TYPES: Dict[str, Callable[[Any], Any]] = {
@@ -41,9 +50,10 @@ _TYPES: Dict[str, Callable[[Any], Any]] = {
     "bool": lambda v: v if isinstance(v, bool) else str(v).lower()
     in ("1", "true", "yes", "on"),
     "str": str,
-    "Shape": lambda v: tuple(int(x) for x in v)
-    if isinstance(v, (tuple, list)) else (int(v),),
-    "tuple_of_float": lambda v: tuple(float(x) for x in v),
+    "Shape": lambda v: (lambda s: tuple(int(x) for x in s)
+                        if isinstance(s, (tuple, list))
+                        else (int(s),))(_parse_seq(v)),
+    "tuple_of_float": lambda v: tuple(float(x) for x in _parse_seq(v)),
     "any": lambda v: v,
 }
 
@@ -185,10 +195,79 @@ def schema_to_json(name: str) -> str:
 
 
 def list_documented_ops():
+    """Ops carrying a schema. An EMPTY schema counts: it is the explicit
+    declaration 'this op takes no parameters' (plain elementwise ops),
+    exactly like a dmlc::Parameter struct with no fields."""
     from .registry import _REGISTRY
 
     return sorted(n for n, e in _REGISTRY.items()
-                  if getattr(e, "param_schema", None))
+                  if getattr(e, "param_schema", None) is not None)
+
+
+# ------------------------------------------------ signature-derived schemas
+def _infer_type(default) -> str:
+    if isinstance(default, bool):
+        return "bool"
+    if isinstance(default, int):
+        return "int"
+    if isinstance(default, float):
+        return "float"
+    if isinstance(default, str):
+        return "str"
+    if isinstance(default, (tuple, list)):
+        if default and all(isinstance(x, int) for x in default):
+            return "Shape"
+        if default and all(isinstance(x, (int, float)) for x in default):
+            return "tuple_of_float"
+    return "any"
+
+
+def autogen_schema(op) -> None:
+    """Derive a schema from the op function's signature (the mechanical
+    part of what dmlc::Parameter declared: name, type, default).
+
+    Every keyword argument with a default becomes a P() entry; typed by
+    its default value. Optional array inputs (default None) land as type
+    'any', which coerces as pass-through — harmless for validation and
+    still listed for introspection, the way the reference docs listed
+    optional inputs. Hand-written schemas (richer: ranges, choices,
+    docs) always win; this only fills ops that have none."""
+    import inspect
+
+    if op.param_schema is not None:
+        return
+    try:
+        sig = inspect.signature(op.fn)
+    except (TypeError, ValueError):
+        op.param_schema = []
+        return
+    schema = []
+    for pname, p in sig.parameters.items():
+        if p.kind in (inspect.Parameter.VAR_POSITIONAL,
+                      inspect.Parameter.VAR_KEYWORD):
+            continue
+        if p.default is inspect.Parameter.empty:
+            continue  # positional tensor input
+        schema.append(P(pname, _infer_type(p.default), default=p.default))
+    op.param_schema = schema
+
+
+def autogen_all() -> None:
+    from .registry import _REGISTRY
+
+    for op in _REGISTRY.values():
+        autogen_schema(op)
+
+
+def assert_registry_documented() -> None:
+    """Invariant the reference enforced structurally (no op without its
+    dmlc::Parameter struct): every registered op carries a schema."""
+    from .registry import list_ops
+
+    missing = [n for n in list_ops() if n not in set(list_documented_ops())
+               and _get_op(n).param_schema is None]
+    if missing:
+        raise RuntimeError(f"ops registered without param schema: {missing}")
 
 
 def _install_builtin_schemas():
@@ -276,6 +355,8 @@ def _install_builtin_schemas():
           doc="anchor aspect ratios"),
         P("feature_stride", "int", default=16, doc="input stride of the map"),
         P("output_score", "bool", default=False, doc="also return scores"),
+        P("layout", "str", default="batched", choices=("batched", "flat"),
+          doc="(B, N, 5) TPU-native or the reference's flat (B*N, 5)"),
     )
     attach(
         "_contrib_flash_attention",
@@ -293,7 +374,13 @@ def _install_builtin_schemas():
         "linear_cross_entropy",
         P("block_size", "int", default=8192, low=256, doc="vocab tile"),
         P("ignore_label", "int", default=None, doc="label id with zero loss"),
+        P("mode", "str", default="auto", choices=("auto", "dense", "blocked"),
+          doc="dense logits vs online-logsumexp scan (auto: by byte budget)"),
     )
 
 
 _install_builtin_schemas()
+autogen_all()
+# registrations that happen after this module is loaded (extensions,
+# tests) get their schema from the hook in registry.register
+_READY = True

@@ -107,6 +107,14 @@ def register(
         for a in aliases:
             alias(a, opname)
         fn.op = op  # backlink for introspection
+        # late registrations (extensions, tests) get a signature-derived
+        # schema immediately; during package import the params module
+        # runs autogen_all() once every op module has loaded
+        import sys
+
+        params_mod = sys.modules.get(__package__ + ".params")
+        if params_mod is not None and getattr(params_mod, "_READY", False):
+            params_mod.autogen_schema(op)
         return fn
 
     return deco

@@ -1,0 +1,188 @@
+"""Spatial-transformer operator group + im2col/col2im.
+
+Reference: ``src/operator/spatial_transformer.cc``, ``grid_generator.cc``,
+``bilinear_sampler.cc``, ``src/operator/correlation.cc``, and the im2col
+helpers in ``src/operator/nn/im2col.h`` [all unverified].
+
+TPU-first notes: sampling is expressed as gather + FMA (differentiable
+through jax's autodiff — the reference hand-wrote every backward);
+``im2col`` lowers to ``lax.conv_general_dilated_patches`` (XLA emits the
+same unfold loop a hand kernel would); ``col2im`` is defined as the
+adjoint of ``im2col`` via ``jax.vjp``, which gives the exact scatter-add
+semantics of the reference kernel with zero new kernel code.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _bilinear_sample(data, gx, gy):
+    """Sample data (N,C,H,W) at continuous pixel coords gx, gy (N,Ho,Wo).
+
+    Out-of-range samples clamp to the border pixel weighted by the
+    in-range fraction — matching the reference's zero-padding semantics:
+    weights of out-of-bounds corners are zeroed."""
+    N, C, H, W = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def corner(yi, xi):
+        inb = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        # gather per batch: (N, Ho, Wo) indices into (N, C, H, W)
+        v = jax.vmap(lambda d, yy, xx: d[:, yy, xx])(data, yc, xc)
+        return v * inb[:, None].astype(data.dtype)
+
+    # corner() returns (N, C, Ho, Wo) via vmap over batch; weights
+    # broadcast over C
+    def wexp(w):
+        return w[:, None].astype(data.dtype)
+
+    out = (corner(y0, x0) * wexp((1 - wy) * (1 - wx))
+           + corner(y0, x0 + 1) * wexp((1 - wy) * wx)
+           + corner(y0 + 1, x0) * wexp(wy * (1 - wx))
+           + corner(y0 + 1, x0 + 1) * wexp(wy * wx))
+    return out
+
+
+@register("BilinearSampler")
+def bilinear_sampler(data, grid, **kw):
+    """data (N,C,H,W), grid (N,2,Ho,Wo) with x,y in [-1,1] (reference
+    convention: grid[:,0] = x, grid[:,1] = y, -1 = left/top edge)."""
+    N, C, H, W = data.shape
+    gx = (grid[:, 0] + 1.0) * (W - 1) / 2.0
+    gy = (grid[:, 1] + 1.0) * (H - 1) / 2.0
+    return _bilinear_sample(data, gx, gy)
+
+
+@register("GridGenerator")
+def grid_generator(data, transform_type="affine", target_shape=(0, 0),
+                   **kw):
+    """affine: data (N, 6) row-major 2x3 -> grid (N, 2, H, W) over the
+    normalized [-1,1] mesh; warp: data (N, 2, H, W) optical flow added to
+    the identity pixel mesh, output normalized (reference semantics)."""
+    if transform_type == "affine":
+        H, W = int(target_shape[0]), int(target_shape[1])
+        theta = data.reshape(-1, 2, 3)
+        ys = jnp.linspace(-1.0, 1.0, H)
+        xs = jnp.linspace(-1.0, 1.0, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx.ravel(), gy.ravel(), ones.ravel()])  # (3, HW)
+        out = jnp.einsum("nij,jk->nik", theta, base.astype(data.dtype))
+        return out.reshape(-1, 2, H, W)
+    if transform_type == "warp":
+        N, _, H, W = data.shape
+        gy, gx = jnp.meshgrid(jnp.arange(H, dtype=data.dtype),
+                              jnp.arange(W, dtype=data.dtype),
+                              indexing="ij")
+        px = data[:, 0] + gx
+        py = data[:, 1] + gy
+        nx = 2.0 * px / max(W - 1, 1) - 1.0
+        ny = 2.0 * py / max(H - 1, 1) - 1.0
+        return jnp.stack([nx, ny], axis=1)
+    raise ValueError(f"unknown transform_type {transform_type!r}")
+
+
+@register("SpatialTransformer")
+def spatial_transformer(data, loc, target_shape=(0, 0),
+                        transform_type="affine",
+                        sampler_type="bilinear", **kw):
+    """Affine spatial transformer: loc (N, 6) localization output, data
+    (N, C, H, W) -> (N, C, *target_shape)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise ValueError("reference supports affine + bilinear only")
+    grid = grid_generator(loc, "affine", target_shape)
+    return bilinear_sampler(data, grid)
+
+
+@register("Correlation", num_outputs=1)
+def correlation(data1, data2, kernel_size=1, max_displacement=1,
+                stride1=1, stride2=1, pad_size=0, is_multiply=True, **kw):
+    """FlowNet correlation layer (reference ``correlation.cc``): for each
+    displacement (dy, dx) on the stride2 grid within max_displacement,
+    emit mean over channels&kernel-window of data1 * shifted(data2)
+    (or |a-b| sum when is_multiply=False). Static displacement loop —
+    unrolled into one fused XLA program. Output spatial size matches the
+    reference: the padded grid cropped by border = max_displacement +
+    kernel_radius on each side, then strided by stride1."""
+    N, C, H, W = data1.shape
+    p = int(pad_size)
+    d1 = jnp.pad(data1, ((0, 0), (0, 0), (p, p), (p, p)))
+    d2 = jnp.pad(data2, ((0, 0), (0, 0), (p, p), (p, p)))
+    md, s2 = int(max_displacement), int(stride2)
+    ndisp = 2 * (md // s2) + 1
+    k = int(kernel_size)
+    kr = k // 2
+    Hp, Wp = H + 2 * p, W + 2 * p
+    outs = []
+    norm = C * k * k
+    for dy in range(-(md // s2) * s2, (md // s2) * s2 + 1, s2):
+        for dx in range(-(md // s2) * s2, (md // s2) * s2 + 1, s2):
+            shifted = jnp.roll(d2, shift=(-dy, -dx), axis=(2, 3))
+            # zero the wrapped region (reference pads with zeros)
+            ys = jnp.arange(Hp) + dy
+            xs = jnp.arange(Wp) + dx
+            valid = ((ys >= 0) & (ys < Hp))[:, None] \
+                & ((xs >= 0) & (xs < Wp))[None, :]
+            shifted = shifted * valid[None, None].astype(shifted.dtype)
+            prod = d1 * shifted if is_multiply else jnp.abs(d1 - shifted)
+            s = jnp.sum(prod, axis=1, keepdims=True)  # over channels
+            if k > 1:
+                s = jax.lax.reduce_window(
+                    s, 0.0, jax.lax.add, (1, 1, k, k), (1, 1, 1, 1),
+                    ((0, 0), (0, 0), (kr, kr), (kr, kr)))
+            outs.append(s / norm)
+    out = jnp.concatenate(outs, axis=1)  # (N, ndisp*ndisp, Hp, Wp)
+    border = md + kr
+    if border:
+        if 2 * border >= min(Hp, Wp):
+            raise ValueError(
+                f"Correlation: border {border} consumes the whole "
+                f"{Hp}x{Wp} padded input; increase pad_size"
+            )
+        out = out[:, :, border:Hp - border, border:Wp - border]
+    if int(stride1) > 1:
+        out = out[:, :, ::int(stride1), ::int(stride1)]
+    return out
+
+
+@register("im2col")
+def im2col(data, kernel, stride=1, dilate=1, pad=0, **kw):
+    """(N, C, H, W) -> (N, C*kh*kw, L) column matrix (reference
+    ``im2col.h`` layout: feature dim ordered channel-major, then kernel
+    rows, then kernel cols; L = output locations row-major)."""
+    kh, kw_ = (kernel if isinstance(kernel, (tuple, list)) else (kernel,) * 2)
+    sh, sw = (stride if isinstance(stride, (tuple, list)) else (stride,) * 2)
+    dh, dw = (dilate if isinstance(dilate, (tuple, list)) else (dilate,) * 2)
+    ph, pw = (pad if isinstance(pad, (tuple, list)) else (pad,) * 2)
+    patches = jax.lax.conv_general_dilated_patches(
+        data, (int(kh), int(kw_)), (int(sh), int(sw)),
+        [(int(ph), int(ph)), (int(pw), int(pw))],
+        rhs_dilation=(int(dh), int(dw)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    N = data.shape[0]
+    return patches.reshape(N, patches.shape[1], -1)
+
+
+@register("col2im")
+def col2im(data, input_shape, kernel, stride=1, dilate=1, pad=0, **kw):
+    """Adjoint of ``im2col``: scatter-add columns back to (N, C, H, W).
+
+    Defined as the vjp of im2col — bit-exact adjoint semantics without a
+    hand-written scatter kernel."""
+    shape = tuple(int(s) for s in input_shape)
+    zeros = jnp.zeros(shape, dtype=data.dtype)
+    _, vjp = jax.vjp(
+        lambda x: im2col(x, kernel, stride=stride, dilate=dilate, pad=pad),
+        zeros)
+    return vjp(data)[0]

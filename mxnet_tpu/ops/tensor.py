@@ -14,6 +14,8 @@ Op names and parameter spellings follow the reference's Python surface
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
@@ -520,4 +522,17 @@ register("eye", differentiable=False)(
     lambda N=1, M=0, k=0, dtype="float32", **kw: jnp.eye(
         int(N), int(M) if M else None, k=int(k), dtype=jnp.dtype(dtype)
     )
+)
+
+# ----------------------------------------------------------- round-4 tail
+# add_n / swapaxes / reshape_like: reference ``elemwise_sum.cc``,
+# ``matrix_op.cc`` [unverified]
+register("add_n", aliases=["ElementWiseSum"])(
+    lambda *args, **kw: functools.reduce(jnp.add, args)
+)
+register("swapaxes", aliases=["SwapAxis"])(
+    lambda data, dim1=0, dim2=0, **kw: jnp.swapaxes(data, dim1, dim2)
+)
+register("reshape_like")(
+    lambda lhs, rhs, **kw: jnp.reshape(lhs, rhs.shape)
 )

@@ -468,16 +468,35 @@ class TrainStep:
         if getattr(self, "_rescale_host", None) != rescale:
             self._rescale_host = rescale
             self._rescale_dev = jnp.float32(rescale)
-        L, new_vals, self._opt_state, self._key_dev, self._t_dev, aux = \
-            self._step_fn(
-                train_vals, frozen_vals, self._opt_state, tuple(batch),
+        args = (train_vals, frozen_vals, self._opt_state, tuple(batch),
                 label, self._key_dev, self._lr_dev, self._t_dev,
-                self._rescale_dev,
-            )
+                self._rescale_dev)
+        if getattr(self, "_last_avals", None) is None:
+            # stash operand avals ONCE so cost_analysis() can re-lower the
+            # exact program later (donated buffers are consumed, so keep
+            # shapes only; shapes cannot change without recompiling
+            # _step_fn anyway)
+            self._last_avals = jax.tree.map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
+        L, new_vals, self._opt_state, self._key_dev, self._t_dev, aux = \
+            self._step_fn(*args)
         self._values.update(new_vals)
         for n, v in aux.items():
             self._values[n] = v
         return NDArray(L)
+
+    def cost_analysis(self):
+        """XLA ``cost_analysis`` of the exact compiled step program
+        (flops, bytes accessed) — the honest-MFU/roofline denominator.
+        Requires at least one prior call; re-lowers from the stashed
+        operand avals (compilation-cache hit when nothing changed)."""
+        avals = getattr(self, "_last_avals", None)
+        if avals is None:
+            raise MXNetError("call the step once before cost_analysis()")
+        c = self._step_fn.lower(*avals).compile().cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0]
+        return c
 
     def _current_lr(self):
         opt = self._optimizer

@@ -59,7 +59,13 @@ def main():
         expect_w = 1.0 - 3 * 0.5 * (0.5 * nworkers)
         np.testing.assert_allclose(out.asnumpy(),
                                    np.full((6, 2), expect_w), rtol=1e-5)
-        print(f"worker {rank}/{nworkers}: full-mode dist kvstore OK")
+        # round-4 wire-byte check: the cross-host transfer must carry
+        # PACKED 2-bit codes, not floats — 12 values -> 3 uint8 bytes
+        # per worker (vs 48 f32 bytes uncompressed)
+        assert getattr(kv, "last_push_wire_bytes", None) == 3, \
+            f"wire bytes {getattr(kv, 'last_push_wire_bytes', None)} != 3"
+        print(f"worker {rank}/{nworkers}: full-mode dist kvstore OK "
+              f"(wire bytes/worker: {kv.last_push_wire_bytes})")
         return 0
 
     # init must be identical on all workers (reference requirement)

@@ -1,0 +1,179 @@
+"""Eager per-op jit cache + fused multi-tensor Trainer update (round 4).
+
+The imperative hot loop (SURVEY §3.1): per-op dispatch must not change
+numerics. These tests pin jit-on vs jit-off parity for forward, autograd
+(cached recompute-backward), the fused SGD trainer apply, and the
+blacklist fallback for trace-hostile functions.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu import imperative
+
+
+def _train_steps(flag, steps=3):
+    os.environ["MXTPU_EAGER_JIT"] = flag
+    mx.random.seed(11)
+    rng = np.random.RandomState(0)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Conv2D(4, kernel_size=3, activation="relu"),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(10))
+    net.initialize(mx.initializer.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    x = nd.array(rng.rand(8, 1, 8, 8).astype(np.float32))
+    y = nd.array(rng.randint(0, 10, 8).astype(np.float32))
+    losses = []
+    for _ in range(steps):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+        losses.append(float(loss.mean().asscalar()))
+    # name-counter suffixes differ between instantiations: compare by
+    # declaration order
+    return losses, [v.data().asnumpy()
+                    for _, v in sorted(net.collect_params().items())]
+
+
+def test_eager_jit_training_parity():
+    l1, p1 = _train_steps("1")
+    l0, p0 = _train_steps("0")
+    os.environ.pop("MXTPU_EAGER_JIT", None)
+    np.testing.assert_allclose(l1, l0, rtol=1e-5, atol=1e-6)
+    for i, (a, b) in enumerate(zip(p1, p0)):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"param {i}")
+
+
+def test_fused_sgd_trainer_engages():
+    os.environ["MXTPU_EAGER_JIT"] = "1"
+    try:
+        net = gluon.nn.Dense(3)
+        net.initialize()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        x = nd.array(np.random.rand(4, 5).astype(np.float32))
+        with autograd.record():
+            loss = net(x).sum()
+        loss.backward()
+        updater = trainer._updaters[0]
+        # first step builds state through the fused path
+        trainer.step(4)
+        # the fused path must actually ENGAGE (returns True), not fall
+        # back to per-param updates
+        trainer._optimizer.rescale_grad = trainer._scale / 4
+        assert trainer._fused_sgd_update(updater) is True
+        assert all(isinstance(s, mx.nd.NDArray) or s is None
+                   for s in updater.states.values())
+        # momentum state must exist and be updated by the fused call
+        assert any(s is not None and float(np.abs(s.asnumpy()).sum()) > 0
+                   for s in updater.states.values())
+    finally:
+        os.environ.pop("MXTPU_EAGER_JIT", None)
+
+
+def test_cache_blacklist_fallback():
+    """A trace-hostile function must fall back to the plain path and
+    still produce the right result (and not poison later calls)."""
+    calls = []
+
+    def hostile(a):
+        import jax
+
+        calls.append(1)
+        if isinstance(a, jax.core.Tracer):
+            raise RuntimeError("no tracers here")  # fails only under jit
+        return a * 2
+
+    x = nd.array(np.ones(3, np.float32))
+    out = imperative.invoke_fn(hostile, x)
+    np.testing.assert_allclose(out.asnumpy(), 2 * np.ones(3), rtol=0)
+
+
+def test_rng_ops_not_frozen():
+    """Dropout is deny-listed: two eager calls must draw different
+    masks (a frozen jit constant would repeat them)."""
+    os.environ["MXTPU_EAGER_JIT"] = "1"
+    try:
+        mx.random.seed(3)
+        x = nd.ones((64, 64))
+        a = mx.nd.Dropout(x, p=0.5, mode="always").asnumpy()
+        b = mx.nd.Dropout(x, p=0.5, mode="always").asnumpy()
+        assert not np.array_equal(a, b)
+    finally:
+        os.environ.pop("MXTPU_EAGER_JIT", None)
+
+
+def test_lambda_key_distinguishes_closures():
+    """NDArray method lambdas close over args (e.g. reshape target);
+    different closure values must not collide in the cache."""
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    a = x.reshape((4, 3))
+    b = x.reshape((2, 6))
+    assert a.shape == (4, 3) and b.shape == (2, 6)
+    t1 = x.transpose()
+    assert t1.shape == (4, 3)
+
+
+def test_dataloader_nonpersistent_sees_mutation():
+    """persistent_workers=False re-forks per epoch (reference
+    semantics), so dataset mutations between epochs are visible."""
+    class Ds:
+        def __init__(self):
+            self.scale = 1.0
+
+        def __len__(self):
+            return 4
+
+        def __getitem__(self, i):
+            return np.full((2,), i * self.scale, np.float32)
+
+    ds = Ds()
+    dl = gluon.data.DataLoader(ds, batch_size=2, num_workers=1,
+                               persistent_workers=False)
+    first = [b.asnumpy() for b in dl]
+    ds.scale = 10.0
+    second = [b.asnumpy() for b in dl]
+    np.testing.assert_allclose(second[0], first[0] * 10.0)
+
+
+def test_dist_async_warns_once():
+    import warnings
+
+    from mxnet_tpu.kvstore import kvstore as kvmod
+
+    kvmod._ASYNC_WARNED[0] = False
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mx.kv.create("dist_async")
+        assert any("dist_sync semantics" in str(x.message) for x in w)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        mx.kv.create("dist_async")
+        assert not any("dist_sync semantics" in str(x.message) for x in w)
+
+
+def test_proposal_flat_layout():
+    rng = np.random.RandomState(0)
+    B, A, H, W = 2, 3, 4, 4
+    cls_prob = nd.array(rng.rand(B, 2 * A, H, W).astype(np.float32))
+    bbox_pred = nd.array((rng.rand(B, 4 * A, H, W) * 0.1).astype(np.float32))
+    im_info = nd.array(np.tile([64.0, 64.0, 1.0], (B, 1)).astype(np.float32))
+    kw = dict(rpn_pre_nms_top_n=12, rpn_post_nms_top_n=5,
+              scales=(8.,), ratios=(0.5, 1., 2.), feature_stride=16)
+    batched = mx.nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                                     **kw).asnumpy()
+    flat = mx.nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                                  layout="flat", **kw).asnumpy()
+    assert batched.shape == (2, 5, 5)
+    assert flat.shape == (10, 5)
+    np.testing.assert_allclose(flat, batched.reshape(10, 5))

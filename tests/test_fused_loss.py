@@ -33,7 +33,7 @@ def test_value_parity_f32(n, h, v, block):
     x = jnp.asarray(rng.randn(n, h), jnp.float32)
     w = jnp.asarray(rng.randn(v, h), jnp.float32)
     labels = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
-    got = linear_cross_entropy(x, w, labels, block_size=block)
+    got = linear_cross_entropy(x, w, labels, mode="blocked", block_size=block)
     want = _naive(x, w, labels)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
                                atol=1e-5)
@@ -48,7 +48,7 @@ def test_grad_parity_f32():
     gsc = jnp.asarray(rng.rand(n), jnp.float32)  # non-uniform upstream grads
 
     def fused(x, w):
-        return jnp.sum(linear_cross_entropy(x, w, labels, block_size=32) * gsc)
+        return jnp.sum(linear_cross_entropy(x, w, labels, mode="blocked", block_size=32) * gsc)
 
     def naive(x, w):
         return jnp.sum(_naive(x, w, labels) * gsc)
@@ -68,13 +68,13 @@ def test_ignore_label():
     w = jnp.asarray(rng.randn(v, h), jnp.float32)
     labels = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
     labels = labels.at[::4].set(0)
-    got = linear_cross_entropy(x, w, labels, block_size=16, ignore_label=0)
+    got = linear_cross_entropy(x, w, labels, mode="blocked", block_size=16, ignore_label=0)
     want = _naive(x, w, labels, ignore_label=0)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-5,
                                atol=1e-5)
     # grads of ignored rows must be exactly zero
     def fused(x):
-        return jnp.sum(linear_cross_entropy(x, w, labels, block_size=16,
+        return jnp.sum(linear_cross_entropy(x, w, labels, mode="blocked", block_size=16,
                                             ignore_label=0))
     gx = jax.grad(fused)(x)
     assert np.allclose(np.asarray(gx)[::4], 0.0)
@@ -86,7 +86,7 @@ def test_bf16_inputs_leading_shape():
     x = jnp.asarray(rng.randn(b, s, h), jnp.bfloat16)
     w = jnp.asarray(rng.randn(v, h), jnp.bfloat16)
     labels = jnp.asarray(rng.randint(0, v, (b, s)), jnp.int32)
-    got = linear_cross_entropy(x, w, labels, block_size=64)
+    got = linear_cross_entropy(x, w, labels, mode="blocked", block_size=64)
     assert got.shape == (b, s)
     assert got.dtype == jnp.float32
     want = _naive(x.astype(jnp.float32), w.astype(jnp.float32), labels)
@@ -94,7 +94,7 @@ def test_bf16_inputs_leading_shape():
                                atol=5e-2)
 
     def f(x, w):
-        return jnp.mean(linear_cross_entropy(x, w, labels, block_size=64))
+        return jnp.mean(linear_cross_entropy(x, w, labels, mode="blocked", block_size=64))
     gx, gw = jax.grad(f, argnums=(0, 1))(x, w)
     assert gx.dtype == jnp.bfloat16 and gw.dtype == jnp.bfloat16
     assert np.isfinite(np.asarray(gx, dtype=np.float32)).all()
@@ -107,7 +107,32 @@ def test_jit_and_vs_big_block():
     x = jnp.asarray(rng.randn(n, h), jnp.float32)
     w = jnp.asarray(rng.randn(v, h), jnp.float32)
     labels = jnp.asarray(rng.randint(0, v, (n,)), jnp.int32)
-    f1 = jax.jit(lambda x: linear_cross_entropy(x, w, labels, block_size=16))
-    f2 = jax.jit(lambda x: linear_cross_entropy(x, w, labels, block_size=4096))
+    f1 = jax.jit(lambda x: linear_cross_entropy(x, w, labels, mode="blocked", block_size=16))
+    f2 = jax.jit(lambda x: linear_cross_entropy(x, w, labels, mode="blocked", block_size=4096))
     np.testing.assert_allclose(np.asarray(f1(x)), np.asarray(f2(x)),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_mode_auto_and_dense_parity():
+    """Round-4 auto-select: dense under the byte budget, blocked above;
+    both match the reference computation."""
+    import os
+
+    rng = np.random.default_rng(5)
+    x = jnp.asarray(rng.normal(0, 1, (12, 8)).astype(np.float32))
+    w = jnp.asarray(rng.normal(0, 0.1, (40, 8)).astype(np.float32))
+    labels = jnp.asarray(rng.integers(0, 40, (12,)).astype(np.int32))
+    logits = np.asarray(x) @ np.asarray(w).T
+    ref = (np.log(np.exp(logits - logits.max(1, keepdims=True)).sum(1))
+           + logits.max(1) - logits[np.arange(12), np.asarray(labels)])
+    for mode in ("dense", "blocked", "auto"):
+        got = np.asarray(linear_cross_entropy(x, w, labels, mode=mode))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5,
+                                   err_msg=mode)
+    # auto flips to blocked when the budget is tiny
+    os.environ["MXTPU_CE_DENSE_MAX_BYTES"] = "1"
+    try:
+        got = np.asarray(linear_cross_entropy(x, w, labels, mode="auto"))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    finally:
+        del os.environ["MXTPU_CE_DENSE_MAX_BYTES"]

@@ -1,0 +1,298 @@
+"""linalg_* family, spatial transformer group, im2col/col2im, multi-tensor
+optimizer kernels (round-4 op-breadth tail; reference la_op.cc,
+spatial_transformer.cc, correlation.cc, optimizer_op.cc [unverified])."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+rng = np.random.default_rng(42)
+
+
+def _spd(n, b=()):
+    a = rng.normal(size=b + (n, n)).astype(np.float32)
+    return a @ np.swapaxes(a, -1, -2) + n * np.eye(n, dtype=np.float32)
+
+
+# ------------------------------------------------------------------ linalg
+def test_gemm_gemm2():
+    A = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    B = rng.normal(size=(2, 4, 5)).astype(np.float32)
+    C = rng.normal(size=(2, 3, 5)).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(A), nd.array(B), nd.array(C),
+                         alpha=2.0, beta=0.5).asnumpy()
+    np.testing.assert_allclose(out, 2.0 * A @ B + 0.5 * C, rtol=1e-5)
+    out2 = nd.linalg_gemm2(nd.array(A), nd.array(np.swapaxes(B, 1, 2)),
+                           transpose_b=True).asnumpy()
+    np.testing.assert_allclose(out2, A @ B, rtol=1e-5)
+
+
+def test_potrf_potri_sumlogdiag():
+    A = _spd(5, (3,))
+    L = nd.linalg_potrf(nd.array(A)).asnumpy()
+    np.testing.assert_allclose(L @ np.swapaxes(L, -1, -2), A, rtol=1e-3,
+                               atol=1e-3)
+    Ainv = nd.linalg_potri(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(Ainv, np.linalg.inv(A), rtol=1e-2, atol=1e-3)
+    sld = nd.linalg_sumlogdiag(nd.array(L)).asnumpy()
+    np.testing.assert_allclose(2 * sld, np.linalg.slogdet(A)[1], rtol=1e-4)
+
+
+@pytest.mark.parametrize("transpose", [False, True])
+@pytest.mark.parametrize("rightside", [False, True])
+def test_trsm(transpose, rightside):
+    L = np.tril(rng.normal(size=(4, 4))).astype(np.float32) \
+        + 4 * np.eye(4, dtype=np.float32)
+    B = rng.normal(size=(4, 4)).astype(np.float32)
+    X = nd.linalg_trsm(nd.array(L), nd.array(B), transpose=transpose,
+                       rightside=rightside, alpha=2.0).asnumpy()
+    opA = L.T if transpose else L
+    got = X @ opA if rightside else opA @ X
+    np.testing.assert_allclose(got, 2.0 * B, rtol=1e-3, atol=1e-3)
+
+
+@pytest.mark.parametrize("transpose", [False, True])
+@pytest.mark.parametrize("rightside", [False, True])
+def test_trmm(transpose, rightside):
+    L = np.tril(rng.normal(size=(4, 4))).astype(np.float32)
+    B = rng.normal(size=(4, 4)).astype(np.float32)
+    out = nd.linalg_trmm(nd.array(L), nd.array(B), transpose=transpose,
+                         rightside=rightside, alpha=0.5).asnumpy()
+    opA = L.T if transpose else L
+    ref = 0.5 * (B @ opA if rightside else opA @ B)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_syrk_det_slogdet_inverse_syevd_gelqf():
+    A = rng.normal(size=(3, 4)).astype(np.float32)
+    np.testing.assert_allclose(nd.linalg_syrk(nd.array(A)).asnumpy(),
+                               A @ A.T, rtol=1e-5)
+    S = _spd(4)
+    np.testing.assert_allclose(nd.linalg_det(nd.array(S)).asnumpy(),
+                               np.linalg.det(S), rtol=1e-3)
+    sign, logab = nd.linalg_slogdet(nd.array(S))
+    np.testing.assert_allclose(logab.asnumpy(), np.linalg.slogdet(S)[1],
+                               rtol=1e-4)
+    np.testing.assert_allclose(nd.linalg_inverse(nd.array(S)).asnumpy(),
+                               np.linalg.inv(S), rtol=1e-3, atol=1e-4)
+    U, lam = nd.linalg_syevd(nd.array(S))
+    U, lam = U.asnumpy(), lam.asnumpy()
+    np.testing.assert_allclose(U.T @ np.diag(lam) @ U, S, rtol=1e-3,
+                               atol=1e-3)
+    A2 = rng.normal(size=(3, 5)).astype(np.float32)
+    Lq, Q = nd.linalg_gelqf(nd.array(A2))
+    Lq, Q = Lq.asnumpy(), Q.asnumpy()
+    np.testing.assert_allclose(Lq @ Q, A2, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(Q @ Q.T, np.eye(3), atol=1e-5)
+
+
+def test_diag_trian_roundtrip():
+    A = rng.normal(size=(2, 4, 4)).astype(np.float32)
+    d = nd.linalg_extractdiag(nd.array(A)).asnumpy()
+    np.testing.assert_allclose(d, np.diagonal(A, axis1=-2, axis2=-1))
+    D = nd.linalg_makediag(nd.array(d)).asnumpy()
+    np.testing.assert_allclose(np.diagonal(D, axis1=-2, axis2=-1), d)
+    t = nd.linalg_extracttrian(nd.array(A)).asnumpy()
+    T = nd.linalg_maketrian(nd.array(t)).asnumpy()
+    np.testing.assert_allclose(T, np.tril(A), atol=1e-6)
+    # band offsets round-trip in BOTH directions (review finding: the
+    # positive-offset inversion was broken)
+    for off in (-1, 1, 2):
+        for lower in (True, False):
+            tt = nd.linalg_extracttrian(nd.array(A), offset=off,
+                                        lower=lower).asnumpy()
+            TT = nd.linalg_maketrian(nd.array(tt), offset=off,
+                                     lower=lower).asnumpy()
+            ref = np.tril(A, off) if lower else np.triu(A, off)
+            np.testing.assert_allclose(TT, ref, atol=1e-6,
+                                       err_msg=f"off={off} lower={lower}")
+
+
+def test_potrf_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    S = _spd(3)
+
+    def f(a):
+        return mx.nd.linalg_sumlogdiag(mx.nd.linalg_potrf(a))
+
+    check_numeric_gradient(f, [S], rtol=3e-2, atol=1e-3)
+
+
+# ----------------------------------------------------------------- spatial
+def test_bilinear_sampler_identity():
+    data = rng.normal(size=(2, 3, 8, 8)).astype(np.float32)
+    ys = np.linspace(-1, 1, 8, dtype=np.float32)
+    xs = np.linspace(-1, 1, 8, dtype=np.float32)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    grid = np.broadcast_to(np.stack([gx, gy])[None], (2, 2, 8, 8)).copy()
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_bilinear_sampler_shift_and_oob():
+    data = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+    # shift by exactly one pixel right: x' = x + 2/(W-1)
+    ys = np.linspace(-1, 1, 4, dtype=np.float32)
+    gy, gx = np.meshgrid(ys, ys, indexing="ij")
+    grid = np.stack([gx + 2.0 / 3.0, gy])[None].astype(np.float32)
+    out = nd.BilinearSampler(nd.array(data), nd.array(grid)).asnumpy()
+    np.testing.assert_allclose(out[0, 0, :, :3], data[0, 0, :, 1:],
+                               atol=1e-5)
+    np.testing.assert_allclose(out[0, 0, :, 3], 0.0, atol=1e-5)  # zero pad
+
+
+def test_grid_generator_affine_identity_and_spatial_transformer():
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(5, 7)).asnumpy()
+    assert grid.shape == (2, 2, 5, 7)
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 7),
+                               atol=1e-6)
+    np.testing.assert_allclose(grid[0, 1, :, 0], np.linspace(-1, 1, 5),
+                               atol=1e-6)
+    data = rng.normal(size=(2, 3, 5, 7)).astype(np.float32)
+    out = nd.SpatialTransformer(nd.array(data), nd.array(theta),
+                                target_shape=(5, 7)).asnumpy()
+    np.testing.assert_allclose(out, data, atol=1e-5)
+
+
+def test_grid_generator_warp_zero_flow():
+    flow = np.zeros((1, 2, 4, 6), np.float32)
+    grid = nd.GridGenerator(nd.array(flow), transform_type="warp").asnumpy()
+    np.testing.assert_allclose(grid[0, 0, 0], np.linspace(-1, 1, 6),
+                               atol=1e-6)
+
+
+def test_bilinear_sampler_gradient():
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    data = rng.normal(size=(1, 2, 5, 5)).astype(np.float32)
+    grid = (rng.uniform(-0.8, 0.8, (1, 2, 3, 3))).astype(np.float32)
+
+    def f(d, g):
+        return mx.nd.BilinearSampler(d, g)
+
+    check_numeric_gradient(f, [data, grid], rtol=3e-2, atol=1e-3)
+
+
+def test_correlation_numpy_parity():
+    """out[d](q) = mean_c d1[q] * d2[q + d] with zero padding; output
+    spatial size is the reference's border-cropped grid
+    (Hp - 2*(max_displacement + kernel_radius))."""
+    d1 = rng.normal(size=(1, 3, 5, 5)).astype(np.float32)
+    d2 = rng.normal(size=(1, 3, 5, 5)).astype(np.float32)
+    p, md = 1, 1
+    out = nd.Correlation(nd.array(d1), nd.array(d2), kernel_size=1,
+                         max_displacement=md, pad_size=p).asnumpy()
+    # padded 7x7 grid, border md+kr=1 cropped on each side -> 5x5
+    assert out.shape == (1, 9, 5, 5)
+    a = np.pad(d1, ((0, 0), (0, 0), (p, p), (p, p)))
+    b = np.pad(d2, ((0, 0), (0, 0), (p, p), (p, p)))
+    Hp = 5 + 2 * p
+    border = md
+    ch = 0
+    for dy in (-1, 0, 1):
+        for dx in (-1, 0, 1):
+            ref = np.zeros((Hp, Hp), np.float32)
+            for y in range(Hp):
+                for x in range(Hp):
+                    yy, xx = y + dy, x + dx
+                    if 0 <= yy < Hp and 0 <= xx < Hp:
+                        ref[y, x] = np.dot(a[0, :, y, x], b[0, :, yy, xx]) / 3
+            np.testing.assert_allclose(
+                out[0, ch], ref[border:Hp - border, border:Hp - border],
+                atol=1e-5, err_msg=f"disp ({dy},{dx})")
+            ch += 1
+    # self-correlation: zero displacement dominates globally (C-S)
+    outs = nd.Correlation(nd.array(d1), nd.array(d1), kernel_size=1,
+                          max_displacement=1, pad_size=1).asnumpy()
+    sums = outs.sum(axis=(0, 2, 3))
+    assert sums[4] >= sums.max() - 1e-5
+
+
+def test_im2col_col2im():
+    x = rng.normal(size=(2, 3, 6, 6)).astype(np.float32)
+    cols = nd.im2col(nd.array(x), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1)).asnumpy()
+    assert cols.shape == (2, 27, 36)
+    # parity vs a conv: conv(x, W) == W_flat @ im2col(x)
+    W = rng.normal(size=(4, 3, 3, 3)).astype(np.float32)
+    ref = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(x), jnp.asarray(W), (1, 1), [(1, 1), (1, 1)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    got = np.einsum("ok,nkl->nol", W.reshape(4, 27), cols).reshape(ref.shape)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    # col2im is the exact adjoint: <col2im(c), y> == <c, im2col(y)>
+    c = rng.normal(size=(2, 27, 36)).astype(np.float32)
+    y = rng.normal(size=(2, 3, 6, 6)).astype(np.float32)
+    back = nd.col2im(nd.array(c), input_shape=(2, 3, 6, 6), kernel=(3, 3),
+                     stride=(1, 1), pad=(1, 1)).asnumpy()
+    lhs = np.sum(back * y)
+    rhs = np.sum(c * nd.im2col(nd.array(y), kernel=(3, 3), stride=(1, 1),
+                               pad=(1, 1)).asnumpy())
+    np.testing.assert_allclose(lhs, rhs, rtol=1e-4)
+
+
+# ------------------------------------------------------------ multi-tensor
+def test_multi_sum_sq_and_lars():
+    ws = [rng.normal(size=(4, 5)).astype(np.float32) for _ in range(3)]
+    out = nd.multi_sum_sq(*[nd.array(w) for w in ws],
+                          num_arrays=3).asnumpy()
+    np.testing.assert_allclose(out, [np.sum(w * w) for w in ws], rtol=1e-5)
+    lrs = np.array([0.1, 0.2, 0.3], np.float32)
+    wds = np.array([1e-4, 0.0, 1e-4], np.float32)
+    wss = np.array([np.sum(w * w) for w in ws], np.float32)
+    gss = wss * 0.5
+    got = nd.multi_lars(nd.array(lrs), nd.array(wss), nd.array(gss),
+                        nd.array(wds), eta=0.01).asnumpy()
+    coef = 0.01 * np.sqrt(wss) / (np.sqrt(gss) + wds * np.sqrt(wss) + 1e-8)
+    np.testing.assert_allclose(got, lrs * coef, rtol=1e-5)
+
+
+def test_multi_sgd_parity_with_single():
+    ws = [rng.normal(size=(3, 3)).astype(np.float32) for _ in range(2)]
+    gs = [rng.normal(size=(3, 3)).astype(np.float32) for _ in range(2)]
+    outs = nd.multi_sgd_update(
+        nd.array(ws[0]), nd.array(gs[0]), nd.array(ws[1]), nd.array(gs[1]),
+        lrs=(0.1, 0.2), wds=(0.0, 1e-3), num_weights=2)
+    for i, o in enumerate(outs):
+        ref = nd.sgd_update(nd.array(ws[i]), nd.array(gs[i]),
+                            lr=(0.1, 0.2)[i], wd=(0.0, 1e-3)[i]).asnumpy()
+        np.testing.assert_allclose(o.asnumpy(), ref, rtol=1e-6)
+
+
+def test_multi_sgd_mom_and_mp():
+    w = rng.normal(size=(4,)).astype(np.float32)
+    g = rng.normal(size=(4,)).astype(np.float32)
+    m = np.zeros(4, np.float32)
+    w_, m_ = nd.multi_sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                     lrs=0.1, momentum=0.9, num_weights=1)
+    ref_w, ref_m = nd.sgd_mom_update(nd.array(w), nd.array(g), nd.array(m),
+                                     lr=0.1, momentum=0.9)
+    np.testing.assert_allclose(w_.asnumpy(), ref_w.asnumpy(), rtol=1e-6)
+    wb = w.astype(jnp.bfloat16)
+    outs = nd.multi_mp_sgd_update(nd.array(np.asarray(wb, np.float32)
+                                           .astype(np.float32)),
+                                  nd.array(g), nd.array(w),
+                                  lrs=0.1, num_weights=1)
+    np.testing.assert_allclose(outs[1].asnumpy(), w - 0.1 * g, rtol=1e-6)
+
+
+def test_add_n_swapaxes_reshape_like():
+    a = rng.normal(size=(2, 3)).astype(np.float32)
+    b = rng.normal(size=(2, 3)).astype(np.float32)
+    np.testing.assert_allclose(nd.add_n(nd.array(a), nd.array(b)).asnumpy(),
+                               a + b, rtol=1e-6)
+    x = rng.normal(size=(2, 3, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.swapaxes(nd.array(x), dim1=0, dim2=2).asnumpy(),
+        np.swapaxes(x, 0, 2))
+    np.testing.assert_allclose(
+        nd.reshape_like(nd.array(x), nd.array(np.zeros((4, 6)))).asnumpy(),
+        x.reshape(4, 6))
