@@ -46,6 +46,18 @@ def _quantize_symmetric(arr):
     return q, scale
 
 
+def _quantize_per_channel(w):
+    """Per-OUTPUT-channel symmetric int8 for conv weights (O, I, kh, kw)
+    — the reference's channel-wise weight path: one scale per filter,
+    recovering the dynamic range a single outlier filter would otherwise
+    destroy. Returns (q int8, scales (O,) f32)."""
+    amax = jnp.max(jnp.abs(w.reshape(w.shape[0], -1)), axis=1)
+    scales = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scales.reshape(-1, 1, 1, 1)),
+                 -127, 127).astype(jnp.int8)
+    return q, scales.astype(jnp.float32)
+
+
 def _quantize_act(x, scale):
     return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
 
@@ -236,6 +248,126 @@ class QuantizedConv2D:
         return out
 
 
+class QTensor:
+    """An int8 activation + its scale flowing BETWEEN quantized units
+    (the reference's requantized INT8 graph edges). Only produced when
+    the next unit is known to consume it."""
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = float(scale)
+
+
+class QuantizedConvUnit:
+    """One INT8 execution unit: Conv2D [+ folded BatchNorm] [+ relu]
+    [+ MaxPool2D], with per-output-channel weight scales and requantized
+    int8 output when the next unit consumes int8.
+
+    Math: int8 conv -> int32 acc; the BN eval affine folds into the
+    per-channel dequant multiplier M_c = s_in * s_w[c] * gamma_c /
+    sqrt(var_c + eps) and bias B_c (reference INT8 conv+bn+relu
+    subgraph); relu in f32; when emitting int8, requantize at the
+    calibrated OUTPUT scale and run max-pooling ON THE CODES (max and
+    requantize commute — bit-identical to pooling in f32 first)."""
+
+    def __init__(self, conv, bn, act_kind, pool, act_min, act_max,
+                 out_min, out_max, emit_q=False):
+        kw = conv._kwargs
+        if kw.get("layout", "NCHW")[-1] == "C":
+            raise MXNetError("QuantizedConvUnit: channel-first only")
+        w = conv.weight.data().data
+        self._w_q, w_scales = _quantize_per_channel(w)
+        bias = conv.bias.data().data if conv.bias is not None else None
+        if bn is not None:
+            gamma = bn.gamma.data().data
+            beta = bn.beta.data().data
+            mean = bn.running_mean.data().data
+            var = bn.running_var.data().data
+            eps = bn._kwargs.get("eps", 1e-5)
+            bscale = gamma * jax.lax.rsqrt(var + eps)
+            self._mult = (w_scales * bscale).astype(jnp.float32)
+            shift = beta - mean * bscale
+            self._bias = shift if bias is None else bias * bscale + shift
+        else:
+            self._mult = w_scales
+            self._bias = bias
+        self._act_scale = float(_np.asarray(
+            _scale_from_range(jnp.asarray(act_min), jnp.asarray(act_max))))
+        self._out_scale = float(_np.asarray(
+            _scale_from_range(jnp.asarray(out_min), jnp.asarray(out_max))))
+        self._relu = act_kind == "relu"
+        self._pool_kw = dict(pool._kwargs) if pool is not None else None
+        self._kw = dict(kw)
+        self.emit_q = emit_q
+
+    def __call__(self, x):
+        from ..imperative import invoke_fn
+
+        if isinstance(x, QTensor):
+            s_in, x_in, preq = x.scale, x.q, True
+        else:
+            s_in, x_in, preq = self._act_scale, x, False
+        kw = self._kw
+
+        def fwd(xd):
+            x_q = xd if preq else _quantize_act(xd, s_in)
+            nd_sp = x_q.ndim - 2
+            spatial = "DHW"[-nd_sp:]
+            stride = kw.get("stride") or (1,) * nd_sp
+            dilate = kw.get("dilate") or (1,) * nd_sp
+            pad = kw.get("pad") or (0,) * nd_sp
+            acc = jax.lax.conv_general_dilated(
+                x_q, self._w_q,
+                window_strides=tuple(stride),
+                padding=[(p, p) for p in pad],
+                rhs_dilation=tuple(dilate),
+                dimension_numbers=("NC" + spatial, "OI" + spatial,
+                                   "NC" + spatial),
+                feature_group_count=kw.get("num_group", 1),
+                preferred_element_type=jnp.int32,
+            )
+            mult = (s_in * self._mult).reshape((1, -1) + (1,) * nd_sp)
+            out = acc.astype(jnp.float32) * mult
+            if self._bias is not None:
+                out = out + self._bias.reshape((1, -1) + (1,) * nd_sp)
+            if self._relu:
+                out = jnp.maximum(out, 0.0)
+            if self.emit_q:
+                oq = _quantize_act(out, self._out_scale)
+                if self._pool_kw is not None:
+                    oq = self._pool_int8(oq)
+                return oq
+            if self._pool_kw is not None:
+                out = self._pool_f32(out)
+            return out
+
+        out = invoke_fn(fwd, x_in)
+        if self.emit_q:
+            return QTensor(out, self._out_scale)
+        return out
+
+    def _pool_int8(self, q):
+        pk = self._pool_kw
+        k = pk["kernel"]
+        s = pk["stride"]
+        p = pk["pad"]
+        return jax.lax.reduce_window(
+            q, jnp.int8(-128), jax.lax.max,
+            (1, 1) + tuple(k), (1, 1) + tuple(s),
+            ((0, 0), (0, 0)) + tuple((x, x) for x in p),
+        )
+
+    def _pool_f32(self, out):
+        pk = self._pool_kw
+        return jax.lax.reduce_window(
+            out, -jnp.inf, jax.lax.max,
+            (1, 1) + tuple(pk["kernel"]), (1, 1) + tuple(pk["stride"]),
+            ((0, 0), (0, 0)) + tuple((x, x) for x in pk["pad"]),
+        )
+
+
 def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
     """Activation ranges of each target layer's INPUT over the
     calibration batches. ``mode``: 'naive' (min/max, the reference
@@ -299,58 +431,218 @@ def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
     return {k: (v[0], v[1]) for k, v in ranges.items()}
 
 
-def quantize_net(net, calib_data=None, exclude=(), calib_mode="naive"):
-    """Replace every calibrated ``Dense``/``Conv2D`` child with its INT8
-    twin in-place; returns the rewritten net (reference:
-    ``quantize_model``'s graph rewrite, gluon-style). Runs ``calib_data``
-    through the net for activation ranges (required);
-    ``calib_mode``: 'naive' min/max or 'entropy' KL-optimal."""
+def _collect_units(net, exclude, report):
+    """Walk containers, grouping each Conv2D with its immediately
+    following BatchNorm / Activation(relu) / MaxPool2D siblings into one
+    INT8 unit (the reference's fused quantized subgraph); Dense layers
+    are single-layer units. Returns [(container, [child names], head,
+    parts dict)] in forward order per container."""
     from ..gluon.nn import Dense
-    from ..gluon.nn.conv_layers import Conv2D
+    from ..gluon.nn.activations import Activation
+    from ..gluon.nn.basic_layers import BatchNorm
+    from ..gluon.nn.conv_layers import Conv2D, MaxPool2D
 
-    target_layers = []
+    units = []
 
-    def collect(block):
-        for child in block._children.values():
-            if isinstance(child, (Dense, Conv2D)) and child not in exclude:
-                if isinstance(child, Conv2D) and \
-                        child._kwargs.get("layout", "NCHW")[-1] == "C":
-                    pass  # channel-last conv: left in float (unsupported)
-                else:
-                    target_layers.append(child)
-            collect(child)
+    def walk(block, path):
+        children = list(block._children.items())
+        i = 0
+        while i < len(children):
+            name, child = children[i]
+            cpath = f"{path}.{name}" if path else name
+            if child in exclude:
+                report.append((cpath, type(child).__name__, "float",
+                               "excluded by caller"))
+                i += 1
+                continue
+            if isinstance(child, Conv2D):
+                if child._kwargs.get("layout", "NCHW")[-1] == "C":
+                    report.append((cpath, "Conv2D", "float",
+                                   "channel-last layout unsupported"))
+                    i += 1
+                    continue
+                parts = {"conv": child, "bn": None, "act": None,
+                         "pool": None, "names": [name]}
+                if child.act is not None:
+                    act_name = getattr(child.act, "_act_type", None) or \
+                        getattr(child.act, "act_type", None)
+                    if act_name != "relu":
+                        report.append((cpath, "Conv2D", "float",
+                                       f"activation {act_name!r} not "
+                                       "int8-fusable (relu only)"))
+                        i += 1
+                        continue
+                    parts["act"] = "relu"
+                j = i + 1
+                while j < len(children):
+                    nxt = children[j][1]
+                    if isinstance(nxt, BatchNorm) and parts["bn"] is None \
+                            and parts["act"] is None and parts["pool"] is None:
+                        parts["bn"] = nxt
+                    elif isinstance(nxt, Activation) and parts["act"] is None \
+                            and parts["pool"] is None and \
+                            getattr(nxt, "_act_type", None) == "relu":
+                        parts["act"] = "relu"
+                    elif isinstance(nxt, MaxPool2D) and parts["pool"] is None:
+                        parts["pool"] = nxt
+                    else:
+                        break
+                    parts["names"].append(children[j][0])
+                    j += 1
+                units.append((block, cpath, parts))
+                i = j
+                continue
+            if isinstance(child, Dense):
+                units.append((block, cpath,
+                              {"dense": child, "names": [name]}))
+                i += 1
+                continue
+            walk(child, cpath)
+            i += 1
 
-    collect(net)
-    if not target_layers:
+    walk(net, "")
+    return units
+
+
+def quantize_net(net, calib_data=None, exclude=(), calib_mode="naive",
+                 verbose=False):
+    """Rewrite the net with INT8 execution units in-place and return it
+    (reference: ``quantize_model``'s graph rewrite, gluon-style).
+
+    Round-4 depth: Conv2D units absorb an immediately following
+    BatchNorm (eval-affine folded into the per-output-channel requantize
+    multiplier), relu, and MaxPool2D; consecutive quantized units pass
+    requantized int8 activations directly (max-pooling runs on the int8
+    codes), so a conv stack stays int8 end-to-end. Weight scales are
+    per output channel for convs, per tensor for Dense.
+
+    Every considered layer lands in ``net._quantization_report`` as
+    (path, kind, 'int8'|'int8-chained'|'float', detail); ``verbose=True``
+    prints the table (what stayed float and WHY)."""
+    report = []
+    units = _collect_units(net, exclude, report)
+    if not units:
         raise MXNetError("quantize_net: no Dense/Conv2D layers to quantize")
     if calib_data is None:
         raise MXNetError("quantize_net needs calibration data")
-    ranges = calib_ranges(net, calib_data, target_layers, mode=calib_mode)
+    heads = [u[2].get("conv") or u[2]["dense"] for u in units]
+    tails = []
+    for _, _, parts in units:
+        tail = parts.get("pool") or parts.get("bn") or \
+            parts.get("conv") or parts.get("dense")
+        # the unit's OUTPUT range is observed after its last sibling;
+        # conv.act runs inside the conv block so conv is still the tail
+        tails.append(tail)
+    ranges = calib_ranges(net, calib_data, heads, mode=calib_mode)
+    out_ranges = _calib_outputs(net, calib_data, tails)
 
-    def rewrite(block):
-        for name, child in list(block._children.items()):
-            if id(child) in ranges and isinstance(child, (Dense, Conv2D)):
-                lo, hi = ranges[id(child)]
-                if isinstance(child, Dense):
-                    newb = _QuantizedDenseBlock(
-                        QuantizedDense(child, lo, hi))
-                else:
-                    newb = _QuantizedDenseBlock(
-                        QuantizedConv2D(child, lo, hi))
-                block._children[name] = newb
-                # attribute-style blocks (self.fc = Dense(...)) call the
-                # child through the instance attribute, not _children —
-                # swap every attribute referencing the old layer too
-                for attr, val in list(vars(block).items()):
-                    if val is child:
-                        object.__setattr__(block, attr, newb)
-            else:
-                rewrite(child)
+    # chain detection: unit k feeds unit k+1 directly when they are
+    # consecutive children of the SAME container
+    feeds_next = []
+    for k, (block, _, parts) in enumerate(units):
+        nxt = units[k + 1] if k + 1 < len(units) else None
+        direct = False
+        if nxt is not None and nxt[0] is block and "conv" in parts \
+                and "conv" in nxt[2]:
+            names = list(block._children.keys())
+            direct = names.index(nxt[2]["names"][0]) == \
+                names.index(parts["names"][-1]) + 1
+        feeds_next.append(direct)
 
-    rewrite(net)
+    for k, (block, cpath, parts) in enumerate(units):
+        head = parts.get("conv") or parts["dense"]
+        if id(head) not in ranges:
+            report.append((cpath, type(head).__name__, "float",
+                           "never reached by calibration data"))
+            continue
+        lo, hi = ranges[id(head)]
+        if "dense" in parts:
+            newb = _QuantizedDenseBlock(QuantizedDense(parts["dense"],
+                                                       lo, hi))
+            _swap(block, parts["names"][0], newb)
+            report.append((cpath, "Dense", "int8",
+                           "per-tensor weights"))
+            continue
+        olo, ohi = out_ranges.get(id(tails[k]), (lo, hi))
+        unit = QuantizedConvUnit(
+            parts["conv"], parts["bn"], parts["act"], parts["pool"],
+            lo, hi, olo, ohi, emit_q=feeds_next[k])
+        newb = _QuantizedDenseBlock(unit)
+        _swap(block, parts["names"][0], newb)
+        for extra in parts["names"][1:]:
+            _swap(block, extra, _identity_block())
+        fused = [p for p in ("bn", "act", "pool") if parts.get(p)]
+        status = "int8-chained" if feeds_next[k] else "int8"
+        report.append((cpath, "Conv2D", status,
+                       "per-channel weights"
+                       + (f", fused {'+'.join(fused)}" if fused else "")
+                       + (", int8 handoff to next unit"
+                          if feeds_next[k] else "")))
+
     if hasattr(net, "_clear_cached_op"):
         net._clear_cached_op()
+    net._quantization_report = report
+    if verbose:
+        print(f"{'layer':40s} {'kind':8s} {'status':13s} detail")
+        for path, kind, status, detail in report:
+            print(f"{path:40s} {kind:8s} {status:13s} {detail}")
+        n_q = sum(1 for r in report if r[2].startswith("int8"))
+        print(f"quantized {n_q}/{len(report)} considered layers")
     return net
+
+
+def _calib_outputs(net, calib_data, tails):
+    out: Dict[int, List[float]] = {}
+    hooks = []
+
+    def make_hook(key):
+        def hook(block, inputs, output):
+            x = output[0] if isinstance(output, (list, tuple)) else output
+            arr = _np.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+            lo, hi = float(arr.min()), float(arr.max())
+            if key in out:
+                out[key][0] = min(out[key][0], lo)
+                out[key][1] = max(out[key][1], hi)
+            else:
+                out[key] = [lo, hi]
+
+        return hook
+
+    for t in tails:
+        hooks.append(t.register_forward_hook(make_hook(id(t))))
+    try:
+        for batch in calib_data:
+            x = batch[0] if isinstance(batch, (list, tuple)) else batch
+            net(x)
+    finally:
+        for h in hooks:
+            h.detach()
+    return {k: (v[0], v[1]) for k, v in out.items()}
+
+
+def _swap(block, name, newb):
+    child = block._children[name]
+    block._children[name] = newb
+    # attribute-style blocks (self.fc = Dense(...)) call the child
+    # through the instance attribute, not _children — swap those too
+    for attr, val in list(vars(block).items()):
+        if val is child:
+            object.__setattr__(block, attr, newb)
+
+
+def _identity_block():
+    from ..gluon.block import Block
+
+    class _Identity(Block):
+        """Placeholder for siblings folded into a QuantizedConvUnit."""
+
+        def __init__(self):
+            super().__init__(prefix="qfolded_", params=None)
+
+        def forward(self, x, *args):
+            return x
+
+    return _Identity()
 
 
 # the ops above registered after mx.nd was generated at package import:

@@ -200,3 +200,112 @@ class TestQuantizedConv:
         r = calib_ranges(net, [small, big], [conv], mode="entropy")
         (_, hi), = r.values()
         assert hi > 2.0, f"threshold {hi} stuck at first batch's range"
+
+
+class TestQuantDepthRound4:
+    """Per-channel conv scales, BN folding, int8 requantize chains, and
+    the per-layer coverage report (round-4 depth items)."""
+
+    def _make_cnn(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon
+
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(
+                gluon.nn.Conv2D(8, kernel_size=3, padding=1, in_channels=1),
+                gluon.nn.BatchNorm(in_channels=8),
+                gluon.nn.Activation("relu"),
+                gluon.nn.Conv2D(16, kernel_size=3, padding=1,
+                                in_channels=8, activation="relu"),
+                gluon.nn.MaxPool2D(2, 2),
+                gluon.nn.Flatten(),
+                gluon.nn.Dense(4),
+            )
+        net.initialize(mx.initializer.Xavier())
+        return net
+
+    def _synthetic(self, n=256, seed=0):
+        # 4-class synthetic: quadrant of the bright blob in an 8x8 image
+        rng = np.random.RandomState(seed)
+        x = rng.rand(n, 1, 8, 8).astype(np.float32) * 0.3
+        y = rng.randint(0, 4, n)
+        for i, cls in enumerate(y):
+            r, c = divmod(int(cls), 2)
+            x[i, 0, r * 4:r * 4 + 4, c * 4:c * 4 + 4] += 1.0
+        return x, y.astype(np.float32)
+
+    def test_int8_chain_accuracy_within_1pct(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import autograd, gluon, nd
+        from mxnet_tpu.contrib.quantization import quantize_net
+
+        mx.random.seed(0)
+        net = self._make_cnn()
+        x, y = self._synthetic(256)
+        xt, yt = nd.array(x), nd.array(y)
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                {"learning_rate": 0.1, "momentum": 0.9})
+        for _ in range(30):
+            with autograd.record():
+                loss = loss_fn(net(xt), yt)
+            loss.backward()
+            trainer.step(256)
+        xe, ye = self._synthetic(512, seed=1)
+        float_pred = net(nd.array(xe)).asnumpy().argmax(1)
+        float_acc = (float_pred == ye).mean()
+        assert float_acc > 0.95, f"float net undertrained: {float_acc}"
+
+        qnet = quantize_net(net, calib_data=[xt], verbose=True)
+        report = qnet._quantization_report
+        # both convs int8, first one chained into the second
+        conv_rows = [r for r in report if r[1] == "Conv2D"]
+        assert len(conv_rows) == 2
+        assert conv_rows[0][2] == "int8-chained", conv_rows
+        assert conv_rows[1][2] == "int8", conv_rows
+        assert "fused bn+act" in conv_rows[0][3]
+        assert "fused pool" in conv_rows[1][3] or "pool" in conv_rows[1][3]
+        dense_rows = [r for r in report if r[1] == "Dense"]
+        assert len(dense_rows) == 1 and dense_rows[0][2] == "int8"
+
+        q_pred = qnet(nd.array(xe)).asnumpy().argmax(1)
+        q_acc = (q_pred == ye).mean()
+        assert q_acc >= float_acc - 0.01, \
+            f"int8 accuracy {q_acc} dropped >1% below float {float_acc}"
+
+    def test_report_names_float_leftovers(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import gluon, nd
+        from mxnet_tpu.contrib.quantization import quantize_net
+
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Conv2D(4, kernel_size=3, in_channels=1,
+                                    activation="tanh"),  # not fusable
+                    gluon.nn.Flatten(),
+                    gluon.nn.Dense(3))
+        net.initialize()
+        x = nd.array(np.random.rand(4, 1, 6, 6).astype(np.float32))
+        net(x)
+        qnet = quantize_net(net, calib_data=[x])
+        report = qnet._quantization_report
+        tanh_rows = [r for r in report if "tanh" in r[3]]
+        assert tanh_rows and tanh_rows[0][2] == "float"
+
+    def test_per_channel_scales_beat_per_tensor_on_outlier_filters(self):
+        from mxnet_tpu.contrib.quantization import (_quantize_per_channel,
+                                                    _quantize_symmetric)
+
+        rng = np.random.RandomState(0)
+        w = rng.randn(8, 4, 3, 3).astype(np.float32) * 0.01
+        w[0] *= 100.0  # outlier filter destroys the per-tensor scale
+        import jax.numpy as jnp
+
+        qc, sc = _quantize_per_channel(jnp.asarray(w))
+        qt, st = _quantize_symmetric(jnp.asarray(w))
+        rec_c = np.asarray(qc, np.float32) * np.asarray(sc).reshape(-1, 1, 1, 1)
+        rec_t = np.asarray(qt, np.float32) * st
+        err_c = np.abs(rec_c[1:] - w[1:]).max()
+        err_t = np.abs(rec_t[1:] - w[1:]).max()
+        assert err_c < err_t / 10
