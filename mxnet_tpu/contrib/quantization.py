@@ -301,6 +301,9 @@ class QuantizedConvUnit:
         self._pool_kw = dict(pool._kwargs) if pool is not None else None
         self._kw = dict(kw)
         self.emit_q = emit_q
+        # non-relu conv activation (tanh/sigmoid/...): applied in f32
+        # after dequant, exactly as the pre-fusion QuantizedConv2D did
+        self.post_act = None
 
     def __call__(self, x):
         from ..imperative import invoke_fn
@@ -346,6 +349,8 @@ class QuantizedConvUnit:
         out = invoke_fn(fwd, x_in)
         if self.emit_q:
             return QTensor(out, self._out_scale)
+        if self.post_act is not None:
+            out = self.post_act(out)
         return out
 
     def _pool_int8(self, q):
@@ -368,11 +373,14 @@ class QuantizedConvUnit:
         )
 
 
-def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
+def calib_ranges(net, calib_data, layers, mode="naive", out_layers=None):
     """Activation ranges of each target layer's INPUT over the
     calibration batches. ``mode``: 'naive' (min/max, the reference
     default) or 'entropy' (KL-optimal symmetric threshold).
-    ``layers``: list of Dense/Conv2D blocks."""
+    ``layers``: list of Dense/Conv2D blocks. ``out_layers``: blocks whose
+    OUTPUT min/max is also wanted (chained-unit requantize scales) —
+    observed in the SAME forward pass; when given, returns
+    (input_ranges, output_ranges)."""
     if mode not in ("naive", "entropy"):
         raise MXNetError(
             f"unknown calibration mode {mode!r}; use 'naive' or 'entropy'"
@@ -403,8 +411,25 @@ def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
 
         return hook
 
+    out_ranges: Dict[int, List[float]] = {}
+
+    def make_out_hook(key):
+        def hook(block, inputs, output):
+            x = output[0] if isinstance(output, (list, tuple)) else output
+            arr = _np.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
+            lo, hi = float(arr.min()), float(arr.max())
+            if key in out_ranges:
+                out_ranges[key][0] = min(out_ranges[key][0], lo)
+                out_ranges[key][1] = max(out_ranges[key][1], hi)
+            else:
+                out_ranges[key] = [lo, hi]
+
+        return hook
+
     for layer in layers:
         hooks.append(layer.register_forward_pre_hook(make_hook(id(layer))))
+    for layer in (out_layers or ()):
+        hooks.append(layer.register_forward_hook(make_out_hook(id(layer))))
     try:
         for batch in calib_data:
             x = batch[0] if isinstance(batch, (list, tuple)) else batch
@@ -412,6 +437,12 @@ def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
     finally:
         for h in hooks:
             h.detach()
+
+    def _ret(inp):
+        if out_layers is None:
+            return inp
+        return inp, {k: (v[0], v[1]) for k, v in out_ranges.items()}
+
     if mode == "entropy":
         out = {}
         for k, v in ranges.items():
@@ -427,8 +458,8 @@ def calib_ranges(net, calib_data, layers, mode="naive") -> Dict[int, tuple]:
                 _np.add.at(merged, idx, h)
             t = entropy_threshold(merged, gmax / NBINS)
             out[k] = (-t, t)
-        return out
-    return {k: (v[0], v[1]) for k, v in ranges.items()}
+        return _ret(out)
+    return _ret({k: (v[0], v[1]) for k, v in ranges.items()})
 
 
 def _collect_units(net, exclude, report):
@@ -439,7 +470,8 @@ def _collect_units(net, exclude, report):
     parts dict)] in forward order per container."""
     from ..gluon.nn import Dense
     from ..gluon.nn.activations import Activation
-    from ..gluon.nn.basic_layers import BatchNorm
+    from ..gluon.nn.basic_layers import BatchNorm, HybridSequential, \
+        Sequential
     from ..gluon.nn.conv_layers import Conv2D, MaxPool2D
 
     units = []
@@ -462,20 +494,28 @@ def _collect_units(net, exclude, report):
                     i += 1
                     continue
                 parts = {"conv": child, "bn": None, "act": None,
-                         "pool": None, "names": [name]}
+                         "post_act": None, "pool": None, "tail": child,
+                         "names": [name]}
+                fusable = True
                 if child.act is not None:
                     act_name = getattr(child.act, "_act_type", None) or \
                         getattr(child.act, "act_type", None)
-                    if act_name != "relu":
-                        report.append((cpath, "Conv2D", "float",
-                                       f"activation {act_name!r} not "
-                                       "int8-fusable (relu only)"))
-                        i += 1
-                        continue
-                    parts["act"] = "relu"
+                    if act_name == "relu":
+                        parts["act"] = "relu"
+                    else:
+                        # non-relu act: quantize the conv, apply the act
+                        # in f32 after dequant (pre-round-4 behavior);
+                        # no sibling folding / no int8 handoff
+                        parts["post_act"] = child.act
+                        fusable = False
                 j = i + 1
-                while j < len(children):
+                # sibling folding is only meaningful where execution
+                # order == child order: Sequential containers
+                seq = isinstance(block, (Sequential, HybridSequential))
+                while fusable and seq and j < len(children):
                     nxt = children[j][1]
+                    if nxt in exclude:
+                        break  # honor the caller's opt-out: stop folding
                     if isinstance(nxt, BatchNorm) and parts["bn"] is None \
                             and parts["act"] is None and parts["pool"] is None:
                         parts["bn"] = nxt
@@ -483,10 +523,16 @@ def _collect_units(net, exclude, report):
                             and parts["pool"] is None and \
                             getattr(nxt, "_act_type", None) == "relu":
                         parts["act"] = "relu"
-                    elif isinstance(nxt, MaxPool2D) and parts["pool"] is None:
+                    elif isinstance(nxt, MaxPool2D) and parts["pool"] is None \
+                            and nxt._kwargs.get("pooling_convention",
+                                                "valid") == "valid" \
+                            and nxt._kwargs.get("layout", "NCHW") == "NCHW":
+                        # ceil_mode ('full') pooling has different output
+                        # sizes than reduce_window: left unfolded
                         parts["pool"] = nxt
                     else:
                         break
+                    parts["tail"] = nxt
                     parts["names"].append(children[j][0])
                     j += 1
                 units.append((block, cpath, parts))
@@ -519,6 +565,8 @@ def quantize_net(net, calib_data=None, exclude=(), calib_mode="naive",
     Every considered layer lands in ``net._quantization_report`` as
     (path, kind, 'int8'|'int8-chained'|'float', detail); ``verbose=True``
     prints the table (what stayed float and WHY)."""
+    from ..gluon.nn.basic_layers import HybridSequential, Sequential
+
     report = []
     units = _collect_units(net, exclude, report)
     if not units:
@@ -526,28 +574,36 @@ def quantize_net(net, calib_data=None, exclude=(), calib_mode="naive",
     if calib_data is None:
         raise MXNetError("quantize_net needs calibration data")
     heads = [u[2].get("conv") or u[2]["dense"] for u in units]
-    tails = []
-    for _, _, parts in units:
-        tail = parts.get("pool") or parts.get("bn") or \
-            parts.get("conv") or parts.get("dense")
-        # the unit's OUTPUT range is observed after its last sibling;
-        # conv.act runs inside the conv block so conv is still the tail
-        tails.append(tail)
-    ranges = calib_ranges(net, calib_data, heads, mode=calib_mode)
-    out_ranges = _calib_outputs(net, calib_data, tails)
 
-    # chain detection: unit k feeds unit k+1 directly when they are
-    # consecutive children of the SAME container
+    # chain detection FIRST (decides which output hooks are needed):
+    # unit k hands int8 to unit k+1 only when both are consecutive
+    # children of the SAME Sequential container (execution order ==
+    # child order there, and nowhere else — parallel-branch containers
+    # like squeezenet's concat blocks must not chain) and neither side
+    # carries a non-relu activation
     feeds_next = []
     for k, (block, _, parts) in enumerate(units):
         nxt = units[k + 1] if k + 1 < len(units) else None
         direct = False
-        if nxt is not None and nxt[0] is block and "conv" in parts \
-                and "conv" in nxt[2]:
+        if nxt is not None and nxt[0] is block \
+                and isinstance(block, (Sequential, HybridSequential)) \
+                and "conv" in parts and "conv" in nxt[2] \
+                and parts.get("post_act") is None \
+                and nxt[2].get("post_act") is None:
             names = list(block._children.keys())
             direct = names.index(nxt[2]["names"][0]) == \
                 names.index(parts["names"][-1]) + 1
         feeds_next.append(direct)
+
+    # ONE calibration pass: input ranges for every head + output ranges
+    # for the tails of units that will actually chain. The tail is the
+    # last FOLDED sibling (activation included), so the observed range
+    # is post-relu — exactly what the emitted int8 codes carry.
+    chain_tails = [u[2]["tail"] for k, u in enumerate(units)
+                   if feeds_next[k]]
+    ranges, out_ranges = calib_ranges(net, calib_data, heads,
+                                      mode=calib_mode,
+                                      out_layers=chain_tails)
 
     for k, (block, cpath, parts) in enumerate(units):
         head = parts.get("conv") or parts["dense"]
@@ -563,21 +619,24 @@ def quantize_net(net, calib_data=None, exclude=(), calib_mode="naive",
             report.append((cpath, "Dense", "int8",
                            "per-tensor weights"))
             continue
-        olo, ohi = out_ranges.get(id(tails[k]), (lo, hi))
+        olo, ohi = out_ranges.get(id(parts["tail"]), (lo, hi))
         unit = QuantizedConvUnit(
             parts["conv"], parts["bn"], parts["act"], parts["pool"],
             lo, hi, olo, ohi, emit_q=feeds_next[k])
+        if parts.get("post_act") is not None:
+            unit.post_act = parts["post_act"]
         newb = _QuantizedDenseBlock(unit)
         _swap(block, parts["names"][0], newb)
         for extra in parts["names"][1:]:
             _swap(block, extra, _identity_block())
         fused = [p for p in ("bn", "act", "pool") if parts.get(p)]
         status = "int8-chained" if feeds_next[k] else "int8"
-        report.append((cpath, "Conv2D", status,
-                       "per-channel weights"
-                       + (f", fused {'+'.join(fused)}" if fused else "")
-                       + (", int8 handoff to next unit"
-                          if feeds_next[k] else "")))
+        detail = "per-channel weights" \
+            + (f", fused {'+'.join(fused)}" if fused else "") \
+            + (", int8 handoff to next unit" if feeds_next[k] else "") \
+            + (", f32 activation after dequant"
+               if parts.get("post_act") is not None else "")
+        report.append((cpath, "Conv2D", status, detail))
 
     if hasattr(net, "_clear_cached_op"):
         net._clear_cached_op()
@@ -589,35 +648,6 @@ def quantize_net(net, calib_data=None, exclude=(), calib_mode="naive",
         n_q = sum(1 for r in report if r[2].startswith("int8"))
         print(f"quantized {n_q}/{len(report)} considered layers")
     return net
-
-
-def _calib_outputs(net, calib_data, tails):
-    out: Dict[int, List[float]] = {}
-    hooks = []
-
-    def make_hook(key):
-        def hook(block, inputs, output):
-            x = output[0] if isinstance(output, (list, tuple)) else output
-            arr = _np.asarray(x.asnumpy() if hasattr(x, "asnumpy") else x)
-            lo, hi = float(arr.min()), float(arr.max())
-            if key in out:
-                out[key][0] = min(out[key][0], lo)
-                out[key][1] = max(out[key][1], hi)
-            else:
-                out[key] = [lo, hi]
-
-        return hook
-
-    for t in tails:
-        hooks.append(t.register_forward_hook(make_hook(id(t))))
-    try:
-        for batch in calib_data:
-            x = batch[0] if isinstance(batch, (list, tuple)) else batch
-            net(x)
-    finally:
-        for h in hooks:
-            h.detach()
-    return {k: (v[0], v[1]) for k, v in out.items()}
 
 
 def _swap(block, name, newb):

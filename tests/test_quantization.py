@@ -290,8 +290,11 @@ class TestQuantDepthRound4:
         net(x)
         qnet = quantize_net(net, calib_data=[x])
         report = qnet._quantization_report
-        tanh_rows = [r for r in report if "tanh" in r[3]]
-        assert tanh_rows and tanh_rows[0][2] == "float"
+        # non-relu act convs quantize with the activation in f32 after
+        # dequant (review round-4: the fusion rewrite must not LOSE the
+        # pre-existing int8 coverage)
+        act_rows = [r for r in report if "f32 activation" in r[3]]
+        assert act_rows and act_rows[0][2] == "int8"
 
     def test_per_channel_scales_beat_per_tensor_on_outlier_filters(self):
         from mxnet_tpu.contrib.quantization import (_quantize_per_channel,
@@ -309,3 +312,80 @@ class TestQuantDepthRound4:
         err_c = np.abs(rec_c[1:] - w[1:]).max()
         err_t = np.abs(rec_t[1:] - w[1:]).max()
         assert err_c < err_t / 10
+
+
+class TestQuantChainSafety:
+    """Review round-4: chaining must only happen where execution order
+    is child order (Sequential); ceil_mode pools must not fold."""
+
+    def test_parallel_branch_container_does_not_chain(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        from mxnet_tpu.contrib.quantization import quantize_net
+        from mxnet_tpu.gluon import nn
+        from mxnet_tpu.gluon.block import Block
+
+        class TwoBranch(Block):
+            def __init__(self):
+                super().__init__()
+                with self.name_scope():
+                    self.a = nn.Conv2D(4, kernel_size=1, in_channels=2)
+                    self.b = nn.Conv2D(4, kernel_size=1, in_channels=2)
+
+            def forward(self, x, *args):
+                return nd.concat(self.a(x), self.b(x), dim=1)
+
+        net = TwoBranch()
+        net.initialize()
+        x = nd.array(np.random.rand(2, 2, 5, 5).astype(np.float32))
+        net(x)
+        qnet = quantize_net(net, calib_data=[x])
+        # both convs int8 but NOT chained (parallel branches) — and the
+        # rewritten net must run without a QTensor reaching concat
+        out = qnet(x)
+        assert out.shape == (2, 8, 5, 5)
+        assert all(r[2] == "int8" for r in qnet._quantization_report
+                   if r[1] == "Conv2D")
+
+    def test_ceil_mode_pool_not_folded(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        from mxnet_tpu.contrib.quantization import quantize_net
+        from mxnet_tpu.gluon import nn
+
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(4, kernel_size=3, in_channels=1,
+                              activation="relu"),
+                    nn.MaxPool2D(pool_size=3, strides=2, ceil_mode=True),
+                    nn.Flatten(), nn.Dense(3))
+        net.initialize()
+        x = nd.array(np.random.rand(2, 1, 12, 12).astype(np.float32))
+        ref = net(x).asnumpy()
+        qnet = quantize_net(net, calib_data=[x])
+        conv_row = [r for r in qnet._quantization_report
+                    if r[1] == "Conv2D"][0]
+        assert "pool" not in conv_row[3]  # ceil_mode pool left unfolded
+        out = qnet(x).asnumpy()
+        assert out.shape == ref.shape  # 'full' convention preserved
+
+    def test_excluded_bn_not_folded(self):
+        import mxnet_tpu as mx
+        from mxnet_tpu import nd
+        from mxnet_tpu.contrib.quantization import quantize_net
+        from mxnet_tpu.gluon import nn
+
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Conv2D(4, kernel_size=3, in_channels=1),
+                    nn.BatchNorm(in_channels=4),
+                    nn.Flatten(), nn.Dense(3))
+        net.initialize()
+        bn = net[1]
+        x = nd.array(np.random.rand(2, 1, 8, 8).astype(np.float32))
+        net(x)
+        qnet = quantize_net(net, calib_data=[x], exclude=(bn,))
+        conv_row = [r for r in qnet._quantization_report
+                    if r[1] == "Conv2D"][0]
+        assert "bn" not in conv_row[3]  # stayed a separate float BN
+        assert qnet._children[list(qnet._children.keys())[1]] is bn
