@@ -66,6 +66,29 @@ DEFAULT_SPECS = {
     "LayerNorm": ([(64, 512), (512,), (512,)], {}),
     "Dropout": ([(256, 256)], {"p": 0.5}),
     "Activation": ([(256, 256)], {"act_type": "tanh"}),
+    # round-4 families: linalg, spatial, multi-tensor, loss heads
+    "linalg_gemm2": ([(16, 64, 64), (16, 64, 64)], {}),
+    "linalg_potrf": ([(16, 64, 64)], {"__spd__": True}),
+    "linalg_trsm": ([(16, 64, 64), (16, 64, 64)], {"__spd__": True}),
+    "linalg_syrk": ([(16, 64, 64)], {}),
+    "BilinearSampler": ([(8, 16, 32, 32), (8, 2, 32, 32)], {}),
+    "GridGenerator": ([(8, 6)], {"transform_type": "affine",
+                                 "target_shape": (32, 32)}),
+    "SpatialTransformer": ([(8, 16, 32, 32), (8, 6)],
+                           {"target_shape": (32, 32)}),
+    "Correlation": ([(4, 16, 24, 24), (4, 16, 24, 24)],
+                    {"max_displacement": 2, "pad_size": 2}),
+    "im2col": ([(8, 16, 32, 32)], {"kernel": (3, 3), "pad": (1, 1)}),
+    "multi_sum_sq": ([(256, 256), (256, 256), (256, 256)],
+                     {"num_arrays": 3}),
+    "multi_sgd_update": ([(256, 256), (256, 256), (128, 128), (128, 128)],
+                         {"lrs": (0.1, 0.1), "num_weights": 2}),
+    "LinearRegressionOutput": ([(256, 256), (256, 256)], {}),
+    "SVMOutput": ([(256, 64), (256,)], {}),
+    "cumsum": ([(256, 256)], {"axis": 1}),
+    "add_n": ([(256, 256), (256, 256), (256, 256)], {}),
+    "swapaxes": ([(64, 32, 16)], {"dim1": 0, "dim2": 2}),
+    "reshape_like": ([(256, 256), (64, 1024)], {}),
     # contrib detection ops
     "_contrib_box_iou": ([(1, 64, 4), (1, 64, 4)], {}),
     "_contrib_box_nms": ([(1, 128, 6)], {}),
@@ -142,9 +165,20 @@ def bench_op(name, shapes, params, warmup=2, runs=20, dtype=np.float32):
     op = registry.maybe_get(name)
     if op is None:
         return None
+    params = dict(params)
+    spd = params.pop("__spd__", False)
     # linear_cross_entropy takes labels as arg 2 with small vocab index
     args = _inputs(shapes, dtype=dtype,
                    int_slots=_INT_INPUT.get(name, ()))
+    if spd:
+        # factorization/solve ops need a well-conditioned SPD (or its
+        # Cholesky-factor) leading operand
+        import jax.numpy as jnp
+
+        a = args[0]
+        n = a.shape[-1]
+        args[0] = jnp.matmul(a, jnp.swapaxes(a, -1, -2)) \
+            + n * jnp.eye(n, dtype=a.dtype)
     import functools
 
     fn = functools.partial(op.fn, **params) if params else op.fn

@@ -1,0 +1,544 @@
+"""mx -> ONNX export (reference: ``python/mxnet/onnx/mx2onnx`` op-by-op
+converters [unverified]).
+
+Walks the Symbol DAG topologically, emitting one (or a few) ONNX nodes
+per operator into a wire-compatible ModelProto built on the vendored
+schema subset (``onnx_subset.proto`` — standard field numbers, so any
+ONNX runtime parses the output). Parameters become initializers; free
+variables become graph inputs. Opset 17 (LayerNormalization needs 17;
+everything else is 13-stable).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import onnx_subset_pb2 as P
+
+OPSET = 17
+
+_DTYPE = {
+    _np.dtype("float32"): P.TensorProto.FLOAT,
+    _np.dtype("float64"): P.TensorProto.DOUBLE,
+    _np.dtype("float16"): P.TensorProto.FLOAT16,
+    _np.dtype("int32"): P.TensorProto.INT32,
+    _np.dtype("int64"): P.TensorProto.INT64,
+    _np.dtype("int8"): P.TensorProto.INT8,
+    _np.dtype("uint8"): P.TensorProto.UINT8,
+    _np.dtype("bool"): P.TensorProto.BOOL,
+}
+
+
+def _tensor(name: str, arr: _np.ndarray) -> P.TensorProto:
+    t = P.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    dt = _DTYPE.get(arr.dtype)
+    if dt is None:
+        raise MXNetError(f"ONNX export: unsupported dtype {arr.dtype}")
+    t.data_type = dt
+    t.raw_data = _np.ascontiguousarray(arr).tobytes()
+    return t
+
+
+def _np_to_elem(dtype) -> int:
+    dt = _DTYPE.get(_np.dtype(dtype))
+    if dt is None:
+        raise MXNetError(f"ONNX export: unsupported input dtype {dtype}")
+    return dt
+
+
+def _vinfo(name: str, shape=None, elem=P.TensorProto.FLOAT):
+    v = P.ValueInfoProto()
+    v.name = name
+    v.type.tensor_type.elem_type = elem
+    if shape is not None:
+        for d in shape:
+            dim = v.type.tensor_type.shape.dim.add()
+            dim.dim_value = int(d)
+    else:
+        v.type.tensor_type.shape.SetInParent()
+    return v
+
+
+class _Builder:
+    """Accumulates nodes/initializers; hands converters fresh names."""
+
+    def __init__(self):
+        self.nodes: List[P.NodeProto] = []
+        self.initializers: List[P.TensorProto] = []
+        self.params: Dict[str, tuple] = {}  # bound param name -> shape
+        self.replaced: set = set()  # params a converter substituted
+        self._n = 0
+
+    def node(self, op_type: str, inputs, outputs, name=None, **attrs):
+        n = P.NodeProto()
+        n.op_type = op_type
+        n.name = name or f"{op_type.lower()}_{len(self.nodes)}"
+        n.input.extend(inputs)
+        n.output.extend(outputs)
+        for k, v in attrs.items():
+            if v is None:
+                continue
+            a = n.attribute.add()
+            a.name = k
+            if isinstance(v, bool):
+                a.type = P.AttributeProto.INT
+                a.i = int(v)
+            elif isinstance(v, int):
+                a.type = P.AttributeProto.INT
+                a.i = v
+            elif isinstance(v, float):
+                a.type = P.AttributeProto.FLOAT
+                a.f = v
+            elif isinstance(v, str):
+                a.type = P.AttributeProto.STRING
+                a.s = v.encode()
+            elif isinstance(v, (list, tuple)):
+                if all(isinstance(x, int) for x in v):
+                    a.type = P.AttributeProto.INTS
+                    a.ints.extend(v)
+                else:
+                    a.type = P.AttributeProto.FLOATS
+                    a.floats.extend(float(x) for x in v)
+            else:
+                raise MXNetError(f"ONNX export: bad attr {k}={v!r}")
+        self.nodes.append(n)
+        return n
+
+    def const(self, arr: _np.ndarray, hint="const") -> str:
+        name = f"_{hint}_{self._n}"
+        self._n += 1
+        self.initializers.append(_tensor(name, _np.asarray(arr)))
+        return name
+
+
+# converter registry: mx op name -> fn(b, name, ins, attrs, out) where
+# `ins` are the ONNX input value names and `out` the output value name
+_CONVERTERS: Dict[str, Callable] = {}
+
+
+def _conv(name):
+    def deco(fn):
+        for n in ([name] if isinstance(name, str) else name):
+            _CONVERTERS[n] = fn
+        return fn
+
+    return deco
+
+
+def _shape_attr(attrs, key, nd=2, default=None):
+    v = attrs.get(key, default)
+    if v is None:
+        return None
+    if isinstance(v, int):
+        return (v,) * nd
+    return tuple(int(x) for x in v)
+
+
+@_conv("Convolution")
+def _c_conv(b, name, ins, attrs, out):
+    kernel = _shape_attr(attrs, "kernel")
+    nd = len(kernel)
+    stride = _shape_attr(attrs, "stride", nd, 1)
+    dilate = _shape_attr(attrs, "dilate", nd, 1)
+    pad = _shape_attr(attrs, "pad", nd, 0)
+    b.node("Conv", ins, [out], name=name, kernel_shape=list(kernel),
+           strides=list(stride), dilations=list(dilate),
+           pads=list(pad) + list(pad), group=int(attrs.get("num_group", 1)))
+
+
+@_conv("FullyConnected")
+def _c_fc(b, name, ins, attrs, out):
+    x, w = ins[0], ins[1]
+    bias = ins[2] if len(ins) > 2 else None
+    if attrs.get("flatten", True):
+        flat = f"{name}_flat"
+        b.node("Flatten", [x], [flat], axis=1)
+        gemm_in = [flat, w] + ([bias] if bias else [])
+        if bias:
+            b.node("Gemm", gemm_in, [out], name=name, transB=1)
+        else:
+            b.node("Gemm", gemm_in, [out], name=name, transB=1, beta=0.0)
+    else:
+        wt = f"{name}_wT"
+        b.node("Transpose", [w], [wt], perm=[1, 0])
+        mm = f"{name}_mm" if bias else out
+        b.node("MatMul", [x, wt], [mm], name=name)
+        if bias:
+            b.node("Add", [mm, bias], [out])
+
+
+@_conv("BatchNorm")
+def _c_bn(b, name, ins, attrs, out):
+    if int(attrs.get("axis", 1)) != 1:
+        raise MXNetError(
+            "ONNX export: BatchNorm axis != 1 (ONNX BatchNormalization "
+            "is channel-axis-1 only); transpose around the layer instead")
+    gamma = ins[1]
+    if attrs.get("fix_gamma", True):
+        # mx semantics: gamma frozen at 1 regardless of the stored param
+        # (reference default) — emit a ones initializer of the param's
+        # shape in its place, and drop the now-dead stored gamma so it
+        # cannot resurface as a stale arg_param on re-import
+        shape = b.params.get(ins[1])
+        if shape is None:
+            raise MXNetError(
+                f"ONNX export: BatchNorm {name} has fix_gamma=True but "
+                f"gamma {ins[1]!r} is not a bound parameter; pass it in "
+                "params or set fix_gamma=False")
+        gamma = b.const(_np.ones(shape, _np.float32), "fixed_gamma")
+        b.replaced.add(ins[1])
+    b.node("BatchNormalization",
+           [ins[0], gamma, ins[2], ins[3], ins[4]], [out], name=name,
+           epsilon=float(attrs.get("eps", 1e-3)),
+           momentum=float(attrs.get("momentum", 0.9)))
+
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+@_conv("Activation")
+def _c_act(b, name, ins, attrs, out):
+    t = attrs.get("act_type", "relu")
+    if t not in _ACT:
+        raise MXNetError(f"ONNX export: Activation act_type {t!r}")
+    b.node(_ACT[t], ins[:1], [out], name=name)
+
+
+@_conv("LeakyReLU")
+def _c_leaky(b, name, ins, attrs, out):
+    if attrs.get("act_type", "leaky") not in ("leaky", "prelu"):
+        raise MXNetError("ONNX export: only leaky/prelu LeakyReLU")
+    if attrs.get("act_type", "leaky") == "prelu":
+        b.node("PRelu", ins[:2], [out], name=name)
+    else:
+        b.node("LeakyRelu", ins[:1], [out], name=name,
+               alpha=float(attrs.get("slope", 0.25)))
+
+
+@_conv("Pooling")
+def _c_pool(b, name, ins, attrs, out):
+    ptype = attrs.get("pool_type", "max")
+    if attrs.get("global_pool", False):
+        b.node({"max": "GlobalMaxPool", "avg": "GlobalAveragePool"}[ptype],
+               ins[:1], [out], name=name)
+        return
+    kernel = _shape_attr(attrs, "kernel")
+    nd = len(kernel)
+    stride = _shape_attr(attrs, "stride", nd, 1)
+    pad = _shape_attr(attrs, "pad", nd, 0)
+    common = dict(kernel_shape=list(kernel), strides=list(stride),
+                  pads=list(pad) + list(pad))
+    if attrs.get("pooling_convention", "valid") == "full":
+        common["ceil_mode"] = 1
+    if ptype == "max":
+        b.node("MaxPool", ins[:1], [out], name=name, **common)
+    elif ptype == "avg":
+        b.node("AveragePool", ins[:1], [out], name=name,
+               count_include_pad=int(attrs.get("count_include_pad", True)),
+               **common)
+    else:
+        raise MXNetError(f"ONNX export: pool_type {ptype!r}")
+
+
+@_conv("Flatten")
+def _c_flatten(b, name, ins, attrs, out):
+    b.node("Flatten", ins[:1], [out], name=name, axis=1)
+
+
+@_conv("Reshape")
+def _c_reshape(b, name, ins, attrs, out):
+    shape = attrs.get("shape")
+    if shape is None:
+        raise MXNetError("ONNX export: Reshape needs a shape attr")
+    s = b.const(_np.asarray(shape, _np.int64), "shape")
+    b.node("Reshape", [ins[0], s], [out], name=name)
+
+
+@_conv("concat")
+def _c_concat(b, name, ins, attrs, out):
+    b.node("Concat", ins, [out], name=name, axis=int(attrs.get("dim", 1)))
+
+
+_BINOP = {"broadcast_add": "Add", "broadcast_sub": "Sub",
+          "broadcast_mul": "Mul", "broadcast_div": "Div",
+          "broadcast_maximum": "Max", "broadcast_minimum": "Min",
+          "broadcast_power": "Pow", "dot": "MatMul", "batch_dot": "MatMul"}
+
+
+for _mx, _ox in _BINOP.items():
+    def _mk(ox):
+        def f(b, name, ins, attrs, out):
+            b.node(ox, ins[:2], [out], name=name)
+
+        return f
+
+    _CONVERTERS[_mx] = _mk(_ox)
+
+_UNOP = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+         "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "abs": "Abs",
+         "negative": "Neg", "floor": "Floor", "ceil": "Ceil",
+         "erf": "Erf", "sign": "Sign", "identity": "Identity",
+         "BlockGrad": "Identity", "reciprocal": "Reciprocal",
+         "sin": "Sin", "cos": "Cos"}
+
+for _mx, _ox in _UNOP.items():
+    def _mk1(ox):
+        def f(b, name, ins, attrs, out):
+            b.node(ox, ins[:1], [out], name=name)
+
+        return f
+
+    _CONVERTERS[_mx] = _mk1(_ox)
+
+
+@_conv(["softmax", "SoftmaxActivation", "SoftmaxOutput"])
+def _c_softmax(b, name, ins, attrs, out):
+    b.node("Softmax", ins[:1], [out], name=name,
+           axis=int(attrs.get("axis", -1)))
+
+
+@_conv("log_softmax")
+def _c_log_softmax(b, name, ins, attrs, out):
+    b.node("LogSoftmax", ins[:1], [out], name=name,
+           axis=int(attrs.get("axis", -1)))
+
+
+@_conv("Dropout")
+def _c_dropout(b, name, ins, attrs, out):
+    # inference-mode export: identity (the reference exporter emitted
+    # Dropout with ratio; runtimes ignore it at inference — Identity is
+    # the same result without relying on that)
+    b.node("Identity", ins[:1], [out], name=name)
+
+
+@_conv("transpose")
+def _c_transpose(b, name, ins, attrs, out):
+    axes = attrs.get("axes")
+    if axes:
+        b.node("Transpose", ins[:1], [out], name=name,
+               perm=[int(a) for a in axes])
+    else:
+        b.node("Transpose", ins[:1], [out], name=name)
+
+
+@_conv("add_n")
+def _c_add_n(b, name, ins, attrs, out):
+    b.node("Sum", ins, [out], name=name)
+
+
+@_conv("clip")
+def _c_clip(b, name, ins, attrs, out):
+    # missing bounds are UNBOUNDED (mx a_min/a_max=None) — emit the ONNX
+    # optional-input placeholder, never a spurious 0.0
+    a_min = attrs.get("a_min")
+    a_max = attrs.get("a_max")
+    inputs = [ins[0]]
+    inputs.append(b.const(_np.float32(a_min), "min")
+                  if a_min is not None else "")
+    if a_max is not None:
+        inputs.append(b.const(_np.float32(a_max), "max"))
+    while inputs and inputs[-1] == "":
+        inputs.pop()
+    b.node("Clip", inputs, [out], name=name)
+
+
+@_conv("slice_axis")
+def _c_slice_axis(b, name, ins, attrs, out):
+    axis = int(attrs["axis"])
+    begin = int(attrs.get("begin", 0))
+    end = attrs.get("end")
+    end = _np.iinfo(_np.int64).max if end is None else int(end)
+    b.node("Slice", [
+        ins[0],
+        b.const(_np.asarray([begin], _np.int64), "starts"),
+        b.const(_np.asarray([end], _np.int64), "ends"),
+        b.const(_np.asarray([axis], _np.int64), "axes"),
+    ], [out], name=name)
+
+
+@_conv("expand_dims")
+def _c_expand(b, name, ins, attrs, out):
+    ax = b.const(_np.asarray([int(attrs["axis"])], _np.int64), "axes")
+    b.node("Unsqueeze", [ins[0], ax], [out], name=name)
+
+
+@_conv("squeeze")
+def _c_squeeze(b, name, ins, attrs, out):
+    axis = attrs.get("axis")
+    if axis is None:
+        b.node("Squeeze", ins[:1], [out], name=name)
+    else:
+        axes = [axis] if isinstance(axis, int) else list(axis)
+        ax = b.const(_np.asarray(axes, _np.int64), "axes")
+        b.node("Squeeze", [ins[0], ax], [out], name=name)
+
+
+def _reduce(onnx_op, axes_as_input=False):
+    def f(b, name, ins, attrs, out):
+        axis = attrs.get("axis")
+        keep = int(attrs.get("keepdims", False))
+        axes = None if axis is None else \
+            ([axis] if isinstance(axis, int) else list(axis))
+        if axes_as_input:
+            extra = [] if axes is None else \
+                [b.const(_np.asarray(axes, _np.int64), "axes")]
+            b.node(onnx_op, [ins[0]] + extra, [out], name=name,
+                   keepdims=keep)
+        else:
+            b.node(onnx_op, ins[:1], [out], name=name, keepdims=keep,
+                   axes=axes)
+
+    return f
+
+
+_CONVERTERS["mean"] = _reduce("ReduceMean")
+_CONVERTERS["max"] = _reduce("ReduceMax")
+_CONVERTERS["min"] = _reduce("ReduceMin")
+_CONVERTERS["prod"] = _reduce("ReduceProd")
+_CONVERTERS["sum"] = _reduce("ReduceSum", axes_as_input=True)
+
+
+@_conv("Embedding")
+def _c_embedding(b, name, ins, attrs, out):
+    idx = f"{name}_idx64"
+    b.node("Cast", [ins[0]], [idx], to=P.TensorProto.INT64)
+    b.node("Gather", [ins[1], idx], [out], name=name)
+
+
+@_conv("LayerNorm")
+def _c_layernorm(b, name, ins, attrs, out):
+    b.node("LayerNormalization", ins[:3], [out], name=name,
+           axis=int(attrs.get("axis", -1)),
+           epsilon=float(attrs.get("eps", 1e-5)))
+
+
+def export_model(sym, params, input_shapes=None, input_types=None,
+                 onnx_file_path="model.onnx", verbose=False,
+                 dynamic=False):
+    """Export a Symbol + params to an ONNX ModelProto file; returns the
+    path (reference ``mx.onnx.export_model`` signature).
+
+    ``params`` maps name -> NDArray/ndarray; the reference's
+    'arg:'/'aux:' prefixes are accepted and stripped. ``input_shapes``:
+    list of shapes for the free (non-param) variables, in
+    ``list_arguments`` order."""
+    from ..symbol.symbol import Symbol
+
+    if not isinstance(sym, Symbol):
+        raise MXNetError("export_model expects a Symbol")
+    pvals: Dict[str, _np.ndarray] = {}
+    for k, v in (params or {}).items():
+        if k.startswith(("arg:", "aux:")):
+            k = k[4:]
+        pvals[k] = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+
+    b = _Builder()
+    b.params = {k: v.shape for k, v in pvals.items()}
+    order: List = []
+    seen = set()
+    # iterative DFS: deep chains (unrolled sequences, 100+-layer nets)
+    # overflow Python recursion otherwise. Indexed views of a
+    # multi-output node share its name: dedupe by (name, is_var) so one
+    # ONNX node is emitted per symbol node.
+    stack = [(sym, False)]
+    while stack:
+        s, expanded = stack.pop()
+        key = (s._name, s._is_var())
+        if expanded:
+            if key not in seen:
+                seen.add(key)
+                order.append(s)
+            continue
+        if key in seen:
+            continue
+        stack.append((s, True))
+        for i in reversed(s._inputs):
+            stack.append((i, False))
+
+    free_vars: List[str] = []
+    for s in order:
+        if s._is_var():
+            if s._name not in pvals:
+                free_vars.append(s._name)
+        elif s._op is None:
+            raise MXNetError("ONNX export: group symbols are not a graph")
+
+    if dynamic:
+        raise MXNetError(
+            "ONNX export: dynamic axes are not supported; export with "
+            "concrete input_shapes")
+    shapes = {}
+    if input_shapes is not None:
+        for n, shp in zip(free_vars, input_shapes):
+            shapes[n] = shp
+    elems = {}
+    if input_types is not None:
+        types = input_types if isinstance(input_types, (list, tuple)) \
+            else [input_types] * len(free_vars)
+        for n, t in zip(free_vars, types):
+            elems[n] = _np_to_elem(t)
+
+    for s in order:
+        if s._is_var() or s._op is None:
+            continue
+        conv = _CONVERTERS.get(s._op)
+        if conv is None:
+            raise MXNetError(
+                f"ONNX export: no converter for op {s._op!r} "
+                f"(node {s._name}); supported: "
+                f"{sorted(_CONVERTERS)}"
+            )
+        ins = []
+        for i in s._inputs:
+            if i._out_index not in (None, 0):
+                raise MXNetError(
+                    f"ONNX export: {s._name} consumes output "
+                    f"{i._out_index} of {i._name}; only primary outputs "
+                    "export (aux outputs are training-only state)"
+                )
+            ins.append(i._name)
+        conv(b, s._name, ins, s._attrs, s._name)
+
+    # initializers and graph inputs AFTER conversion: only values some
+    # emitted node actually consumes (loss heads drop their label input;
+    # fix_gamma replaces its gamma — neither may surface in the file)
+    used = {sym._name}  # a bare-variable head is its own output
+    for n in b.nodes:
+        used.update(n.input)
+    for name in list(dict.fromkeys(  # preserve DAG order
+            s._name for s in order if s._is_var())):
+        if name in pvals:
+            if name in used and name not in b.replaced:
+                b.initializers.append(_tensor(name, pvals[name]))
+    graph_inputs: List[P.ValueInfoProto] = []
+    for n in free_vars:
+        if n in used:
+            graph_inputs.append(
+                _vinfo(n, shapes.get(n), elems.get(n, P.TensorProto.FLOAT)))
+
+    m = P.ModelProto()
+    m.ir_version = 8
+    m.producer_name = "mxnet_tpu"
+    m.producer_version = "0.4"
+    op = m.opset_import.add()
+    op.version = OPSET
+    g = m.graph
+    g.name = sym._name
+    g.node.extend(b.nodes)
+    g.initializer.extend(b.initializers)
+    g.input.extend(graph_inputs)
+    if sym._out_index not in (None, 0):
+        raise MXNetError("ONNX export: head must be output 0 of its node")
+    g.output.append(_vinfo(sym._name))
+    with open(onnx_file_path, "wb") as f:
+        f.write(m.SerializeToString())
+    if verbose:
+        print(f"exported {len(b.nodes)} nodes, "
+              f"{len(b.initializers)} initializers -> {onnx_file_path}")
+    return onnx_file_path

@@ -1,0 +1,282 @@
+"""ONNX -> mx import (reference: ``mx.onnx.import_model`` /
+``onnx2mx`` converters [unverified]).
+
+Parses a ModelProto (any producer — the vendored schema subset reads the
+standard wire format) into a Symbol graph plus arg/aux param dicts, the
+reference's ``(sym, arg_params, aux_params)`` contract, for the operator
+subset the exporter emits (CNN/MLP/attention-adjacent ops).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as _np
+
+from ..base import MXNetError
+from . import onnx_subset_pb2 as P
+
+_NP_DTYPE = {
+    P.TensorProto.FLOAT: _np.float32,
+    P.TensorProto.DOUBLE: _np.float64,
+    P.TensorProto.FLOAT16: _np.float16,
+    P.TensorProto.INT32: _np.int32,
+    P.TensorProto.INT64: _np.int64,
+    P.TensorProto.INT8: _np.int8,
+    P.TensorProto.UINT8: _np.uint8,
+    P.TensorProto.BOOL: _np.bool_,
+}
+
+
+def _to_np(t: P.TensorProto) -> _np.ndarray:
+    dt = _NP_DTYPE.get(t.data_type)
+    if dt is None:
+        raise MXNetError(f"ONNX import: tensor dtype {t.data_type}")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return _np.frombuffer(t.raw_data, dtype=dt).reshape(shape).copy()
+    if t.float_data:
+        return _np.asarray(t.float_data, dt).reshape(shape)
+    if t.int64_data:
+        return _np.asarray(t.int64_data, dt).reshape(shape)
+    if t.int32_data:
+        return _np.asarray(t.int32_data, dt).reshape(shape)
+    return _np.zeros(shape, dt)
+
+
+def _attrs(node: P.NodeProto) -> dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == P.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == P.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == P.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == P.AttributeProto.INTS:
+            out[a.name] = tuple(int(x) for x in a.ints)
+        elif a.type == P.AttributeProto.FLOATS:
+            out[a.name] = tuple(float(x) for x in a.floats)
+        elif a.type == P.AttributeProto.TENSOR:
+            out[a.name] = _to_np(a.t)
+    return out
+
+
+def _pads(a, nd):
+    p = a.get("pads")
+    if not p:
+        return (0,) * nd
+    begin, end = p[:nd], p[nd:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError("ONNX import: asymmetric pads unsupported")
+    return tuple(begin)
+
+
+def import_model(onnx_file_path):
+    """-> (sym, arg_params, aux_params), the reference contract."""
+    from .. import symbol as sym_mod
+    from ..ndarray import array as nd_array
+
+    m = P.ModelProto()
+    with open(onnx_file_path, "rb") as f:
+        m.ParseFromString(f.read())
+    g = m.graph
+
+    inits: Dict[str, _np.ndarray] = {t.name: _to_np(t)
+                                     for t in g.initializer}
+    values: Dict[str, object] = {}
+    aux_names = set()
+    # consts consumed structurally (Reshape shapes, Slice starts...)
+    structural = set()
+
+    for vi in g.input:
+        if vi.name not in inits:
+            values[vi.name] = sym_mod.var(vi.name)
+
+    def var_for(name):
+        if name not in values:
+            if name not in inits:
+                raise MXNetError(f"ONNX import: undefined value {name!r}")
+            values[name] = sym_mod.var(name)
+        return values[name]
+
+    def const_ints(name):
+        if name not in inits:
+            raise MXNetError(
+                f"ONNX import: {name!r} must be a constant initializer")
+        structural.add(name)
+        return [int(x) for x in _np.asarray(inits[name]).reshape(-1)]
+
+    def const_floats(name):
+        if name not in inits:
+            raise MXNetError(
+                f"ONNX import: {name!r} must be a constant initializer")
+        structural.add(name)
+        return [float(x) for x in _np.asarray(inits[name]).reshape(-1)]
+
+    S = sym_mod
+
+    for node in g.node:
+        op = node.op_type
+        a = _attrs(node)
+        ins = list(node.input)
+        out = node.output[0]
+
+        def I(k=0):  # noqa: E743 - local helper
+            return var_for(ins[k])
+
+        if op == "Conv":
+            kernel = a["kernel_shape"]
+            nd = len(kernel)
+            args = [I(0), I(1)] + ([I(2)] if len(ins) > 2 else [])
+            res = S.Convolution(
+                *args, kernel=tuple(kernel),
+                stride=tuple(a.get("strides", (1,) * nd)),
+                dilate=tuple(a.get("dilations", (1,) * nd)),
+                pad=_pads(a, nd), num_group=a.get("group", 1),
+                no_bias=len(ins) <= 2,
+                num_filter=int(inits[ins[1]].shape[0])
+                if ins[1] in inits else 0)
+        elif op == "Gemm":
+            if a.get("transB", 0) != 1 or a.get("transA", 0) != 0:
+                raise MXNetError("ONNX import: Gemm needs transB=1")
+            if a.get("alpha", 1.0) != 1.0 or a.get("beta", 1.0) != 1.0:
+                raise MXNetError(
+                    "ONNX import: Gemm alpha/beta != 1 unsupported")
+            args = [I(0), I(1)] + ([I(2)] if len(ins) > 2 else [])
+            num_hidden = int(inits[ins[1]].shape[0]) \
+                if ins[1] in inits else 0
+            res = S.FullyConnected(*args, num_hidden=num_hidden,
+                                   no_bias=len(ins) <= 2, flatten=False)
+        elif op == "MatMul":
+            res = S.dot(I(0), I(1))
+        elif op == "BatchNormalization":
+            aux_names.update(ins[3:5])
+            res = S.BatchNorm(
+                I(0), I(1), I(2), I(3), I(4),
+                eps=a.get("epsilon", 1e-5),
+                momentum=a.get("momentum", 0.9), fix_gamma=False,
+                use_global_stats=True)[0]
+        elif op in ("Relu", "Sigmoid", "Tanh", "Softplus", "Softsign"):
+            act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+                   "Softplus": "softrelu", "Softsign": "softsign"}[op]
+            res = S.Activation(I(0), act_type=act)
+        elif op == "LeakyRelu":
+            res = S.LeakyReLU(I(0), slope=a.get("alpha", 0.01))
+        elif op == "PRelu":
+            res = S.LeakyReLU(I(0), I(1), act_type="prelu")
+        elif op in ("MaxPool", "AveragePool"):
+            kernel = a["kernel_shape"]
+            nd = len(kernel)
+            res = S.Pooling(
+                I(0), kernel=tuple(kernel),
+                pool_type="max" if op == "MaxPool" else "avg",
+                stride=tuple(a.get("strides", (1,) * nd)),
+                pad=_pads(a, nd),
+                pooling_convention="full" if a.get("ceil_mode") else "valid",
+                # ONNX spec default is 0: padding EXCLUDED from the mean
+                count_include_pad=bool(a.get("count_include_pad", 0)))
+        elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+            res = S.Pooling(
+                I(0), global_pool=True, kernel=(1, 1),
+                pool_type="max" if op == "GlobalMaxPool" else "avg")
+        elif op == "Flatten":
+            if a.get("axis", 1) != 1:
+                raise MXNetError("ONNX import: Flatten axis != 1")
+            res = S.Flatten(I(0))
+        elif op == "Reshape":
+            res = S.Reshape(I(0), shape=tuple(const_ints(ins[1])))
+        elif op == "Concat":
+            res = S.concat(*[var_for(n) for n in ins],
+                           dim=a.get("axis", 1))
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow"):
+            name = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+                    "Mul": "broadcast_mul", "Div": "broadcast_div",
+                    "Pow": "broadcast_power"}[op]
+            res = getattr(S, name)(I(0), I(1))
+        elif op == "Sum":
+            res = S.add_n(*[var_for(n) for n in ins])
+        elif op in ("Softmax", "LogSoftmax"):
+            fn = S.softmax if op == "Softmax" else S.log_softmax
+            res = fn(I(0), axis=a.get("axis", -1))
+        elif op == "Identity" or op == "Dropout":
+            res = S.identity(I(0))
+        elif op == "Transpose":
+            perm = a.get("perm")
+            res = S.transpose(I(0), axes=tuple(perm) if perm else None)
+        elif op == "Clip":
+            lo = const_floats(ins[1])[0] if len(ins) > 1 and ins[1] else None
+            hi = const_floats(ins[2])[0] if len(ins) > 2 and ins[2] else None
+            res = S.clip(I(0), a_min=a.get("min", lo),
+                         a_max=a.get("max", hi))
+        elif op == "Slice":
+            starts = const_ints(ins[1])
+            ends = const_ints(ins[2])
+            axes = const_ints(ins[3]) if len(ins) > 3 and ins[3] else \
+                list(range(len(starts)))
+            if len(ins) > 4 and ins[4]:
+                steps = const_ints(ins[4])
+                if any(st != 1 for st in steps):
+                    raise MXNetError(
+                        "ONNX import: strided Slice (steps != 1) "
+                        "unsupported")
+            res = var_for(ins[0])
+            for st, en, ax in zip(starts, ends, axes):
+                en_v = None if en >= 2 ** 62 else en
+                res = S.slice_axis(res, axis=ax, begin=st, end=en_v)
+        elif op == "Unsqueeze":
+            res = var_for(ins[0])
+            for ax in sorted(const_ints(ins[1])):
+                res = S.expand_dims(res, axis=ax)
+        elif op == "Squeeze":
+            axes = const_ints(ins[1]) if len(ins) > 1 else None
+            res = S.squeeze(I(0), axis=tuple(axes) if axes else None)
+        elif op in ("ReduceMean", "ReduceMax", "ReduceMin", "ReduceProd",
+                    "ReduceSum"):
+            fn = {"ReduceMean": S.mean, "ReduceMax": S.max,
+                  "ReduceMin": S.min, "ReduceProd": S.prod,
+                  "ReduceSum": S.sum}[op]
+            if op == "ReduceSum" and len(ins) > 1:
+                axes = tuple(const_ints(ins[1]))
+            else:
+                axes = a.get("axes")
+                axes = tuple(axes) if axes is not None else None
+            res = fn(I(0), axis=axes, keepdims=bool(a.get("keepdims", 1)))
+        elif op == "Cast":
+            to = a.get("to")
+            np_dt = _NP_DTYPE.get(to)
+            if np_dt is None:
+                raise MXNetError(
+                    f"ONNX import: Cast target dtype {to} unsupported")
+            res = S.cast(I(0), dtype=_np.dtype(np_dt).name)
+        elif op == "Gather":
+            if a.get("axis", 0) != 0:
+                raise MXNetError(
+                    "ONNX import: Gather axis != 0 unsupported")
+            res = S.Embedding(
+                I(1), I(0),
+                input_dim=int(inits[ins[0]].shape[0])
+                if ins[0] in inits else 0,
+                output_dim=int(inits[ins[0]].shape[-1])
+                if ins[0] in inits else 0)
+        elif op == "LayerNormalization":
+            res = S.LayerNorm(I(0), I(1), I(2),
+                              axis=a.get("axis", -1),
+                              eps=a.get("epsilon", 1e-5))
+        else:
+            raise MXNetError(f"ONNX import: unsupported op {op!r}")
+        values[out] = res
+        for extra in node.output[1:]:
+            if extra:
+                raise MXNetError(
+                    f"ONNX import: multi-output node {op} unsupported")
+
+    outs = [values[o.name] for o in g.output]
+    sym = outs[0] if len(outs) == 1 else sym_mod.Group(outs)
+    arg_params, aux_params = {}, {}
+    for name, arr in inits.items():
+        if name in structural:
+            continue
+        (aux_params if name in aux_names else arg_params)[name] = \
+            nd_array(arr)
+    return sym, arg_params, aux_params

@@ -661,3 +661,138 @@ def upsampling(*args, scale=1, sample_type="nearest", num_args=1, **kw):
         return jnp.repeat(jnp.repeat(data, scale, axis=2), scale, axis=3)
     n, c, h, w = data.shape
     return jax.image.resize(data, (n, c, h * scale, w * scale), method="bilinear")
+
+
+# ------------------------------------------------- legacy regression heads
+# Reference: ``src/operator/regression_output.cc``, ``make_loss.cc``,
+# ``svm_output.cc`` [unverified] — loss-layer ops whose FORWARD is the
+# prediction (identity / sigmoid) and whose BACKWARD injects the loss
+# gradient directly, ignoring the incoming cotangent (Module-era training
+# heads; the same custom_vjp shape as SoftmaxOutput above).
+def _reg_head(fwd_fn, grad_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd_fn(data)
+
+    def fwd(data, label, grad_scale):
+        out = fwd_fn(data)
+        return out, (out, label)
+
+    def bwd(grad_scale, res, g):
+        out, label = res
+        n = 1
+        for d in label.shape[1:]:
+            n *= d
+        grad = grad_fn(out, label.reshape(out.shape).astype(out.dtype)) \
+            * (grad_scale / n)
+        if jnp.issubdtype(label.dtype, jnp.floating):
+            lct = jnp.zeros_like(label)
+        else:
+            # integer primals require a float0 cotangent under custom_vjp
+            lct = np.zeros(label.shape, jax.dtypes.float0)
+        return grad.astype(out.dtype), lct
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_lin_reg = _reg_head(lambda d: d, lambda o, l: o - l)
+_mae_reg = _reg_head(lambda d: d, lambda o, l: jnp.sign(o - l))
+_log_reg = _reg_head(jax.nn.sigmoid, lambda o, l: o - l)
+
+
+@register("LinearRegressionOutput")
+def linear_regression_output(data, label, grad_scale=1.0, **kw):
+    """forward = data; backward = (data - label) * grad_scale / n."""
+    return _lin_reg(data, label, float(grad_scale))
+
+
+@register("MAERegressionOutput")
+def mae_regression_output(data, label, grad_scale=1.0, **kw):
+    return _mae_reg(data, label, float(grad_scale))
+
+
+@register("LogisticRegressionOutput")
+def logistic_regression_output(data, label, grad_scale=1.0, **kw):
+    """forward = sigmoid(data); backward = (sigmoid(data) - label)."""
+    return _log_reg(data, label, float(grad_scale))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def _make_loss_core(data, grad_scale, valid_thresh):
+    return data
+
+
+def _make_loss_fwd(data, grad_scale, valid_thresh):
+    return data, None
+
+
+def _make_loss_bwd(grad_scale, valid_thresh, res, g):
+    # reference make_loss: d(data) = grad_scale (the head IS the loss);
+    # normalization folds into grad_scale before the call
+    return (jnp.full_like(g, grad_scale),)
+
+
+_make_loss_core.defvjp(_make_loss_fwd, _make_loss_bwd)
+
+
+@register("MakeLoss")
+def make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+              normalization="null", **kw):
+    """forward = data (reference: identity); backward seeds
+    d(data) = grad_scale, divided by batch size under
+    normalization='batch' (the scale reaches the GRADIENT, where the
+    reference applied it)."""
+    scale = float(grad_scale)
+    if normalization == "batch":
+        scale /= data.shape[0]
+    return _make_loss_core(data, scale, float(valid_thresh))
+
+
+def _svm_grad(out, label, margin, reg_coef, use_linear):
+    n_class = out.shape[-1]
+    lab = jax.nn.one_hot(label.astype(jnp.int32), n_class, dtype=out.dtype)
+    # hinge: grad = -1 at label where violated, +1 at violating others
+    score_at_label = jnp.sum(out * lab, axis=-1, keepdims=True)
+    if use_linear:
+        viol_other = ((out - score_at_label + margin) > 0) & (lab == 0)
+        grad = viol_other.astype(out.dtype)
+        grad = grad - lab * jnp.sum(grad, axis=-1, keepdims=True)
+    else:  # squared hinge
+        m = jnp.maximum(out - score_at_label + margin, 0) * (lab == 0)
+        grad = 2 * m
+        grad = grad - lab * jnp.sum(grad, axis=-1, keepdims=True)
+    return grad * reg_coef
+
+
+def _svm_head():
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4))
+    def core(data, label, margin, reg_coef, use_linear):
+        return data
+
+    def fwd(data, label, margin, reg_coef, use_linear):
+        return data, (data, label)
+
+    def bwd(margin, reg_coef, use_linear, res, g):
+        data, label = res
+        grad = _svm_grad(data, label, margin, reg_coef, use_linear)
+        if jnp.issubdtype(label.dtype, jnp.floating):
+            lct = jnp.zeros_like(label)
+        else:
+            lct = np.zeros(label.shape, jax.dtypes.float0)
+        return grad.astype(data.dtype), lct
+
+    core.defvjp(fwd, bwd)
+    return core
+
+
+_svm_core = _svm_head()
+
+
+@register("SVMOutput")
+def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+               use_linear=False, **kw):
+    """forward = data (scores); backward = hinge-loss gradient
+    (reference svm_output.cc)."""
+    return _svm_core(data, label, float(margin),
+                     float(regularization_coefficient), bool(use_linear))

@@ -536,3 +536,24 @@ register("swapaxes", aliases=["SwapAxis"])(
 register("reshape_like")(
     lambda lhs, rhs, **kw: jnp.reshape(lhs, rhs.shape)
 )
+
+register("cumsum")(
+    lambda data, axis=None, dtype=None, **kw: jnp.cumsum(
+        data, axis=axis, dtype=jnp.dtype(dtype) if dtype else None)
+)
+register("ravel_multi_index", aliases=["_ravel_multi_index"],
+         differentiable=False)(
+    lambda data, shape=None, **kw: jnp.ravel_multi_index(
+        tuple(data.astype(jnp.int32)), tuple(int(s) for s in shape),
+        mode="clip")
+)
+register("unravel_index", aliases=["_unravel_index"],
+         differentiable=False)(
+    lambda data, shape=None, **kw: jnp.stack(
+        jnp.unravel_index(data.astype(jnp.int32),
+                          tuple(int(s) for s in shape)))
+)
+register("batch_take")(
+    lambda a, indices, **kw: jnp.take_along_axis(
+        a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
+)

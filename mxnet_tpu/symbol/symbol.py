@@ -163,33 +163,52 @@ class Symbol:
 
     # ------------------------------------------------------------ evaluation
     def _eval(self, values: Dict[str, jnp.ndarray], cache: Dict[int, object]):
-        if id(self) in cache:
-            out = cache[id(self)]
-        elif self._is_var():
-            if self._name not in values:
-                raise MXNetError(f"missing value for argument {self._name}")
-            out = values[self._name]
-            cache[id(self)] = out
-        elif self._op is None:  # group: members contribute first outputs
-            out = tuple(
-                _first_output(i, i._eval(values, cache))
-                for i in self._inputs
-            )
-            cache[id(self)] = out
-        else:
-            op = _registry.get(self._op)
-            args = [_first_output(i, i._eval(values, cache))
-                    for i in self._inputs]
-            attrs = self._attrs
-            if self._op in _MODE_OPS and "training" not in attrs:
-                # executor-driven train/predict mode (reference: is_train on
-                # the graph executor; nnvm ops read the mode, not an attr)
-                attrs = dict(attrs, training=_TRAIN_MODE[0])
-            out = op.fn(*args, **attrs)
-            cache[id(self)] = out
-        if self._out_index is not None:
-            return out[self._out_index]
-        return out
+        """Iterative post-order evaluation (an explicit stack — deep
+        chains like unrolled sequences or imported 1000-op graphs must
+        not hit Python's recursion limit)."""
+
+        def indexed(s):
+            out = cache[id(s)]
+            return out[s._out_index] if s._out_index is not None else out
+
+        stack = [self]
+        while stack:
+            s = stack[-1]
+            if id(s) in cache:
+                stack.pop()
+                continue
+            if type(s)._eval is not Symbol._eval:
+                # subclasses with their own evaluation (_Const) keep
+                # their polymorphic hook
+                cache[id(s)] = s._eval(values, cache)
+                stack.pop()
+                continue
+            if s._is_var():
+                if s._name not in values:
+                    raise MXNetError(
+                        f"missing value for argument {s._name}")
+                cache[id(s)] = values[s._name]
+                stack.pop()
+                continue
+            pending = [i for i in s._inputs if id(i) not in cache]
+            if pending:
+                stack.extend(reversed(pending))
+                continue
+            if s._op is None:  # group: members contribute first outputs
+                cache[id(s)] = tuple(
+                    _first_output(i, indexed(i)) for i in s._inputs)
+            else:
+                op = _registry.get(s._op)
+                args = [_first_output(i, indexed(i)) for i in s._inputs]
+                attrs = s._attrs
+                if s._op in _MODE_OPS and "training" not in attrs:
+                    # executor-driven train/predict mode (reference:
+                    # is_train on the graph executor; nnvm ops read the
+                    # mode, not an attr)
+                    attrs = dict(attrs, training=_TRAIN_MODE[0])
+                cache[id(s)] = op.fn(*args, **attrs)
+            stack.pop()
+        return indexed(self)
 
     def eval(self, ctx=None, **kwargs):
         """Evaluate eagerly from name->NDArray kwargs (reference API)."""

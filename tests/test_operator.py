@@ -374,3 +374,88 @@ class TestDeformableConvolution:
                 nd.array(x), nd.array(bad), nd.array(w), kernel=(3, 3),
                 stride=(2, 2), pad=(1, 1), num_filter=2,
                 num_deformable_group=2, no_bias=True)
+
+
+class TestLegacyLossHeads:
+    """Round-4 tail: regression/SVM/MakeLoss heads (reference
+    regression_output.cc, svm_output.cc, make_loss.cc [unverified]) —
+    forward is the prediction, backward injects the loss gradient."""
+
+    def test_linear_regression_output(self):
+        rng = np.random.RandomState(0)
+        d = nd.array(rng.rand(4, 3).astype(np.float32))
+        lab = nd.array(rng.rand(4, 3).astype(np.float32))
+        d.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.LinearRegressionOutput(d, lab)
+        np.testing.assert_allclose(out.asnumpy(), d.asnumpy())
+        out.backward()
+        np.testing.assert_allclose(
+            d.grad.asnumpy(), (d.asnumpy() - lab.asnumpy()) / 3, rtol=1e-5)
+
+    def test_logistic_regression_output(self):
+        rng = np.random.RandomState(1)
+        d = nd.array(rng.randn(4, 1).astype(np.float32))
+        lab = nd.array(rng.randint(0, 2, (4, 1)).astype(np.float32))
+        d.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.LogisticRegressionOutput(d, lab)
+        sig = 1 / (1 + np.exp(-d.asnumpy()))
+        np.testing.assert_allclose(out.asnumpy(), sig, rtol=1e-5)
+        out.backward()
+        np.testing.assert_allclose(d.grad.asnumpy(), sig - lab.asnumpy(),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mae_regression_output(self):
+        d = nd.array(np.asarray([[2.0, -1.0]], np.float32))
+        lab = nd.array(np.asarray([[0.0, 0.0]], np.float32))
+        d.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.MAERegressionOutput(d, lab)
+        out.backward()
+        np.testing.assert_allclose(d.grad.asnumpy(), [[0.5, -0.5]])
+
+    def test_make_loss(self):
+        d = nd.array(np.asarray([1.0, 2.0], np.float32))
+        d.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.MakeLoss(d, grad_scale=2.0)
+        np.testing.assert_allclose(out.asnumpy(), d.asnumpy())
+        out.backward()
+        np.testing.assert_allclose(d.grad.asnumpy(), [2.0, 2.0])
+
+    def test_svm_output(self):
+        d = nd.array(np.asarray([[2.0, 1.0, 0.0]], np.float32))
+        lab = nd.array(np.asarray([0.0], np.float32))
+        d.attach_grad()
+        with mx.autograd.record():
+            out = mx.nd.SVMOutput(d, lab, margin=1.0, use_linear=True)
+        np.testing.assert_allclose(out.asnumpy(), d.asnumpy())
+        out.backward()
+        g = d.grad.asnumpy()
+        # class 1 violates (1 - 2 + 1 = 0 not > 0)? boundary: not viol;
+        # class 2: 0 - 2 + 1 = -1 < 0 not viol -> but class1 at margin
+        # boundary (>0 strict) -> no violations -> zero grad
+        np.testing.assert_allclose(g, np.zeros((1, 3)))
+        d2 = nd.array(np.asarray([[0.5, 1.0, 0.0]], np.float32))
+        d2.attach_grad()
+        with mx.autograd.record():
+            out2 = mx.nd.SVMOutput(d2, lab, margin=1.0, use_linear=True)
+        out2.backward()
+        g2 = d2.grad.asnumpy()
+        assert g2[0, 1] > 0 and g2[0, 0] < 0  # label pushed up, violator down
+
+    def test_cumsum_batch_take_ravel(self):
+        x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+        np.testing.assert_allclose(
+            mx.nd.cumsum(x, axis=1).asnumpy(),
+            np.cumsum(np.arange(6).reshape(2, 3), axis=1))
+        idx = nd.array(np.asarray([2, 0], np.float32))
+        np.testing.assert_allclose(
+            mx.nd.batch_take(x, idx).asnumpy(), [2.0, 3.0])
+        flat = mx.nd.unravel_index(nd.array(np.asarray([5], np.float32)),
+                                   shape=(2, 3)).asnumpy()
+        np.testing.assert_array_equal(flat.ravel(), [1, 2])
+        r = mx.nd.ravel_multi_index(
+            nd.array(np.asarray([[1], [2]], np.float32)), shape=(2, 3))
+        np.testing.assert_array_equal(r.asnumpy(), [5])
