@@ -3,8 +3,9 @@
 Measures tokens/sec through the fully-jitted sharded TrainStep (forward +
 backward + optimizer in ONE XLA executable, donated buffers) — BASELINE.md
 config 3, the metric of record "tokens/sec/chip BERT-base pretrain".
-``steps_per_call=10`` runs ten full optimizer steps on ten distinct
-microbatches per dispatch via a device-side lax.scan (parallel/step.py),
+``steps_per_call=STEPS_PER_CALL`` runs that many full optimizer steps on
+distinct microbatches per dispatch via a device-side lax.scan
+(parallel/step.py),
 so host/tunnel dispatch latency is amortized the way a real input pipeline
 would.
 
@@ -26,7 +27,7 @@ import time
 
 import numpy as np
 
-STEPS_PER_CALL = 10
+STEPS_PER_CALL = 40
 SEQ = 128
 WINDOWS = 4
 CALLS_PER_WINDOW = 4
@@ -68,7 +69,7 @@ def _build(batch, seq):
                      compute_dtype="bfloat16", state_dtype="bfloat16",
                      steps_per_call=STEPS_PER_CALL)
     rng = np.random.RandomState(0)
-    n = batch * STEPS_PER_CALL  # 10 DISTINCT microbatches per dispatch
+    n = batch * STEPS_PER_CALL  # STEPS_PER_CALL DISTINCT microbatches per dispatch
     ids = mx.nd.array(rng.randint(0, 30522, (n, seq)), dtype="int32")
     labels = mx.nd.array(rng.randint(0, 30522, (n, seq)), dtype="int32")
     return step, ids, labels

@@ -9,7 +9,7 @@ import numpy as np
 from .common import run_bench
 
 BATCH = 64
-STEPS_PER_CALL = 5
+STEPS_PER_CALL = 20
 # BASELINE.md derived ceiling: ~1e4 images/s/chip at the (optimistic) 45%
 # matmul-MFU framing on v4; ResNet is conv/memory-bound so well below.
 CEILING = 1.0e4
@@ -30,7 +30,7 @@ def main():
         def __call__(self, out, label):
             return loss_fn(out, label)
 
-    # 5 full optimizer steps per dispatch on distinct microbatches
+    # STEPS_PER_CALL full optimizer steps per dispatch on distinct microbatches
     # (device-side scan) — amortizes tunnel dispatch latency
     step_fn = TrainStep(net, _Loss(),
                         opt.SGD(learning_rate=0.1, momentum=0.9),

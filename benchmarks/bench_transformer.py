@@ -9,7 +9,7 @@ import numpy as np
 from .common import run_bench
 
 BATCH, SRC_LEN, TGT_LEN = 64, 64, 64
-STEPS_PER_CALL = 10
+STEPS_PER_CALL = 40
 VOCAB = 32768
 # derived ceiling (BASELINE.md arithmetic style): ~61M non-embedding params
 # => ~0.37 GFLOPs/token train cost; 45% of v4 peak 275T => ~3.3e5 tok/s.
@@ -33,9 +33,10 @@ def main():
         def __call__(self, logits, label):
             return ce(logits.reshape(-1, VOCAB), label.reshape(-1))
 
-    # steps_per_call: ten full optimizer steps on ten DISTINCT
-    # microbatches per dispatch (device-side scan, parallel/step.py) —
-    # amortizes tunnel dispatch latency like a real input pipeline
+    # steps_per_call: STEPS_PER_CALL full optimizer steps on as many
+    # DISTINCT microbatches per dispatch (device-side scan,
+    # parallel/step.py) — amortizes tunnel dispatch latency like a real
+    # input pipeline
     step_fn = TrainStep(net, _Loss(), opt.AdamW(learning_rate=1e-4),
                         compute_dtype="bfloat16", state_dtype="bfloat16",
                         steps_per_call=STEPS_PER_CALL)

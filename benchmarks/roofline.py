@@ -61,7 +61,8 @@ def main():
     else:
         raise SystemExit(f"unsupported config {args.config}")
 
-    float(step(x, y).asscalar())  # compile + stash avals
+    xs = x if isinstance(x, tuple) else (x,)
+    float(step(*xs, y).asscalar())  # compile + stash avals
     spc = getattr(step, "_steps_per_call", 1)
     c = step.cost_analysis()
     flops = c.get("flops", 0.0) / spc
@@ -75,7 +76,7 @@ def main():
     if args.skip_trace:
         return
     trace_dir = tempfile.mkdtemp(prefix="roofline_")
-    capture(step, x, y, trace_dir, args.steps)
+    capture(lambda a, b: step(*xs, y), x, y, trace_dir, args.steps)
     ms = top_ops(trace_dir, args.steps, args.top) / spc
     floor = max(t_f, t_b)
     print(f"per-step device busy: {ms:.2f} ms; measured/floor = "

@@ -41,13 +41,12 @@ def build_resnet50(batch=64, layout="NCHW"):
 def build_transformer(batch=32, seq=64):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, optimizer as opt
-    from mxnet_tpu.gluon.model_zoo.transformer import Transformer
+    from mxnet_tpu.gluon.model_zoo.transformer import transformer_base
     from mxnet_tpu.parallel import TrainStep
 
-    net = Transformer(src_vocab=32000, tgt_vocab=32000, units=512,
-                      hidden_size=2048, num_layers=6, num_heads=8,
-                      max_length=512, dropout=0.1)
-    net.initialize()
+    net = transformer_base(src_vocab=32768, tgt_vocab=32768,
+                           max_length=512, dropout=0.1)
+    net.initialize(mx.initializer.Xavier())
     net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
                       nd.zeros((2, 8), dtype="int32"))
     ce = gluon.loss.SoftmaxCrossEntropyLoss()

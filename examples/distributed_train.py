@@ -80,8 +80,13 @@ def main():
 
     rng = np.random.RandomState(jax.process_index())
     for i in range(args.steps):
-        x = mx.nd.array(rng.rand(args.batch_size, 64).astype(np.float32))
-        y = mx.nd.array(rng.randint(0, 10, args.batch_size))
+        # learnable synthetic task: feature block y*6..y*6+6 lights up
+        xb = rng.rand(args.batch_size, 64).astype(np.float32) * 0.3
+        yb = rng.randint(0, 10, args.batch_size)
+        for j, cls in enumerate(yb):
+            xb[j, cls * 6:cls * 6 + 6] += 1.0
+        x = mx.nd.array(xb)
+        y = mx.nd.array(yb)
         loss = step(x, y)
         if i % 5 == 0 or i == args.steps - 1:
             print(f"step {i}: loss={float(loss.asscalar()):.4f}")
