@@ -119,6 +119,11 @@ _EAGER_JIT_DENY = {
     "RNN",       # dropout path inside the scan body
     "Custom",    # python-callback custom ops manage their own tape/state
     "unique",    # data-dependent output shape
+    # registry random samplers: key drawn in the body, same freeze hazard
+    "_random_uniform", "_random_normal", "_random_gamma",
+    "_random_exponential", "_random_poisson", "_random_randint",
+    "sample_uniform", "sample_normal", "sample_gamma",
+    "sample_exponential", "sample_poisson",
 }
 _FAILED = object()
 

@@ -12,7 +12,7 @@ import jax
 import jax.numpy as jnp
 
 from ..context import Context
-from ..random import next_key
+from ..random import next_key, next_threefry_key
 from .ndarray import NDArray, _unwrap
 
 __all__ = [
@@ -79,13 +79,14 @@ def exponential(scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
 
 
 def poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
-    data = jax.random.poisson(next_key(), lam, _shape(shape)).astype(jnp.dtype(dtype))
+    data = jax.random.poisson(next_threefry_key(), lam,
+                              _shape(shape)).astype(jnp.dtype(dtype))
     return _wrap(data, ctx)
 
 
 def negative_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
     g = jax.random.gamma(next_key(), k, _shape(shape)) * ((1 - p) / p)
-    data = jax.random.poisson(next_key(), g).astype(jnp.dtype(dtype))
+    data = jax.random.poisson(next_threefry_key(), g).astype(jnp.dtype(dtype))
     return _wrap(data, ctx)
 
 

@@ -16,6 +16,7 @@ from . import optimizer_op  # noqa: F401 - registers fused optimizer updates
 from . import fused_loss  # noqa: F401 - registers blocked vocab-proj + CE
 from . import linalg  # noqa: F401 - registers linalg_* (la_op family)
 from . import spatial  # noqa: F401 - registers spatial transformer group
+from . import random_op  # noqa: F401 - registers _random_*/sample_* ops
 from . import params  # noqa: F401 - typed op-param schemas (dmlc::Parameter)
 from .params import P, op_params, describe_op, validate_params, \
     schema_to_json, list_documented_ops

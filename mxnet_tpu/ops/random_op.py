@@ -1,0 +1,124 @@
+"""Registry forms of the random samplers (reference:
+``src/operator/random/sample_op.cc`` and ``multisample_op.cc``
+[unverified]): ``_random_*`` draw a tensor of the given shape from
+scalar distribution params; ``sample_*`` draw per-element — one batch of
+``shape`` samples for every element of the (broadcast) param tensors.
+
+Keys come from the global ``mxnet_tpu.random`` state (eager semantics;
+key-supply scope under hybridize keeps traced graphs pure) — which is
+why these ops sit on the eager-jit deny list like Dropout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+
+__all__ = []
+
+
+def _key():
+    from ..random import next_key
+
+    return next_key()
+
+
+def _threefry_key():
+    from ..random import next_threefry_key
+
+    return next_threefry_key()
+
+
+def _shape(shape):
+    if shape is None:
+        return ()
+    if isinstance(shape, int):
+        return (shape,)
+    return tuple(int(s) for s in shape)
+
+
+def _dt(dtype):
+    return jnp.dtype(dtype if dtype not in (None, "None") else "float32")
+
+
+@register("_random_uniform", aliases=["random_uniform"],
+          differentiable=False)
+def _random_uniform(low=0.0, high=1.0, shape=None, dtype="float32", **kw):
+    return jax.random.uniform(_key(), _shape(shape), _dt(dtype),
+                              minval=float(low), maxval=float(high))
+
+
+@register("_random_normal", aliases=["random_normal"],
+          differentiable=False)
+def _random_normal(loc=0.0, scale=1.0, shape=None, dtype="float32", **kw):
+    return jax.random.normal(_key(), _shape(shape), _dt(dtype)) \
+        * float(scale) + float(loc)
+
+
+@register("_random_gamma", aliases=["random_gamma"], differentiable=False)
+def _random_gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", **kw):
+    return jax.random.gamma(_key(), float(alpha), _shape(shape),
+                            _dt(dtype)) * float(beta)
+
+
+@register("_random_exponential", aliases=["random_exponential"],
+          differentiable=False)
+def _random_exponential(lam=1.0, shape=None, dtype="float32", **kw):
+    return jax.random.exponential(_key(), _shape(shape), _dt(dtype)) \
+        / float(lam)
+
+
+@register("_random_poisson", aliases=["random_poisson"],
+          differentiable=False)
+def _random_poisson(lam=1.0, shape=None, dtype="float32", **kw):
+    return jax.random.poisson(_threefry_key(), float(lam),
+                              _shape(shape)).astype(_dt(dtype))
+
+
+@register("_random_randint", aliases=["random_randint"],
+          differentiable=False)
+def _random_randint(low=0, high=1, shape=None, dtype="int32", **kw):
+    dt = jnp.dtype(dtype if dtype not in (None, "None") else "int32")
+    return jax.random.randint(_key(), _shape(shape), int(low), int(high),
+                              dt)
+
+
+def _per_element(draw, key_fn=None):
+    """sample_*: params (any broadcastable shapes) -> output
+    broadcast(params).shape + shape, one draw batch per element.
+    ``key_fn`` overrides the key source (poisson needs threefry)."""
+
+    def op(*params, shape=None, dtype="float32", **kw):
+        ps = jnp.broadcast_arrays(*[jnp.asarray(p, jnp.float32)
+                                    for p in params])
+        tail = _shape(shape)
+        out = draw((key_fn or _key)(), [p.reshape(-1) for p in ps], tail)
+        return out.reshape(ps[0].shape + tail).astype(_dt(dtype))
+
+    return op
+
+
+def _vmap_draw(fn):
+    def draw(key, flat_params, tail):
+        n = flat_params[0].shape[0]
+        keys = jax.random.split(key, n)
+        return jax.vmap(lambda k, *p: fn(k, p, tail))(keys, *flat_params)
+
+    return draw
+
+
+register("sample_uniform", differentiable=False)(_per_element(_vmap_draw(
+    lambda k, p, tail: jax.random.uniform(
+        k, tail, minval=p[0], maxval=p[1]))))
+register("sample_normal", differentiable=False)(_per_element(_vmap_draw(
+    lambda k, p, tail: jax.random.normal(k, tail) * p[1] + p[0])))
+register("sample_gamma", differentiable=False)(_per_element(_vmap_draw(
+    lambda k, p, tail: jax.random.gamma(k, p[0], tail) * p[1])))
+register("sample_exponential", differentiable=False)(
+    _per_element(_vmap_draw(
+        lambda k, p, tail: jax.random.exponential(k, tail) / p[0])))
+register("sample_poisson", differentiable=False)(_per_element(_vmap_draw(
+    lambda k, p, tail: jax.random.poisson(k, p[0], tail).astype(
+        jnp.float32)), key_fn=_threefry_key))

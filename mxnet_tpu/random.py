@@ -84,3 +84,14 @@ def next_key():
         _GLOBAL_COUNTER += 1
         count = _GLOBAL_COUNTER
     return jax.random.fold_in(jax.random.PRNGKey(_GLOBAL_SEED), count)
+
+
+def next_threefry_key():
+    """A fresh key in the threefry impl, whatever the session PRNG is.
+
+    jax.random.poisson supports only threefry; under the default rbg
+    PRNG (MXNET_TPU_PRNG) every poisson-based sampler derives its key
+    here — deterministic given the global state."""
+    k = next_key()
+    data = jax.random.key_data(k).reshape(-1)[:2].astype(jnp.uint32)
+    return jax.random.wrap_key_data(data, impl="threefry2x32")

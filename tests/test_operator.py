@@ -459,3 +459,41 @@ class TestLegacyLossHeads:
         r = mx.nd.ravel_multi_index(
             nd.array(np.asarray([[1], [2]], np.float32)), shape=(2, 3))
         np.testing.assert_array_equal(r.asnumpy(), [5])
+
+
+class TestRegistryRandomOps:
+    """Registry forms of the samplers (reference sample_op.cc /
+    multisample_op.cc): _random_* from scalars, sample_* per-element."""
+
+    def test_random_ops_shapes_and_stats(self):
+        mx.random.seed(5)
+        u = mx.nd.random_uniform(low=2.0, high=4.0, shape=(2000,)).asnumpy()
+        assert u.shape == (2000,) and 2.0 <= u.min() and u.max() <= 4.0
+        n = mx.nd.random_normal(loc=1.0, scale=0.1, shape=(2000,)).asnumpy()
+        assert abs(n.mean() - 1.0) < 0.02
+        r = mx.nd.random_randint(low=0, high=7, shape=(500,)).asnumpy()
+        assert r.min() >= 0 and r.max() < 7 and r.dtype == np.int32
+        p = mx.nd.random_poisson(lam=4.0, shape=(2000,)).asnumpy()
+        assert abs(p.mean() - 4.0) < 0.3
+        e = mx.nd.random_exponential(lam=2.0, shape=(4000,)).asnumpy()
+        assert abs(e.mean() - 0.5) < 0.05
+        g = mx.nd.random_gamma(alpha=3.0, beta=2.0, shape=(4000,)).asnumpy()
+        assert abs(g.mean() - 6.0) < 0.4
+
+    def test_sample_ops_per_element(self):
+        mx.random.seed(6)
+        lows = nd.array(np.asarray([0.0, 10.0], np.float32))
+        highs = nd.array(np.asarray([1.0, 11.0], np.float32))
+        s = mx.nd.sample_uniform(lows, highs, shape=(500,)).asnumpy()
+        assert s.shape == (2, 500)
+        assert s[0].max() <= 1.0 and 10.0 <= s[1].min() <= s[1].max() <= 11.0
+        mus = nd.array(np.asarray([0.0, 100.0], np.float32))
+        sig = nd.array(np.asarray([1.0, 1.0], np.float32))
+        sn = mx.nd.sample_normal(mus, sig, shape=(800,)).asnumpy()
+        assert abs(sn[0].mean()) < 0.15 and abs(sn[1].mean() - 100.0) < 0.15
+
+    def test_random_ops_draw_fresh(self):
+        mx.random.seed(7)
+        a = mx.nd.random_uniform(shape=(16,)).asnumpy()
+        b = mx.nd.random_uniform(shape=(16,)).asnumpy()
+        assert not np.array_equal(a, b)  # deny-listed from jit freezing
