@@ -557,3 +557,48 @@ register("batch_take")(
     lambda a, indices, **kw: jnp.take_along_axis(
         a, indices.astype(jnp.int32)[:, None], axis=1)[:, 0]
 )
+
+# ------------------------------------------------------------- scalar ops
+# Reference ``elemwise_binary_scalar_op.cc`` [unverified]: tensor-scalar
+# arithmetic registered as distinct ops — the names appear in symbol
+# JSON exported by reference MXNet, so graph loading needs each of them.
+_SCALAR_OPS = {
+    "_plus_scalar": lambda d, s: d + s,
+    "_minus_scalar": lambda d, s: d - s,
+    "_rminus_scalar": lambda d, s: s - d,
+    "_mul_scalar": lambda d, s: d * s,
+    "_div_scalar": lambda d, s: d / s,
+    "_rdiv_scalar": lambda d, s: s / d,
+    "_power_scalar": lambda d, s: jnp.power(d, s),
+    "_rpower_scalar": lambda d, s: jnp.power(s, d),
+    "_maximum_scalar": lambda d, s: jnp.maximum(d, s),
+    "_minimum_scalar": lambda d, s: jnp.minimum(d, s),
+    "_mod_scalar": lambda d, s: jnp.mod(d, s),
+    "_rmod_scalar": lambda d, s: jnp.mod(s, d),
+    "_hypot_scalar": lambda d, s: jnp.hypot(d, s),
+}
+_SCALAR_CMP = {
+    "_equal_scalar": jnp.equal,
+    "_not_equal_scalar": jnp.not_equal,
+    "_greater_scalar": jnp.greater,
+    "_greater_equal_scalar": jnp.greater_equal,
+    "_lesser_scalar": jnp.less,
+    "_lesser_equal_scalar": jnp.less_equal,
+}
+
+
+def _reg_scalar(name, fn, differentiable=True):
+    def op(data, scalar=1.0, **kw):
+        return fn(data, jnp.asarray(scalar, data.dtype))
+
+    op.__name__ = name
+    register(name, differentiable=differentiable)(op)
+
+
+for _name, _fn in _SCALAR_OPS.items():
+    _reg_scalar(_name, _fn)
+for _name, _fn in _SCALAR_CMP.items():
+    def _mk_cmp(f):
+        return lambda d, s: f(d, s).astype(d.dtype)
+
+    _reg_scalar(_name, _mk_cmp(_fn), differentiable=False)

@@ -497,3 +497,34 @@ class TestRegistryRandomOps:
         a = mx.nd.random_uniform(shape=(16,)).asnumpy()
         b = mx.nd.random_uniform(shape=(16,)).asnumpy()
         assert not np.array_equal(a, b)  # deny-listed from jit freezing
+
+
+def test_scalar_op_family():
+    """Reference elemwise_binary_scalar_op names: distinct registry ops
+    (they appear verbatim in reference-exported symbol JSON)."""
+    x = nd.array(np.asarray([[1.0, -2.0], [4.0, 0.5]], np.float32))
+    cases = {
+        "_plus_scalar": x.asnumpy() + 2.0,
+        "_rminus_scalar": 2.0 - x.asnumpy(),
+        "_mul_scalar": x.asnumpy() * 2.0,
+        "_rdiv_scalar": 2.0 / x.asnumpy(),
+        "_power_scalar": x.asnumpy() ** 2.0,
+        "_maximum_scalar": np.maximum(x.asnumpy(), 2.0),
+        "_lesser_scalar": (x.asnumpy() < 2.0).astype(np.float32),
+    }
+    for name, want in cases.items():
+        got = getattr(mx.nd, name)(x, scalar=2.0).asnumpy()
+        np.testing.assert_allclose(got, want, rtol=1e-6, err_msg=name)
+    # gradient flows through the arithmetic ones
+    x.attach_grad()
+    with mx.autograd.record():
+        out = mx.nd._mul_scalar(x, scalar=3.0)
+    out.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full((2, 2), 3.0))
+    # and the names round-trip through symbol JSON (reference graphs)
+    from mxnet_tpu import sym
+    a = sym.var("a")
+    s = sym._mul_scalar(a, scalar=4.0)
+    s2 = sym.load_json(s.tojson())
+    r = s2.eval(a=nd.array(np.ones(3, np.float32)))[0]
+    np.testing.assert_allclose(r.asnumpy(), [4.0, 4.0, 4.0])
