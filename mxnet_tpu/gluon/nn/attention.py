@@ -85,21 +85,41 @@ class MultiHeadAttention(HybridBlock):
         """``valid_length`` (B,) int: number of non-padding KEY positions per
         batch row (reference softmax ``use_length`` semantics); keys past it
         are masked out of the attention."""
+        use_bshd = self._use_bshd()
         if self._self_attention:
             qkv = self.qkv_proj(query)  # (B, S, 3*units)
             B, S = qkv.shape[0], qkv.shape[1]
             qkv = qkv.reshape(B, S, self._num_heads, 3 * self._head_dim)
-            q = self._split_packed(qkv, 0)
-            k = self._split_packed(qkv, 1)
-            v = self._split_packed(qkv, 2)
+            if use_bshd:
+                # transpose-free layout: slices stay (B, S, H, D) and the
+                # bshd attention path consumes them directly (measured
+                # perf-neutral on v5e — see traces/README round-4 copy
+                # audit; kept for the simpler graphs)
+                d = self._head_dim
+                q = qkv[:, :, :, 0 * d:1 * d]
+                k = qkv[:, :, :, 1 * d:2 * d]
+                v = qkv[:, :, :, 2 * d:3 * d]
+            else:
+                q = self._split_packed(qkv, 0)
+                k = self._split_packed(qkv, 1)
+                v = self._split_packed(qkv, 2)
         else:
             if key is None:
                 key = query
             if value is None:
                 value = key
-            q = self._split(self.q_proj(query))
-            k = self._split(self.k_proj(key))
-            v = self._split(self.v_proj(value))
+            if use_bshd:
+                def _heads(x):
+                    return x.reshape(x.shape[0], x.shape[1],
+                                     self._num_heads, self._head_dim)
+
+                q = _heads(self.q_proj(query))
+                k = _heads(self.k_proj(key))
+                v = _heads(self.v_proj(value))
+            else:
+                q = self._split(self.q_proj(query))
+                k = self._split(self.k_proj(key))
+                v = self._split(self.v_proj(value))
         use_ring = self._ring_axis is not None
         if use_ring:
             from ..block import _in_probe
@@ -136,12 +156,27 @@ class MultiHeadAttention(HybridBlock):
             out = F.flash_attention(
                 q, k, v, valid_length, causal=self._causal,
                 sm_scale=1.0 / math.sqrt(self._head_dim),
+                layout="BSHD" if use_bshd else "BHSD",
             )
-        out = self._merge(out)
+        if use_bshd:
+            out = out.reshape(out.shape[0], out.shape[1], self._units)
+        else:
+            out = self._merge(out)
         out = self.out_proj(out)
         if self.drop is not None:
             out = self.drop(out)
         return out
+
+    def _use_bshd(self) -> bool:
+        """Transpose-free (B, S, H, D) attention layout — measured
+        perf-neutral on v5e (traces/README round-4 copy audit), kept as
+        default for the simpler graphs; ring/ulysses shard over explicit
+        head-major arrays, so they keep BHSD. MXTPU_ATTN_BSHD=0 restores
+        head-major."""
+        import os
+
+        return self._ring_axis is None and \
+            os.environ.get("MXTPU_ATTN_BSHD", "1") != "0"
 
     def _split_packed(self, qkv, which):
         # qkv (B, S, H, 3*D) interleaved per head like the reference's

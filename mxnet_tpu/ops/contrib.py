@@ -378,33 +378,58 @@ def _dense_max_seq() -> int:
     return int(_os.environ.get("MXTPU_ATTN_DENSE_MAX", "256"))
 
 
-def _dense_attention(q, k, v, valid_length, causal, sm_scale):
-    """Exact softmax attention; f32 scores, grad via XLA autodiff."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * sm_scale
+def _masked_softmax_probs(s, valid_length, causal):
+    """Shared mask+softmax semantics for both dense layouts: scores s
+    are ALWAYS (B, H, Sq, Sk); keys past valid_length and acausal
+    positions drop out; fully-masked rows (valid_length == 0) zero
+    instead of NaN, like the flash kernel."""
     if valid_length is not None:
-        mask = jnp.arange(k.shape[2])[None, None, None, :] < \
+        mask = jnp.arange(s.shape[3])[None, None, None, :] < \
             valid_length.astype(jnp.int32)[:, None, None, None]
         s = jnp.where(mask, s, -jnp.inf)
     if causal:
-        qi = jnp.arange(q.shape[2])[:, None]
-        ki = jnp.arange(k.shape[2])[None, :]
+        qi = jnp.arange(s.shape[2])[:, None]
+        ki = jnp.arange(s.shape[3])[None, :]
         s = jnp.where(qi >= ki, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
-    # fully-masked rows (valid_length == 0) produce NaN softmax; zero them
-    # like the flash kernel does
     if valid_length is not None:
         p = jnp.where(jnp.isfinite(s).any(-1, keepdims=True), p, 0.0)
+    return p
+
+
+def _dense_attention(q, k, v, valid_length, causal, sm_scale):
+    """Exact softmax attention over (B, H, S, D); f32 scores, grad via
+    XLA autodiff."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    p = _masked_softmax_probs(s, valid_length, causal)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+def _dense_attention_bshd(q, k, v, valid_length, causal, sm_scale):
+    """Exact softmax attention over (B, S, H, D) operands: the einsums
+    carry the head batch dim in place, so the model never writes a head
+    transpose. Measured perf-NEUTRAL on v5e (the per-layer QKV copies
+    in the BERT trace are XLA's backward-residual layout choice, not
+    the transposes — see traces/README round-4 copy audit); kept as the
+    default for the simpler graphs."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    p = _masked_softmax_probs(s, valid_length, causal)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
 @register("_contrib_flash_attention", aliases=["flash_attention"])
 def _flash_attention_op(query, key, value, valid_length=None, causal=False,
-                        sm_scale=None, block_q=128, block_k=128, **kw):
+                        sm_scale=None, block_q=128, block_k=128,
+                        layout="BHSD", **kw):
     """Fused O(S)-memory attention (beyond-reference: replaces the O(L^2)
     interleaved ops of src/operator/contrib/transformer.cc [unverified] as
-    the long-context path). Shapes (B, H, S, D); ``valid_length`` (B,)
-    masks padding keys (reference softmax ``use_length`` semantics).
+    the long-context path). ``layout``: "BHSD" (default) takes
+    (B, H, S, D) operands; "BSHD" takes (B, S, H, D) — transpose-free
+    for layers whose projections emit sequence-major tensors.
+    ``valid_length`` (B,) masks padding keys (reference softmax
+    ``use_length`` semantics).
 
     Short sequences (Sk <= MXTPU_ATTN_DENSE_MAX, default 256; read per
     call) take an exact dense path — at these sizes the score tile is
@@ -421,6 +446,17 @@ def _flash_attention_op(query, key, value, valid_length=None, causal=False,
         valid_length = valid_length.data
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(query.shape[-1])
+    if layout == "BSHD":
+        # transpose-free short-seq path; the Pallas kernel wants BHSD,
+        # so long sequences pay the transpose only when they must
+        if max(query.shape[1], key.shape[1]) <= _dense_max_seq():
+            return _dense_attention_bshd(query, key, value, valid_length,
+                                         bool(causal), float(sm_scale))
+        tq, tk, tv = (x.transpose(0, 2, 1, 3)
+                      for x in (query, key, value))
+        out = _fa(tq, tk, tv, valid_length, bool(causal), sm_scale,
+                  int(block_q), int(block_k))
+        return out.transpose(0, 2, 1, 3)
     if max(query.shape[2], key.shape[2]) <= _dense_max_seq():
         return _dense_attention(query, key, value, valid_length,
                                 bool(causal), float(sm_scale))

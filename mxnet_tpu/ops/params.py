@@ -364,6 +364,8 @@ def _install_builtin_schemas():
         P("sm_scale", "float", default=None, doc="softmax scale (None: 1/sqrt(D))"),
         P("block_q", "int", default=128, low=8, doc="query tile"),
         P("block_k", "int", default=128, low=8, doc="key tile"),
+        P("layout", "str", default="BHSD", choices=("BHSD", "BSHD"),
+          doc="operand layout: head-major or sequence-major (transpose-free)"),
     )
     attach(
         "Embedding",
