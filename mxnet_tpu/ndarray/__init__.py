@@ -116,6 +116,13 @@ def waitall_():  # legacy alias
 
 # generated op functions (mx.nd.dot, mx.nd.Convolution, ...)
 _register.populate_module(sys.modules[__name__], namespace="nd")
+# the registry carries reference-named creation/like ops (_zeros,
+# zeros_like, ...) for symbol-JSON loading; the NATIVE helpers above are
+# the mx.nd surface (they keep ctx= device placement and the reference's
+# val= spelling) — re-assert them over the generated namespace
+for _native in (zeros, ones, full, zeros_like, ones_like, full_like,
+                arange):
+    setattr(sys.modules[__name__], _native.__name__, _native)
 
 from . import sparse  # noqa: E402  (facade; row_sparse/csr)
 from . import contrib  # noqa: E402  (mx.nd.contrib.* incl. control flow)

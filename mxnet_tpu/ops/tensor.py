@@ -602,3 +602,81 @@ for _name, _fn in _SCALAR_CMP.items():
         return lambda d, s: f(d, s).astype(d.dtype)
 
     _reg_scalar(_name, _mk_cmp(_fn), differentiable=False)
+
+# ------------------------------------------- creation + legacy-alias tail
+# Reference ``init_op.cc`` / legacy v1 names [unverified]: the creation
+# ops appear as `_zeros`/`_ones`/`_full`/`_arange` nodes in symbol JSON
+# exported by reference MXNet, so graph loading needs them registered.
+register("_zeros", differentiable=False)(
+    lambda shape=None, dtype="float32", **kw: jnp.zeros(
+        tuple(shape) if not isinstance(shape, int) else (shape,),
+        jnp.dtype(dtype or "float32"))
+)
+register("_ones", differentiable=False)(
+    lambda shape=None, dtype="float32", **kw: jnp.ones(
+        tuple(shape) if not isinstance(shape, int) else (shape,),
+        jnp.dtype(dtype or "float32"))
+)
+register("_full", differentiable=False)(
+    lambda shape=None, value=0.0, dtype="float32", **kw: jnp.full(
+        tuple(shape) if not isinstance(shape, int) else (shape,),
+        value, jnp.dtype(dtype or "float32"))
+)
+register("_arange", differentiable=False)(
+    lambda start=0.0, stop=None, step=1.0, repeat=1, dtype="float32",
+    **kw: jnp.repeat(
+        jnp.arange(start, stop, step, jnp.dtype(dtype or "float32")),
+        int(repeat)) if repeat != 1 else jnp.arange(
+            start, stop, step, jnp.dtype(dtype or "float32"))
+)
+register("zeros_like")(lambda data, **kw: jnp.zeros_like(data))
+register("ones_like")(lambda data, **kw: jnp.ones_like(data))
+register("full_like")(
+    lambda data, fill_value=0.0, **kw: jnp.full_like(data, fill_value)
+)
+register("reverse")(
+    lambda data, axis=0, **kw: jnp.flip(
+        data, axis=tuple(axis) if isinstance(axis, (tuple, list)) else axis)
+)
+register("degrees")(lambda data, **kw: jnp.degrees(data))
+register("radians")(lambda data, **kw: jnp.radians(data))
+register("digamma")(lambda data, **kw: jax.scipy.special.digamma(data))
+register("logical_and", differentiable=False)(
+    lambda lhs, rhs, **kw: jnp.logical_and(lhs, rhs).astype(lhs.dtype))
+register("logical_or", differentiable=False)(
+    lambda lhs, rhs, **kw: jnp.logical_or(lhs, rhs).astype(lhs.dtype))
+register("logical_xor", differentiable=False)(
+    lambda lhs, rhs, **kw: jnp.logical_xor(lhs, rhs).astype(lhs.dtype))
+register("argmax_channel", differentiable=False)(
+    lambda data, **kw: jnp.argmax(data, axis=1).astype(jnp.float32))
+alias("sum_axis", "sum")
+alias("max_axis", "max")
+alias("min_axis", "min")
+alias("_maximum", "broadcast_maximum")
+alias("_minimum", "broadcast_minimum")
+alias("choose_element_0index", "pick")
+
+
+@register("Crop")
+def crop(data, *like, offset=(0, 0), h_w=(0, 0), num_args=1,
+         center_crop=False, **kw):
+    """Legacy spatial crop (reference ``crop.cc`` [unverified]): crop
+    data (N, C, H, W) to ``h_w`` — or to the second input's spatial
+    size when two inputs are given. Offset from top-left, or centered."""
+    if like:
+        th, tw = like[0].shape[2], like[0].shape[3]
+    else:
+        th, tw = int(h_w[0]), int(h_w[1])
+    H, W = data.shape[2], data.shape[3]
+    if th > H or tw > W:
+        raise ValueError(
+            f"Crop: target ({th}, {tw}) larger than input ({H}, {W})")
+    if center_crop:
+        oy, ox = (H - th) // 2, (W - tw) // 2
+    else:
+        oy, ox = int(offset[0]), int(offset[1])
+        if oy + th > H or ox + tw > W:
+            raise ValueError(
+                f"Crop: offset ({oy}, {ox}) + target ({th}, {tw}) runs "
+                f"past the input ({H}, {W})")
+    return data[:, :, oy:oy + th, ox:ox + tw]

@@ -528,3 +528,44 @@ def test_scalar_op_family():
     s2 = sym.load_json(s.tojson())
     r = s2.eval(a=nd.array(np.ones(3, np.float32)))[0]
     np.testing.assert_allclose(r.asnumpy(), [4.0, 4.0, 4.0])
+
+
+def test_creation_and_legacy_tail_ops():
+    """_zeros/_ones/_full/_arange appear in reference symbol JSON;
+    legacy aliases + Crop (crop.cc)."""
+    assert mx.nd._zeros(shape=(2, 3)).asnumpy().sum() == 0
+    np.testing.assert_allclose(mx.nd._full(shape=(2,), value=7).asnumpy(),
+                               [7.0, 7.0])
+    np.testing.assert_allclose(
+        mx.nd._arange(start=0, stop=3, repeat=2).asnumpy(),
+        [0, 0, 1, 1, 2, 2])
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    np.testing.assert_allclose(mx.nd.zeros_like(x).asnumpy(),
+                               np.zeros((2, 3)))
+    np.testing.assert_allclose(mx.nd.ones_like(x).asnumpy(),
+                               np.ones((2, 3)))
+    np.testing.assert_allclose(mx.nd.reverse(x, axis=1).asnumpy(),
+                               np.arange(6, dtype=np.float32
+                                         ).reshape(2, 3)[:, ::-1])
+    np.testing.assert_allclose(mx.nd.degrees(nd.array(
+        np.asarray([np.pi], np.float32))).asnumpy(), [180.0], rtol=1e-5)
+    a = nd.array(np.asarray([1.0, 0.0], np.float32))
+    b = nd.array(np.asarray([1.0, 1.0], np.float32))
+    np.testing.assert_allclose(mx.nd.logical_and(a, b).asnumpy(), [1, 0])
+    s = nd.array(np.random.rand(2, 4, 3).astype(np.float32))
+    np.testing.assert_allclose(mx.nd.argmax_channel(s).asnumpy(),
+                               s.asnumpy().argmax(1))
+    # Crop: offset and like-input forms
+    img = nd.array(np.arange(2 * 1 * 6 * 6, dtype=np.float32
+                             ).reshape(2, 1, 6, 6))
+    c1 = mx.nd.Crop(img, offset=(1, 2), h_w=(3, 3)).asnumpy()
+    np.testing.assert_allclose(c1, img.asnumpy()[:, :, 1:4, 2:5])
+    ref = nd.array(np.zeros((2, 1, 4, 4), np.float32))
+    c2 = mx.nd.Crop(img, ref, num_args=2, center_crop=True).asnumpy()
+    np.testing.assert_allclose(c2, img.asnumpy()[:, :, 1:5, 1:5])
+    # symbol JSON round trip of a creation op (reference graphs embed them)
+    from mxnet_tpu import sym
+    z = sym._arange(start=0, stop=4)
+    out = sym.load_json((z + sym.var("a")).tojson()).eval(
+        a=nd.array(np.ones(4, np.float32)))[0]
+    np.testing.assert_allclose(out.asnumpy(), [1, 2, 3, 4])
