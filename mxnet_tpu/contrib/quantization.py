@@ -713,3 +713,79 @@ def _quantized_dense_block_cls():
 
 def _QuantizedDenseBlock(q):
     return _quantized_dense_block_cls()(q)
+
+
+# ----------------------------------------------- registry op forms (INT8)
+# Reference op names (``src/operator/quantization/quantized_fully_
+# connected.cc``, ``quantized_conv.cc``, ``requantize.cc`` [unverified]):
+# graph-level INT8 execution as registry ops over the same int8 helpers
+# the gluon rewrite uses. Min/max range operands follow the reference's
+# (data, min, max) convention; outputs carry their own range.
+def _install_quantized_ops():
+    from ..ops.registry import maybe_get
+
+    if maybe_get("_contrib_quantized_dense") is not None:
+        return
+
+    @register("_contrib_quantized_dense",
+              aliases=["_contrib_quantized_fully_connected"],
+              num_outputs=3, differentiable=False)
+    def quantized_dense(data, weight, bias, data_min, data_max,
+                        weight_min, weight_max, num_hidden=None,
+                        no_bias=False, **kw):
+        """int8 x int8 -> int32 dense; returns (out_f32-scaled-int32
+        semantics collapsed to f32, out_min, out_max) like the
+        reference's dequantize-fused path."""
+        ds = _scale_from_range(jnp.asarray(data_min), jnp.asarray(data_max))
+        ws = _scale_from_range(jnp.asarray(weight_min),
+                               jnp.asarray(weight_max))
+        acc = jax.lax.dot_general(
+            data, weight.T, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32) * (ds * ws)
+        if bias is not None and not no_bias:
+            acc = acc + bias
+        mx_ = jnp.max(jnp.abs(acc))
+        return acc, -mx_, mx_
+
+    @register("_contrib_quantized_conv", num_outputs=3,
+              differentiable=False)
+    def quantized_conv(data, weight, bias, data_min, data_max,
+                       weight_min, weight_max, kernel=None, stride=(1, 1),
+                       pad=(0, 0), dilate=(1, 1), num_filter=None,
+                       num_group=1, no_bias=False, **kw):
+        """int8 conv with int32 accumulation (NCHW), dequantized by the
+        product of scales; returns (out, out_min, out_max)."""
+        ds = _scale_from_range(jnp.asarray(data_min), jnp.asarray(data_max))
+        ws = _scale_from_range(jnp.asarray(weight_min),
+                               jnp.asarray(weight_max))
+        nd_sp = data.ndim - 2
+        spatial = "DHW"[-nd_sp:]
+        acc = jax.lax.conv_general_dilated(
+            data, weight, window_strides=tuple(stride),
+            padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+            dimension_numbers=("NC" + spatial, "OI" + spatial,
+                               "NC" + spatial),
+            feature_group_count=num_group,
+            preferred_element_type=jnp.int32,
+        ).astype(jnp.float32) * (ds * ws)
+        if bias is not None and not no_bias:
+            acc = acc + bias.reshape((1, -1) + (1,) * nd_sp)
+        mx_ = jnp.max(jnp.abs(acc))
+        return acc, -mx_, mx_
+
+    @register("_contrib_requantize", num_outputs=3, differentiable=False)
+    def requantize(data, min_range, max_range, min_calib_range=None,
+                   max_calib_range=None, **kw):
+        """f32 (or wide) -> int8 at the calibrated range (reference
+        requantize.cc collapsing int32+ranges to int8)."""
+        lo = min_calib_range if min_calib_range is not None else min_range
+        hi = max_calib_range if max_calib_range is not None else max_range
+        scale = _scale_from_range(jnp.asarray(lo), jnp.asarray(hi))
+        q = jnp.clip(jnp.round(data.astype(jnp.float32) / scale),
+                     -127, 127).astype(jnp.int8)
+        return q, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
+
+
+_install_quantized_ops()
+_refresh_namespaces()

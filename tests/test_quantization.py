@@ -389,3 +389,53 @@ class TestQuantChainSafety:
                     if r[1] == "Conv2D"][0]
         assert "bn" not in conv_row[3]  # stayed a separate float BN
         assert qnet._children[list(qnet._children.keys())[1]] is bn
+
+
+def test_quantized_op_forms():
+    """Reference INT8 op names as registry ops: quantized_dense /
+    quantized_conv / requantize with (data, min, max) range operands."""
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    xf = rng.randn(4, 8).astype(np.float32)
+    wf = (rng.randn(3, 8) * 0.1).astype(np.float32)
+    b = rng.randn(3).astype(np.float32)
+    xs = np.abs(xf).max() / 127.0
+    ws = np.abs(wf).max() / 127.0
+    xq = np.clip(np.round(xf / xs), -127, 127).astype(np.int8)
+    wq = np.clip(np.round(wf / ws), -127, 127).astype(np.int8)
+    out, lo, hi = mx.nd._contrib_quantized_dense(
+        nd.array(xq), nd.array(wq), nd.array(b),
+        nd.array(np.float32(-np.abs(xf).max())),
+        nd.array(np.float32(np.abs(xf).max())),
+        nd.array(np.float32(-np.abs(wf).max())),
+        nd.array(np.float32(np.abs(wf).max())), num_hidden=3)
+    ref = xf @ wf.T + b
+    np.testing.assert_allclose(out.asnumpy(), ref, rtol=0.1, atol=0.05)
+    assert float(lo.asnumpy()) < 0 < float(hi.asnumpy())
+
+    # requantize to int8 at a calibrated range
+    q, qlo, qhi = mx.nd._contrib_requantize(
+        out, lo, hi, min_calib_range=-3.0, max_calib_range=3.0)
+    assert q.asnumpy().dtype == np.int8
+    back = q.asnumpy().astype(np.float32) * (3.0 / 127.0)
+    np.testing.assert_allclose(back, np.clip(ref, -3, 3), atol=0.1)
+
+    # quantized conv
+    imgf = rng.randn(2, 3, 6, 6).astype(np.float32)
+    kf = (rng.randn(4, 3, 3, 3) * 0.1).astype(np.float32)
+    is_, ks = np.abs(imgf).max() / 127.0, np.abs(kf).max() / 127.0
+    iq = np.clip(np.round(imgf / is_), -127, 127).astype(np.int8)
+    kq = np.clip(np.round(kf / ks), -127, 127).astype(np.int8)
+    co, clo, chi = mx.nd._contrib_quantized_conv(
+        nd.array(iq), nd.array(kq), None,
+        nd.array(np.float32(-np.abs(imgf).max())),
+        nd.array(np.float32(np.abs(imgf).max())),
+        nd.array(np.float32(-np.abs(kf).max())),
+        nd.array(np.float32(np.abs(kf).max())),
+        kernel=(3, 3), num_filter=4, no_bias=True)
+    import jax
+    refc = np.asarray(jax.lax.conv_general_dilated(
+        jnp.asarray(imgf), jnp.asarray(kf), (1, 1), [(0, 0), (0, 0)],
+        dimension_numbers=("NCHW", "OIHW", "NCHW")))
+    np.testing.assert_allclose(co.asnumpy(), refc, rtol=0.15, atol=0.1)
