@@ -91,6 +91,21 @@ else:  # pragma: no cover - double import guard
     dequantize = maybe_get("_contrib_dequantize").fn
 
 
+def _int8_conv(x_q, w_q, stride, pad, dilate, groups):
+    """int8 x int8 -> int32 convolution in NC[DHW] layout (shared by
+    QuantizedConv2D, QuantizedConvUnit, and the registry op); caller
+    applies the dequant scales."""
+    nd_sp = x_q.ndim - 2
+    spatial = "DHW"[-nd_sp:]
+    return jax.lax.conv_general_dilated(
+        x_q, w_q, window_strides=tuple(stride),
+        padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
+        dimension_numbers=("NC" + spatial, "OI" + spatial, "NC" + spatial),
+        feature_group_count=groups,
+        preferred_element_type=jnp.int32,
+    ).astype(jnp.float32)
+
+
 def _int8_matmul(x_q, w_q_t, x_scale, w_scale):
     """(M,K)i8 @ (K,N)i8 -> f32, accumulating in int32 on the MXU."""
     acc = jax.lax.dot_general(
@@ -222,22 +237,12 @@ class QuantizedConv2D:
         def fwd(xd):
             x_q = _quantize_act(xd, self._act_scale)
             nd_sp = x_q.ndim - 2
-            spatial = "DHW"[-nd_sp:]
             stride = kw.get("stride") or (1,) * nd_sp
             dilate = kw.get("dilate") or (1,) * nd_sp
             pad = kw.get("pad") or (0,) * nd_sp
-            out32 = jax.lax.conv_general_dilated(
-                x_q, self._w_q,
-                window_strides=tuple(stride),
-                padding=[(p, p) for p in pad],
-                rhs_dilation=tuple(dilate),
-                dimension_numbers=("NC" + spatial, "OI" + spatial,
-                                   "NC" + spatial),
-                feature_group_count=kw.get("num_group", 1),
-                preferred_element_type=jnp.int32,
-            )
-            out = out32.astype(jnp.float32) * (self._act_scale
-                                               * self._w_scale)
+            out = _int8_conv(x_q, self._w_q, stride, pad, dilate,
+                             kw.get("num_group", 1)) \
+                * (self._act_scale * self._w_scale)
             if self._bias is not None:
                 out = out + self._bias.reshape((1, -1) + (1,) * nd_sp)
             return out
@@ -317,22 +322,13 @@ class QuantizedConvUnit:
         def fwd(xd):
             x_q = xd if preq else _quantize_act(xd, s_in)
             nd_sp = x_q.ndim - 2
-            spatial = "DHW"[-nd_sp:]
             stride = kw.get("stride") or (1,) * nd_sp
             dilate = kw.get("dilate") or (1,) * nd_sp
             pad = kw.get("pad") or (0,) * nd_sp
-            acc = jax.lax.conv_general_dilated(
-                x_q, self._w_q,
-                window_strides=tuple(stride),
-                padding=[(p, p) for p in pad],
-                rhs_dilation=tuple(dilate),
-                dimension_numbers=("NC" + spatial, "OI" + spatial,
-                                   "NC" + spatial),
-                feature_group_count=kw.get("num_group", 1),
-                preferred_element_type=jnp.int32,
-            )
+            acc = _int8_conv(x_q, self._w_q, stride, pad, dilate,
+                             kw.get("num_group", 1))
             mult = (s_in * self._mult).reshape((1, -1) + (1,) * nd_sp)
-            out = acc.astype(jnp.float32) * mult
+            out = acc * mult
             if self._bias is not None:
                 out = out + self._bias.reshape((1, -1) + (1,) * nd_sp)
             if self._relu:
@@ -727,49 +723,59 @@ def _install_quantized_ops():
     if maybe_get("_contrib_quantized_dense") is not None:
         return
 
+    def _split_q_args(args, no_bias):
+        """Reference arity: (bias?, data_min, data_max, w_min, w_max) —
+        the bias operand is OMITTED under no_bias (6-input form)."""
+        if no_bias or len(args) == 4:
+            return (None,) + tuple(args[-4:])
+        if len(args) != 5:
+            raise MXNetError(
+                "quantized op expects (bias, data_min, data_max, "
+                "weight_min, weight_max) or the 4-range no_bias form, "
+                f"got {len(args)} trailing operands")
+        return tuple(args)
+
     @register("_contrib_quantized_dense",
               aliases=["_contrib_quantized_fully_connected"],
               num_outputs=3, differentiable=False)
-    def quantized_dense(data, weight, bias, data_min, data_max,
-                        weight_min, weight_max, num_hidden=None,
+    def quantized_dense(data, weight, *args, num_hidden=None,
                         no_bias=False, **kw):
-        """int8 x int8 -> int32 dense; returns (out_f32-scaled-int32
-        semantics collapsed to f32, out_min, out_max) like the
-        reference's dequantize-fused path."""
+        """int8 x int8 -> int32 dense; returns (out collapsed to f32,
+        out_min, out_max) like the reference's dequantize-fused path.
+        Trailing operands: (bias?, data_min, data_max, weight_min,
+        weight_max) — bias omitted under no_bias (reference arity)."""
+        bias, data_min, data_max, weight_min, weight_max = \
+            _split_q_args(args, no_bias)
         ds = _scale_from_range(jnp.asarray(data_min), jnp.asarray(data_max))
         ws = _scale_from_range(jnp.asarray(weight_min),
                                jnp.asarray(weight_max))
-        acc = jax.lax.dot_general(
-            data, weight.T, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.int32,
-        ).astype(jnp.float32) * (ds * ws)
-        if bias is not None and not no_bias:
+        acc = _int8_matmul(data, weight.T, ds, ws)
+        if bias is not None:
             acc = acc + bias
         mx_ = jnp.max(jnp.abs(acc))
         return acc, -mx_, mx_
 
     @register("_contrib_quantized_conv", num_outputs=3,
               differentiable=False)
-    def quantized_conv(data, weight, bias, data_min, data_max,
-                       weight_min, weight_max, kernel=None, stride=(1, 1),
-                       pad=(0, 0), dilate=(1, 1), num_filter=None,
+    def quantized_conv(data, weight, *args, kernel=None, stride=None,
+                       pad=None, dilate=None, num_filter=None,
                        num_group=1, no_bias=False, **kw):
-        """int8 conv with int32 accumulation (NCHW), dequantized by the
-        product of scales; returns (out, out_min, out_max)."""
+        """int8 conv with int32 accumulation (NC[DHW]), dequantized by
+        the product of scales; returns (out, out_min, out_max).
+        Trailing operands as in quantized_dense; stride/pad/dilate
+        default per the input's spatial rank."""
+        bias, data_min, data_max, weight_min, weight_max = \
+            _split_q_args(args, no_bias)
         ds = _scale_from_range(jnp.asarray(data_min), jnp.asarray(data_max))
         ws = _scale_from_range(jnp.asarray(weight_min),
                                jnp.asarray(weight_max))
         nd_sp = data.ndim - 2
-        spatial = "DHW"[-nd_sp:]
-        acc = jax.lax.conv_general_dilated(
-            data, weight, window_strides=tuple(stride),
-            padding=[(p, p) for p in pad], rhs_dilation=tuple(dilate),
-            dimension_numbers=("NC" + spatial, "OI" + spatial,
-                               "NC" + spatial),
-            feature_group_count=num_group,
-            preferred_element_type=jnp.int32,
-        ).astype(jnp.float32) * (ds * ws)
-        if bias is not None and not no_bias:
+        stride = tuple(stride) if stride is not None else (1,) * nd_sp
+        pad = tuple(pad) if pad is not None else (0,) * nd_sp
+        dilate = tuple(dilate) if dilate is not None else (1,) * nd_sp
+        acc = _int8_conv(data, weight, stride, pad, dilate,
+                         num_group) * (ds * ws)
+        if bias is not None:
             acc = acc + bias.reshape((1, -1) + (1,) * nd_sp)
         mx_ = jnp.max(jnp.abs(acc))
         return acc, -mx_, mx_
@@ -782,8 +788,7 @@ def _install_quantized_ops():
         lo = min_calib_range if min_calib_range is not None else min_range
         hi = max_calib_range if max_calib_range is not None else max_range
         scale = _scale_from_range(jnp.asarray(lo), jnp.asarray(hi))
-        q = jnp.clip(jnp.round(data.astype(jnp.float32) / scale),
-                     -127, 127).astype(jnp.int8)
+        q = _quantize_act(data.astype(jnp.float32), scale)
         return q, jnp.asarray(lo, jnp.float32), jnp.asarray(hi, jnp.float32)
 
 

@@ -439,3 +439,22 @@ def test_quantized_op_forms():
         jnp.asarray(imgf), jnp.asarray(kf), (1, 1), [(0, 0), (0, 0)],
         dimension_numbers=("NCHW", "OIHW", "NCHW")))
     np.testing.assert_allclose(co.asnumpy(), refc, rtol=0.15, atol=0.1)
+
+
+def test_quantized_dense_no_bias_reference_arity():
+    """Review fix: the 6-input no_bias form (bias operand omitted) must
+    bind correctly — reference-derived graphs use this arity."""
+    rng = np.random.RandomState(1)
+    xf = rng.randn(2, 4).astype(np.float32)
+    wf = (rng.randn(3, 4) * 0.1).astype(np.float32)
+    xs, ws = np.abs(xf).max() / 127.0, np.abs(wf).max() / 127.0
+    xq = np.clip(np.round(xf / xs), -127, 127).astype(np.int8)
+    wq = np.clip(np.round(wf / ws), -127, 127).astype(np.int8)
+    out, _, _ = mx.nd._contrib_quantized_dense(
+        nd.array(xq), nd.array(wq),
+        nd.array(np.float32(-np.abs(xf).max())),
+        nd.array(np.float32(np.abs(xf).max())),
+        nd.array(np.float32(-np.abs(wf).max())),
+        nd.array(np.float32(np.abs(wf).max())), no_bias=True)
+    np.testing.assert_allclose(out.asnumpy(), xf @ wf.T, rtol=0.1,
+                               atol=0.05)
