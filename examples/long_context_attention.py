@@ -1,0 +1,85 @@
+"""Long-context attention walkthrough: the beyond-reference capability
+(SURVEY §5 — the reference's attention materialized the O(L^2) score
+matrix; this build's flash kernel is O(S), and ring/Ulysses shard the
+sequence over a device mesh).
+
+Runs the same MultiHeadAttention layer three ways and checks parity:
+1. dense exact attention (short-seq path),
+2. Pallas flash kernel (O(S) memory, long-context path),
+3. ring attention over a sequence-sharded device mesh.
+
+    python examples/long_context_attention.py [--seq 1024] [--devices 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq", type=int, default=1024)
+    ap.add_argument("--devices", type=int, default=4)
+    ap.add_argument("--tpu", action="store_true",
+                    help="run on real devices instead of the virtual CPU mesh")
+    args = ap.parse_args()
+
+    # a virtual CPU mesh is enough to demonstrate the sharded path
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + f" --xla_force_host_platform_device_count="
+                                 f"{args.devices}").strip()
+    import jax
+
+    if not args.tpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.nn.attention import MultiHeadAttention
+    from mxnet_tpu.parallel import make_mesh, mesh_scope
+
+    B, S, H, U = 2, args.seq, 4, 128
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.randn(B, S, U).astype(np.float32) * 0.1)
+
+    mx.random.seed(0)
+    attn = MultiHeadAttention(U, H, self_attention=True)
+    attn.initialize()
+
+    # 1. dense exact path (force it regardless of S)
+    os.environ["MXTPU_ATTN_DENSE_MAX"] = str(10 ** 9)
+    dense = attn(x).asnumpy()
+    # 2. O(S)-memory flash kernel
+    os.environ["MXTPU_ATTN_DENSE_MAX"] = "0"
+    flash = attn(x).asnumpy()
+    del os.environ["MXTPU_ATTN_DENSE_MAX"]
+    err_flash = np.abs(dense - flash).max()
+    print(f"flash vs dense max abs err: {err_flash:.2e}")
+
+    # 3. ring attention: sequence axis sharded over the mesh
+    mesh = make_mesh({"seq": args.devices})
+    ring_attn = MultiHeadAttention(U, H, self_attention=True,
+                                   ring_axis="seq")
+    ring_attn.initialize()
+    # share weights with the single-device layer for parity
+    for (_, p_src), (_, p_dst) in zip(
+            sorted(attn.collect_params().items()),
+            sorted(ring_attn.collect_params().items())):
+        p_dst.set_data(p_src.data())
+    with mesh_scope(mesh):
+        ring = ring_attn(x).asnumpy()
+    err_ring = np.abs(dense - ring).max()
+    print(f"ring({args.devices} devices) vs dense max abs err: "
+          f"{err_ring:.2e}")
+    assert err_flash < 5e-5 and err_ring < 5e-5
+    print(f"long-context attention parity OK at S={S}")
+
+
+if __name__ == "__main__":
+    main()

@@ -127,6 +127,28 @@ _EAGER_JIT_DENY = {
 }
 _FAILED = object()
 
+# ops whose BODIES read env vars at trace time: the var's current value
+# must be part of the cache key, or flipping it after the first call is
+# silently ignored (the trace froze the old branch — found when a
+# long-context example measured flash == dense EXACTLY because both hit
+# one cached executable)
+_ENV_KEYED_OPS = {
+    # (MXTPU_FLASH_BWD is NOT here: it binds at import; the runtime
+    # switch is set_flash_backward(), which clears jax caches itself)
+    "_contrib_flash_attention": ("MXTPU_ATTN_DENSE_MAX",),
+    "BatchNorm": ("MXTPU_FUSED_BN",),
+    "linear_cross_entropy": ("MXTPU_CE_DENSE_MAX_BYTES",),
+}
+
+
+def _env_fingerprint(op_name):
+    import os
+
+    keys = _ENV_KEYED_OPS.get(op_name)
+    if not keys:
+        return ()
+    return tuple(os.environ.get(k) for k in keys)
+
 
 def _freeze(v):
     if isinstance(v, (list, tuple)):
@@ -154,7 +176,8 @@ def _op_jit_key(op, params):
             # NDArray rebinding would silently stale them) — stay eager
             return None
     try:
-        key = ("op", op.name, _freeze(tuple(sorted(params.items()))))
+        key = ("op", op.name, _freeze(tuple(sorted(params.items()))),
+               _env_fingerprint(op.name))
         hash(key)
     except TypeError:
         return None

@@ -177,3 +177,27 @@ def test_proposal_flat_layout():
     assert batched.shape == (2, 5, 5)
     assert flat.shape == (10, 5)
     np.testing.assert_allclose(flat, batched.reshape(10, 5))
+
+
+def test_env_keyed_ops_not_frozen():
+    """Ops whose bodies read env vars must re-trace when the var flips:
+    MXTPU_ATTN_DENSE_MAX=0 must genuinely select the flash kernel (found
+    via a long-context example where flash == dense EXACTLY because both
+    calls hit one cached executable)."""
+    rng = np.random.RandomState(0)
+    q = nd.array(rng.randn(1, 2, 64, 16).astype(np.float32) * 0.1)
+    os.environ["MXTPU_EAGER_JIT"] = "1"
+    try:
+        before = len(imperative._EAGER_FWD_CACHE)
+        os.environ["MXTPU_ATTN_DENSE_MAX"] = "1000000"
+        dense = mx.nd.contrib.flash_attention(q, q, q).asnumpy()
+        mid = len(imperative._EAGER_FWD_CACHE)
+        os.environ["MXTPU_ATTN_DENSE_MAX"] = "0"
+        flash = mx.nd.contrib.flash_attention(q, q, q).asnumpy()
+        after = len(imperative._EAGER_FWD_CACHE)
+        # distinct cache entries per env value: the second call re-traced
+        assert mid > before and after > mid, (before, mid, after)
+        np.testing.assert_allclose(flash, dense, rtol=2e-4, atol=2e-5)
+    finally:
+        os.environ.pop("MXTPU_ATTN_DENSE_MAX", None)
+        os.environ.pop("MXTPU_EAGER_JIT", None)

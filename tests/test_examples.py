@@ -56,3 +56,21 @@ def test_distributed_train_tp():
     out = _run("distributed_train.py", "--steps", "4", "--batch-size", "8",
                "--tp", "2", "--force-cpu")
     assert "done" in out
+
+
+def test_int8_inference_example():
+    out = _run("int8_inference.py", "--steps", "25")
+    assert "quantized 3/3" in out
+    m = re.search(r"int8 accuracy:\s+([0-9.]+)", out)
+    assert m and float(m.group(1)) > 0.9
+
+
+def test_onnx_interchange_example(tmp_path):
+    out = _run("onnx_interchange.py", "--out",
+               str(tmp_path / "m.onnx"))
+    assert "onnx interchange OK" in out
+
+
+def test_long_context_attention_example():
+    out = _run("long_context_attention.py", "--seq", "512")
+    assert "long-context attention parity OK" in out
