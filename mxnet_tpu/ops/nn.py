@@ -796,3 +796,49 @@ def svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
     (reference svm_output.cc)."""
     return _svm_core(data, label, float(margin),
                      float(regularization_coefficient), bool(use_linear))
+
+
+@register("CTCLoss", aliases=["ctc_loss", "_contrib_CTCLoss",
+                              "_contrib_ctc_loss"])
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first", **kw):
+    """Connectionist temporal classification loss (reference:
+    ``src/operator/nn/ctc_loss.cc`` over warp-ctc [unverified]; here the
+    optax forward-algorithm implementation drives the same contract).
+
+    data: (T, N, C) unnormalized activations (reference layout);
+    label: (N, L) int class ids, 0-padded unless label lengths given.
+    Returns (N,) negative log-likelihoods. ``blank_label``: 'first'
+    (blank = id 0, labels 1-based like the reference default) or 'last'
+    (blank = C-1, labels 0-based).
+    """
+    import optax
+
+    T, N, C = data.shape
+    logits = jnp.transpose(data, (1, 0, 2)).astype(jnp.float32)  # (N,T,C)
+    lab = label.astype(jnp.int32)
+    if use_data_lengths and data_lengths is not None:
+        dl = data_lengths.astype(jnp.int32)
+        logit_pad = (jnp.arange(T)[None, :] >= dl[:, None]
+                     ).astype(jnp.float32)
+    else:
+        logit_pad = jnp.zeros((N, T), jnp.float32)
+    if use_label_lengths and label_lengths is not None:
+        ll = label_lengths.astype(jnp.int32)
+        label_pad = (jnp.arange(lab.shape[1])[None, :] >= ll[:, None]
+                     ).astype(jnp.float32)
+    else:
+        # reference padding convention without lengths: 0 marks padding
+        # (labels are 1-based under blank_label='first')
+        label_pad = (lab == 0).astype(jnp.float32) \
+            if blank_label == "first" else jnp.zeros_like(lab, jnp.float32)
+    if blank_label == "first":
+        blank_id = 0
+    elif blank_label == "last":
+        blank_id = C - 1
+    else:
+        raise ValueError(f"blank_label must be 'first' or 'last', got "
+                         f"{blank_label!r}")
+    return optax.ctc_loss(logits, logit_pad, lab, label_pad,
+                          blank_id=blank_id)

@@ -569,3 +569,41 @@ def test_creation_and_legacy_tail_ops():
     out = sym.load_json((z + sym.var("a")).tojson()).eval(
         a=nd.array(np.ones(4, np.float32)))[0]
     np.testing.assert_allclose(out.asnumpy(), [1, 2, 3, 4])
+
+
+def test_ctc_loss():
+    """CTCLoss over the optax forward algorithm (reference warp-ctc
+    contract: (T, N, C) activations, per-sample NLL)."""
+    rng = np.random.RandomState(0)
+    T, N, C, L = 10, 2, 5, 3
+    data = nd.array(rng.randn(T, N, C).astype(np.float32))
+    label = nd.array(np.asarray([[1, 2, 3], [2, 4, 0]], np.float32))
+    out = mx.nd.CTCLoss(data, label).asnumpy()
+    assert out.shape == (N,)
+    assert (out > 0).all() and np.isfinite(out).all()
+    # a sequence that matches its only label perfectly should have a
+    # much smaller loss than a contradicting one
+    strong = np.full((6, 1, 3), -10.0, np.float32)
+    strong[:, 0, 1] = 10.0  # class 1 at every step
+    l_match = mx.nd.CTCLoss(nd.array(strong),
+                            nd.array(np.asarray([[1]], np.float32))
+                            ).asnumpy()[0]
+    l_wrong = mx.nd.CTCLoss(nd.array(strong),
+                            nd.array(np.asarray([[2]], np.float32))
+                            ).asnumpy()[0]
+    assert l_match < 1.0 < l_wrong
+    # gradients flow (training usability)
+    x = nd.array(rng.randn(T, N, C).astype(np.float32))
+    x.attach_grad()
+    with mx.autograd.record():
+        loss = mx.nd.CTCLoss(x, label).sum()
+    loss.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    # explicit lengths path
+    out2 = mx.nd.CTCLoss(data, label,
+                         nd.array(np.asarray([10, 8], np.float32)),
+                         nd.array(np.asarray([3, 2], np.float32)),
+                         use_data_lengths=True,
+                         use_label_lengths=True).asnumpy()
+    assert out2.shape == (N,) and np.isfinite(out2).all()
