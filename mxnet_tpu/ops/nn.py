@@ -829,10 +829,11 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
         label_pad = (jnp.arange(lab.shape[1])[None, :] >= ll[:, None]
                      ).astype(jnp.float32)
     else:
-        # reference padding convention without lengths: 0 marks padding
-        # (labels are 1-based under blank_label='first')
-        label_pad = (lab == 0).astype(jnp.float32) \
-            if blank_label == "first" else jnp.zeros_like(lab, jnp.float32)
+        # reference padding conventions without explicit lengths:
+        # 0 marks padding under blank_label='first' (labels 1-based),
+        # -1 under blank_label='last' (labels 0-based)
+        pad_id = 0 if blank_label == "first" else -1
+        label_pad = (lab == pad_id).astype(jnp.float32)
     if blank_label == "first":
         blank_id = 0
     elif blank_label == "last":
@@ -840,5 +841,6 @@ def ctc_loss(data, label, data_lengths=None, label_lengths=None,
     else:
         raise ValueError(f"blank_label must be 'first' or 'last', got "
                          f"{blank_label!r}")
+    lab = jnp.where(label_pad > 0, 0, lab)  # padded slots: any valid id
     return optax.ctc_loss(logits, logit_pad, lab, label_pad,
                           blank_id=blank_id)

@@ -607,3 +607,20 @@ def test_ctc_loss():
                          use_data_lengths=True,
                          use_label_lengths=True).asnumpy()
     assert out2.shape == (N,) and np.isfinite(out2).all()
+
+
+def test_ctc_loss_blank_last_padding():
+    """Review fix: blank_label='last' uses -1 padding (reference
+    convention) — padded slots must not flow in as class ids."""
+    strong = np.full((6, 1, 4), -10.0, np.float32)
+    strong[:, 0, 1] = 10.0
+    lab = nd.array(np.asarray([[1, -1, -1]], np.float32))
+    l_pad = mx.nd.CTCLoss(nd.array(strong), lab,
+                          blank_label="last").asnumpy()[0]
+    l_len = mx.nd.CTCLoss(nd.array(strong),
+                          nd.array(np.asarray([[1, 0, 0]], np.float32)),
+                          None, nd.array(np.asarray([1], np.float32)),
+                          use_label_lengths=True,
+                          blank_label="last").asnumpy()[0]
+    np.testing.assert_allclose(l_pad, l_len, rtol=1e-4, atol=1e-5)
+    assert l_pad < 1.0
