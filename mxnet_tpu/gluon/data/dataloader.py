@@ -418,6 +418,21 @@ class DataLoader:
             # reference semantics: a fresh fork per iterator, so the
             # workers see the dataset's CURRENT state each epoch
             self._shutdown_pool()
+        elif (getattr(self, "_mp_pool", None) is not None
+              and not getattr(self, "_warned_persistent", False)):
+            # pool reuse across epochs: workers hold the dataset as
+            # snapshotted at the first fork, so epoch-dependent dataset
+            # mutation in the parent is silently invisible to them —
+            # a behavior change from the reference's fork-per-iterator.
+            import warnings
+            self._warned_persistent = True
+            warnings.warn(
+                "DataLoader(persistent_workers=True) reuses the worker "
+                "pool across epochs; the dataset was snapshotted at the "
+                "first fork, so per-epoch dataset mutation will not be "
+                "seen by workers. Pass persistent_workers=False for the "
+                "reference's fork-per-iterator semantics.",
+                stacklevel=3)
         workers, index_q, data_q = self._ensure_pool()
         self._drain_stale(data_q)
         batches = list(self._batch_sampler)

@@ -184,6 +184,21 @@ def _op_jit_key(op, params):
     return key
 
 
+def _holds_ndarray(v):
+    """True if v is (or transitively contains) an NDArray. NDArray hashes
+    by identity, so it would survive _freeze+hash and be baked into the
+    executable while a later _rebind() of the same object silently went
+    stale. jnp/np arrays are unhashable and already rejected by hash();
+    np.dtype/np.generic hash by value and are safe to bake."""
+    if isinstance(v, NDArray):
+        return True
+    if isinstance(v, (list, tuple)):
+        return any(_holds_ndarray(x) for x in v)
+    if isinstance(v, dict):
+        return any(_holds_ndarray(x) for x in v.values())
+    return False
+
+
 def _fn_jit_key(fn):
     """Cache key for a bare function/lambda dispatch (NDArray method
     lambdas): the code object identity + closure values. The code object
@@ -193,7 +208,8 @@ def _fn_jit_key(fn):
         return None
     if isinstance(fn, functools.partial):
         inner = _fn_jit_key(fn.func)
-        if inner is None:
+        if inner is None or _holds_ndarray(fn.args) \
+                or _holds_ndarray(fn.keywords):
             return None
         try:
             key = ("partial", inner, _freeze(tuple(sorted(fn.keywords.items()))),
@@ -209,6 +225,11 @@ def _fn_jit_key(fn):
     if fn.__closure__:
         try:
             cells = tuple(c.cell_contents for c in fn.__closure__)
+        except ValueError:
+            return None
+        if _holds_ndarray(cells):
+            return None
+        try:
             cells = _freeze(cells)
             hash(cells)
         except (TypeError, ValueError):

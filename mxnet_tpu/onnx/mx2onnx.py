@@ -59,8 +59,8 @@ def _vinfo(name: str, shape=None, elem=P.TensorProto.FLOAT):
         for d in shape:
             dim = v.type.tensor_type.shape.dim.add()
             dim.dim_value = int(d)
-    else:
-        v.type.tensor_type.shape.SetInParent()
+    # shape=None leaves the shape field unset (unknown rank); an empty
+    # TensorShapeProto would declare a rank-0 scalar per ONNX semantics.
     return v
 
 

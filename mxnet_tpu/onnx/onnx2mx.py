@@ -140,9 +140,13 @@ def import_model(onnx_file_path):
         elif op == "Gemm":
             if a.get("transB", 0) != 1 or a.get("transA", 0) != 0:
                 raise MXNetError("ONNX import: Gemm needs transB=1")
-            if a.get("alpha", 1.0) != 1.0 or a.get("beta", 1.0) != 1.0:
-                raise MXNetError(
-                    "ONNX import: Gemm alpha/beta != 1 unsupported")
+            if a.get("alpha", 1.0) != 1.0:
+                raise MXNetError("ONNX import: Gemm alpha != 1 unsupported")
+            # beta only scales the C operand; with two inputs (no bias,
+            # the exporter emits beta=0.0 for no_bias FullyConnected)
+            # any beta value is irrelevant.
+            if len(ins) > 2 and a.get("beta", 1.0) != 1.0:
+                raise MXNetError("ONNX import: Gemm beta != 1 unsupported")
             args = [I(0), I(1)] + ([I(2)] if len(ins) > 2 else [])
             num_hidden = int(inits[ins[1]].shape[0]) \
                 if ins[1] in inits else 0

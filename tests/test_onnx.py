@@ -220,3 +220,32 @@ def test_clip_one_sided_and_softmax_output_label_dropped(tmp_path):
                         onnx_file_path=path2)
     _, args2, _ = mxonnx.import_model(path2)
     assert "g2" not in args2
+
+
+def test_no_bias_gemm_reimport(tmp_path):
+    """Advisor round 4 (medium): the exporter emits Gemm beta=0.0 for
+    no_bias FullyConnected; with only two inputs beta scales nothing and
+    the importer must accept it. Full round trip, not export-only."""
+    w = sym.var("w")
+    fc = sym.FullyConnected(sym.var("data"), w, num_hidden=4, no_bias=True,
+                            flatten=True)
+    params = {"w": rng.rand(4, 6).astype(np.float32)}
+    feeds = {"data": rng.rand(2, 6).astype(np.float32)}
+    _roundtrip(fc, params, feeds, tmp_path)
+
+
+def test_output_shape_not_scalar(tmp_path):
+    """Advisor round 4 (low): shape=None must leave the shape field unset
+    (unknown rank), not emit an empty TensorShapeProto (a rank-0 scalar
+    declaration strict checkers reject)."""
+    from mxnet_tpu.onnx import onnx_subset_pb2 as P
+
+    fc = sym.FullyConnected(sym.var("data"), sym.var("w"), num_hidden=4,
+                            no_bias=True)
+    path = str(tmp_path / "o.onnx")
+    mxonnx.export_model(fc, {"w": rng.rand(4, 6).astype(np.float32)},
+                        input_shapes=[(2, 6)], onnx_file_path=path)
+    m = P.ModelProto()
+    m.ParseFromString(open(path, "rb").read())
+    for v in m.graph.output:
+        assert not v.type.tensor_type.HasField("shape")
