@@ -23,24 +23,46 @@ __all__ = ["save_checkpoint", "load_checkpoint", "latest_step",
            "CheckpointManager"]
 
 
-def save_checkpoint(directory, step, net=None, trainer=None, extra=None):
+def save_checkpoint(directory, step, net=None, trainer=None, extra=None,
+                    train_step=None):
     """Write a resumable training checkpoint.
 
     Layout: ``{directory}/step_{N}/`` with model params, optimizer states
-    and metadata. Multi-host: only process 0 writes (with replicated
-    data-parallel params every process holds the full state; sharded-array
-    gather via tensorstore is a later milestone). Safe to call from every
-    process.
+    and metadata. ``train_step`` (a ``parallel.TrainStep``) is saved via
+    the SHARDED layout (``checkpoint_sharded``): every process writes its
+    addressable shards under ``trainstep/`` — no gather, TP-sharded
+    arrays are never materialized whole. net/trainer state is written by
+    process 0 only (replicated by construction on those paths). Safe to
+    call from every process.
     """
     path = os.path.join(directory, f"step_{step}")
+    os.makedirs(path, exist_ok=True)
+    if train_step is not None:
+        # all processes participate; each writes only its own files
+        train_step.save_checkpoint(os.path.join(path, "trainstep"))
     if jax.process_index() != 0:
         return path
-    os.makedirs(path, exist_ok=True)
+    if train_step is not None:
+        # wait for every process's shard commit marker before declaring
+        # the STEP committed — process 0 must not outrun peers still
+        # writing (a preemption in that window would otherwise leave a
+        # COMMITTED-but-unloadable step that wedges every restart)
+        from . import checkpoint_sharded as _cs
+        import time as _time
+
+        deadline = _time.monotonic() + 600
+        sub = os.path.join(path, "trainstep")
+        while not _cs.is_committed(sub):
+            if _time.monotonic() > deadline:
+                raise MXNetError(
+                    f"timed out waiting for peer shard commits in {sub}")
+            _time.sleep(0.2)
     if net is not None:
         net.save_parameters(os.path.join(path, "model.params"))
     if trainer is not None:
         trainer.save_states(os.path.join(path, "trainer.states"))
-    meta = {"step": int(step), "format": "mxnet_tpu-ckpt-v1"}
+    meta = {"step": int(step), "format": "mxnet_tpu-ckpt-v1",
+            "has_trainstep": train_step is not None}
     if extra:
         with open(os.path.join(path, "extra.pkl"), "wb") as f:
             pickle.dump(extra, f)
@@ -53,13 +75,31 @@ def save_checkpoint(directory, step, net=None, trainer=None, extra=None):
     return path
 
 
+def _step_committed(path) -> bool:
+    """A step is loadable iff its own marker exists AND, when it carries
+    a sharded TrainStep payload, every process's shard commit landed."""
+    if not os.path.exists(os.path.join(path, "COMMITTED")):
+        return False
+    meta_path = os.path.join(path, "meta.json")
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except (OSError, ValueError):
+        return False
+    if meta.get("has_trainstep"):
+        from . import checkpoint_sharded as _cs
+
+        return _cs.is_committed(os.path.join(path, "trainstep"))
+    return True
+
+
 def latest_step(directory) -> Optional[int]:
     if not os.path.isdir(directory):
         return None
     steps = []
     for name in os.listdir(directory):
-        if name.startswith("step_") and os.path.exists(
-            os.path.join(directory, name, "COMMITTED")
+        if name.startswith("step_") and _step_committed(
+            os.path.join(directory, name)
         ):
             try:
                 steps.append(int(name[5:]))
@@ -68,17 +108,24 @@ def latest_step(directory) -> Optional[int]:
     return max(steps) if steps else None
 
 
-def load_checkpoint(directory, step=None, net=None, trainer=None):
+def load_checkpoint(directory, step=None, net=None, trainer=None,
+                    train_step=None):
     """Load the given (or latest committed) checkpoint; returns metadata."""
     if step is None:
         step = latest_step(directory)
         if step is None:
             raise MXNetError(f"no committed checkpoint under {directory}")
     path = os.path.join(directory, f"step_{step}")
-    if not os.path.exists(os.path.join(path, "COMMITTED")):
+    if not _step_committed(path):
         raise MXNetError(f"checkpoint {path} is not committed")
     with open(os.path.join(path, "meta.json")) as f:
         meta = json.load(f)
+    if train_step is not None:
+        if not meta.get("has_trainstep"):
+            raise MXNetError(
+                f"checkpoint {path} was saved without a TrainStep payload; "
+                "cannot restore train_step from it")
+        train_step.load_checkpoint(os.path.join(path, "trainstep"))
     if net is not None:
         net.load_parameters(os.path.join(path, "model.params"))
     if trainer is not None:
@@ -101,16 +148,19 @@ class CheckpointManager:
     def should_save(self, step) -> bool:
         return step % self.interval == 0
 
-    def save(self, step, net=None, trainer=None, extra=None):
-        path = save_checkpoint(self.directory, step, net, trainer, extra)
+    def save(self, step, net=None, trainer=None, extra=None,
+             train_step=None):
+        path = save_checkpoint(self.directory, step, net, trainer, extra,
+                               train_step=train_step)
         self._cleanup()
         return path
 
-    def restore_latest(self, net=None, trainer=None):
+    def restore_latest(self, net=None, trainer=None, train_step=None):
         step = latest_step(self.directory)
         if step is None:
             return None
-        return load_checkpoint(self.directory, step, net, trainer)
+        return load_checkpoint(self.directory, step, net, trainer,
+                               train_step=train_step)
 
     def _cleanup(self):
         if jax.process_index() != 0:
@@ -118,7 +168,7 @@ class CheckpointManager:
         steps = sorted(
             int(n[5:]) for n in os.listdir(self.directory)
             if n.startswith("step_")
-            and os.path.exists(os.path.join(self.directory, n, "COMMITTED"))
+            and _step_committed(os.path.join(self.directory, n))
         )
         for s in steps[: -self.keep]:
             import shutil

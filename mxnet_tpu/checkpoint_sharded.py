@@ -1,0 +1,211 @@
+"""Sharded (per-process) checkpoint layout for distributed arrays.
+
+SURVEY.md §5's "tensorstore-style sharded arrays" plan: every process
+writes exactly the shards it owns (no gather, no process ever holds a
+full copy of a TP-sharded array), plus a JSON index describing where
+each global slice lives; restore re-assembles arrays onto the CURRENT
+mesh via ``jax.make_array_from_callback`` — resharding onto a different
+mesh/process count is allowed, since the callback reads arbitrary
+global slices from the saved pieces.
+
+Reference analogue: Trainer.save_states / Module.save_checkpoint
+(``python/mxnet/gluon/trainer.py`` [unverified]) persisted replicated
+state from one process; the sharded layout here is the multi-host
+extension those APIs never had.
+
+Write protocol (commit-marker, crash-safe):
+  {dir}/shards_p{K}.npz      one file per process, its replica-0 shards
+  {dir}/index_p{K}.json      name -> [slice bounds, npz key] for that file
+  {dir}/ckpt_meta.json       global shapes/dtypes + process_count (proc 0)
+  {dir}/DONE.p{K}            per-process commit marker, written LAST
+A checkpoint is committed iff DONE.p{k} exists for every k in
+range(process_count). Assumes the directory is on a filesystem all
+processes can read at restore time (the standard checkpoint contract).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Optional, Union
+
+import jax
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["save_sharded", "load_sharded", "is_committed"]
+
+
+def _norm_bounds(index, shape):
+    """Normalize a per-device index (tuple of slices) to [[start, stop]]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start, stop, step = sl.indices(dim)
+        if step != 1:
+            raise MXNetError("strided shards are not supported")
+        out.append([int(start), int(stop)])
+    return out
+
+
+def save_sharded(directory: str, arrays: Dict[str, jax.Array],
+                 extra: Optional[dict] = None) -> str:
+    """Write ``arrays`` (possibly sharded jax arrays) under ``directory``.
+
+    Every distinct global slice is written exactly once globally: a shard
+    is saved iff its ``replica_id == 0`` (for replicated arrays that is
+    one device somewhere; for sharded arrays, one holder per slice).
+    Safe to call from every process; each writes only its own files.
+    """
+    os.makedirs(directory, exist_ok=True)
+    proc = jax.process_index()
+    nproc = jax.process_count()
+    pieces = {}  # npz key -> numpy data
+    index = []  # [{name, key, bounds}]
+    for name, a in arrays.items():
+        a = jax.numpy.asarray(a)
+        for shard in a.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            key = str(len(pieces))
+            pieces[key] = _np.asarray(shard.data)
+            index.append({
+                "name": name,
+                "key": key,
+                "bounds": _norm_bounds(shard.index, a.shape),
+            })
+    _np.savez(os.path.join(directory, f"shards_p{proc}.npz"), **pieces)
+    with open(os.path.join(directory, f"index_p{proc}.json"), "w") as f:
+        json.dump(index, f)
+    if proc == 0:
+        meta = {
+            "format": "mxnet_tpu-sharded-v1",
+            "process_count": nproc,
+            "arrays": {
+                name: {"shape": list(a.shape), "dtype": str(a.dtype)}
+                for name, a in
+                ((n, jax.numpy.asarray(v)) for n, v in arrays.items())
+            },
+        }
+        if extra is not None:
+            meta["extra"] = extra
+        with open(os.path.join(directory, "ckpt_meta.json"), "w") as f:
+            json.dump(meta, f)
+    # commit marker LAST: a partially-written process never commits
+    with open(os.path.join(directory, f"DONE.p{proc}"), "w") as f:
+        f.write("ok")
+    return directory
+
+
+def is_committed(directory: str) -> bool:
+    meta_path = os.path.join(directory, "ckpt_meta.json")
+    if not os.path.exists(meta_path):
+        return False
+    with open(meta_path) as f:
+        nproc = json.load(f).get("process_count", 1)
+    return all(
+        os.path.exists(os.path.join(directory, f"DONE.p{k}"))
+        for k in range(nproc)
+    )
+
+
+class _PieceReader:
+    """Lazy per-file npz access: zip members are read on first use, so a
+    restoring process touches only the pieces overlapping its shards."""
+
+    def __init__(self, directory):
+        self._dir = directory
+        self._files = {}
+
+    def get(self, fname, key):
+        f = self._files.get(fname)
+        if f is None:
+            f = self._files[fname] = _np.load(
+                os.path.join(self._dir, fname))
+        return f[key]
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+
+
+def load_sharded(
+    directory: str,
+    shardings: Union[None, Dict[str, jax.sharding.Sharding],
+                     Callable[[str], Optional[jax.sharding.Sharding]]] = None,
+) -> Dict[str, jax.Array]:
+    """Re-assemble the saved arrays onto the CURRENT devices.
+
+    ``shardings`` maps array name -> target ``jax.sharding.Sharding``
+    (dict or callable; None / missing name = default single-device /
+    fully-replicated placement). The target may differ from the layout
+    at save time — each addressable shard's global slice is assembled
+    from whichever saved pieces overlap it.
+    """
+    if not is_committed(directory):
+        raise MXNetError(
+            f"sharded checkpoint {directory} is not committed "
+            "(missing DONE markers or ckpt_meta.json)")
+    with open(os.path.join(directory, "ckpt_meta.json")) as f:
+        meta = json.load(f)
+    pieces: Dict[str, list] = {}
+    for k in range(meta["process_count"]):
+        with open(os.path.join(directory, f"index_p{k}.json")) as f:
+            for ent in json.load(f):
+                pieces.setdefault(ent["name"], []).append(
+                    (ent["bounds"], f"shards_p{k}.npz", ent["key"]))
+    reader = _PieceReader(directory)
+    get_sharding = shardings if callable(shardings) else (
+        (shardings or {}).get)
+    out = {}
+    try:
+        for name, spec in meta["arrays"].items():
+            shape = tuple(spec["shape"])
+            dtype = _np.dtype(spec["dtype"])
+            sharding = get_sharding(name)
+            if sharding is None:
+                sharding = jax.sharding.SingleDeviceSharding(
+                    jax.local_devices()[0])
+            saved = pieces.get(name, [])
+
+            def cb(index, _shape=shape, _dtype=dtype, _saved=saved,
+                   _name=name):
+                lo = [sl.indices(d)[0] for sl, d in zip(index, _shape)]
+                hi = [sl.indices(d)[1] for sl, d in zip(index, _shape)]
+                region = _np.empty(
+                    [h - l for l, h in zip(lo, hi)], _dtype)
+                covered = 0
+                for bounds, fname, key in _saved:
+                    olo = [max(l, b[0]) for l, b in zip(lo, bounds)]
+                    ohi = [min(h, b[1]) for h, b in zip(hi, bounds)]
+                    if any(a >= b for a, b in zip(olo, ohi)):
+                        continue
+                    data = reader.get(fname, key)
+                    src = tuple(
+                        slice(a - b[0], c - b[0])
+                        for a, c, b in zip(olo, ohi, bounds))
+                    dst = tuple(
+                        slice(a - l, c - l)
+                        for a, c, l in zip(olo, ohi, lo))
+                    region[dst] = data[src]
+                    vol = 1
+                    for a, c in zip(olo, ohi):
+                        vol *= c - a
+                    covered += vol
+                want = 1
+                for l, h in zip(lo, hi):
+                    want *= h - l
+                if covered != want:
+                    # replica-0 pieces are disjoint, so coverage volume
+                    # equals region volume iff every element was filled
+                    raise MXNetError(
+                        f"checkpoint piece coverage hole for {_name}: "
+                        f"{covered}/{want} elements")
+                return region
+
+            out[name] = jax.make_array_from_callback(shape, sharding, cb)
+            # materialize before the reader is closed
+            jax.block_until_ready(out[name])
+    finally:
+        reader.close()
+    return out

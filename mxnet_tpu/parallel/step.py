@@ -514,3 +514,248 @@ class TrainStep:
     @property
     def loss_scale(self):
         return 1.0
+
+    # ------------------------------------------------------------ state dict
+    def _struct_names(self):
+        """global param name -> structural name ("0.weight"): stable
+        across processes, unlike the auto-incrementing global prefix
+        (hybridsequential0_...), mirroring ``Block.save_parameters``."""
+        cached = getattr(self, "_struct_cache", None)
+        if cached is not None:
+            return cached
+        byid = {id(p): n for n, p in self._params}
+        out = {}
+        for sname, p in self._net._collect_params_with_prefix().items():
+            g = byid.get(id(p))
+            if g is not None and g not in out:
+                out[g] = sname
+        for n, p in self._params:  # safety: anything structurally hidden
+            out.setdefault(n, n)
+        self._struct_cache = out
+        return out
+
+    def state_dict(self) -> dict:
+        """Full resumable state: parameter values, optimizer moments, the
+        device-carried PRNG key and step counter — keyed by STRUCTURAL
+        parameter names so a fresh process (different global prefixes)
+        restores cleanly. The reference's equivalent contract is
+        Trainer.save_states + net params (``python/mxnet/gluon/trainer.py``
+        [unverified]); here ONE dict covers the whole fused step so a
+        killed run loses nothing."""
+        s = self._struct_names()
+        # snapshot with fresh buffers (sharding preserved): the live ones
+        # are donated to XLA by the next __call__, which would leave the
+        # returned dict holding deleted arrays
+        cp = jnp.copy
+        sd = {
+            "values": {s[n]: cp(v) for n, v in self._values.items()},
+            "opt_state": {s[n]: tuple(cp(x) for x in st)
+                          for n, st in self._opt_state.items()},
+            "t_host": self._t,
+        }
+        if getattr(self, "_key_dev", None) is not None:
+            sd["key"] = cp(self._key_dev)
+            sd["t_dev"] = cp(self._t_dev)
+        return sd
+
+    def load_state_dict(self, sd: dict):
+        """Restore ``state_dict()`` output, re-placing every array onto
+        THIS step's mesh/shardings (resharding from a different layout is
+        fine — device_put moves arbitrary source placements)."""
+        def _place(name, v):
+            if self._param_sharding is not None:
+                return jax.device_put(v, self._param_sharding(name))
+            return jnp.asarray(v)
+
+        s = self._struct_names()
+        gname = {v: k for k, v in s.items()}
+        vals = sd["values"]
+        missing = [n for n, _ in self._params if s[n] not in vals]
+        if missing:
+            raise MXNetError(
+                f"state_dict missing parameters: {missing[:5]}")
+        self._values = {gname[sn]: _place(gname[sn], v)
+                        for sn, v in vals.items() if sn in gname}
+        self._opt_state = {
+            gname[sn]: tuple(_place(gname[sn], x) for x in st)
+            for sn, st in sd["opt_state"].items() if sn in gname
+        }
+        self._t = int(sd["t_host"])
+        if "key" in sd:
+            repl = (NamedSharding(self._mesh, PartitionSpec())
+                    if self._mesh is not None else None)
+
+            def _repl(v):
+                v = jnp.asarray(v)
+                return jax.device_put(v, repl) if repl is not None else v
+
+            self._key_dev = _repl(sd["key"])
+            self._t_dev = _repl(sd["t_dev"])
+        else:
+            self._key_dev = None
+            self._t_dev = None
+        # derived scalar memos are stale now
+        self._lr_host = None
+        self._rescale_host = None
+
+    # ------------------------------------------------------- sharded on-disk
+    def _flat_state(self):
+        s = self._struct_names()
+        flat = {"meta/t_dev": getattr(self, "_t_dev", None),
+                "meta/key": getattr(self, "_key_dev", None)}
+        flat = {k: v for k, v in flat.items() if v is not None}
+        for n, v in self._values.items():
+            flat[f"values/{s[n]}"] = v
+        for n, st in self._opt_state.items():
+            for i, x in enumerate(st):
+                flat[f"opt/{i}/{s[n]}"] = x
+        return flat
+
+    def save_checkpoint(self, directory, step=None):
+        """Write a sharded, committed checkpoint of the full step state.
+
+        Every process writes only its addressable shards (no gather — a
+        TP-sharded weight is never materialized whole anywhere); call
+        from ALL processes. Layout/protocol: ``checkpoint_sharded``."""
+        from .. import checkpoint_sharded as cs
+
+        sub = directory if step is None else \
+            f"{directory}/step_{int(step)}"
+        s = self._struct_names()
+        return cs.save_sharded(
+            sub, self._flat_state(),
+            extra={"t_host": self._t,
+                   "train_names": [s[n] for n in self._train_names]})
+
+    def load_checkpoint(self, directory, step=None):
+        """Restore ``save_checkpoint`` output onto THIS step's mesh.
+
+        The saved mesh/process layout may differ: each process assembles
+        exactly the shards the current placement makes addressable."""
+        from .. import checkpoint_sharded as cs
+        import json as _json
+        import os as _os
+
+        sub = directory if step is None else \
+            f"{directory}/step_{int(step)}"
+        with open(_os.path.join(sub, "ckpt_meta.json")) as f:
+            meta = _json.load(f)
+
+        gname = {v: k for k, v in self._struct_names().items()}
+
+        def sharding_for(flat_name):
+            if flat_name.startswith(("values/", "opt/")):
+                pname = flat_name.split("/", 1)[1]
+                if flat_name.startswith("opt/"):
+                    pname = pname.split("/", 1)[1]
+                if self._param_sharding is not None:
+                    return self._param_sharding(gname.get(pname, pname))
+                return None
+            if self._mesh is not None:
+                return NamedSharding(self._mesh, PartitionSpec())
+            return None
+
+        flat = cs.load_sharded(sub, sharding_for)
+        sd = {"values": {}, "opt_state": {},
+              "t_host": meta["extra"]["t_host"]}
+        nstates = {}
+        for k, v in flat.items():
+            if k.startswith("values/"):
+                sd["values"][k[7:]] = v
+            elif k.startswith("opt/"):
+                i, pname = k[4:].split("/", 1)
+                nstates.setdefault(pname, {})[int(i)] = v
+            elif k == "meta/key":
+                sd["key"] = v
+            elif k == "meta/t_dev":
+                sd["t_dev"] = v
+        sd["opt_state"] = {
+            n: tuple(st[i] for i in sorted(st))
+            for n, st in nstates.items()
+        }
+        for n in meta["extra"]["train_names"]:
+            sd["opt_state"].setdefault(n, ())
+        if "key" not in sd and "t_dev" in sd:
+            del sd["t_dev"]
+        self.load_state_dict(sd)
+        return meta.get("extra", {})
+
+    # --------------------------------------------------------- Trainer interop
+    def export_trainer_states(self, trainer):
+        """Hand this step's optimizer moments to a Gluon ``Trainer`` over
+        the SAME parameters, so training can continue on the eager
+        per-param path (reference Trainer.save_states contract). Call
+        ``sync_params()`` separately for the weights."""
+        name_of = {id(p): n for n, p in self._params}
+        if not trainer._kv_initialized:
+            trainer._init_kvstore()
+        updater = trainer._updaters[0]
+        opt = updater.optimizer
+        for i, p in enumerate(trainer._params):
+            n = name_of.get(id(p))
+            if n is None or n not in self._opt_state:
+                continue
+            if getattr(opt, "multi_precision", False) and \
+                    self._values[n].dtype == jnp.float16:
+                # Trainer's multi-precision state is (inner_state,
+                # fp32_master) — a flat moment tuple here would be
+                # unpacked as (state, master) and DESTROY the weight.
+                # TrainStep's AMP scheme (compute_dtype) keeps f32
+                # masters itself, so this handoff has no meaning.
+                raise MXNetError(
+                    "export_trainer_states: multi_precision Trainer over "
+                    "fp16 params is not interoperable with TrainStep "
+                    "state; use a non-multi_precision optimizer or "
+                    "TrainStep(compute_dtype=...) AMP")
+            st = tuple(NDArray(s.astype(self._values[n].dtype))
+                       for s in self._opt_state[n])
+            if len(st) == 0:
+                updater.states[i] = None
+            elif len(st) == 1:
+                updater.states[i] = st[0]
+            else:
+                updater.states[i] = st
+            updater.states_synced[i] = True
+            opt._index_update_count[i] = self._t
+        opt.num_update = max(opt.num_update, self._t)
+
+    def import_trainer_states(self, trainer):
+        """Adopt moments from a ``Trainer`` that trained the SAME
+        parameters (the reverse direction: eager warmup, then switch to
+        the fused sharded step)."""
+        name_of = {id(p): n for n, p in self._params}
+        updater = trainer._updaters[0]
+        for i, p in enumerate(trainer._params):
+            n = name_of.get(id(p))
+            if n is None or n not in self._opt_state:
+                continue
+            st = updater.states.get(i)
+            if st is None:
+                continue
+            st = st if isinstance(st, tuple) else (st,)
+            if any(isinstance(x, (tuple, list)) for x in st):
+                # (inner_state, fp32_master) — multi_precision layout
+                raise MXNetError(
+                    "import_trainer_states: multi_precision Trainer "
+                    "states ((state, master) pairs) are not supported; "
+                    "TrainStep keeps its own f32 masters via "
+                    "compute_dtype AMP")
+            want = len(self._opt_state[n])
+            if len(st) != want:
+                raise MXNetError(
+                    f"optimizer state arity mismatch for {n}: trainer has "
+                    f"{len(st)}, step expects {want} (same optimizer?)")
+            placed = []
+            for s_new, s_old in zip(st, self._opt_state[n]):
+                v = s_new.data if isinstance(s_new, NDArray) else \
+                    jnp.asarray(s_new)
+                v = v.astype(s_old.dtype)
+                if self._param_sharding is not None:
+                    v = jax.device_put(v, self._param_sharding(n))
+                placed.append(v)
+            self._opt_state[n] = tuple(placed)
+        t = int(trainer._optimizer.num_update)
+        if t:
+            self._t = t
+            if getattr(self, "_t_dev", None) is not None:
+                self._t_dev = jnp.asarray(self._t_dev * 0 + t)
