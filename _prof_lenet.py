@@ -1,0 +1,59 @@
+"""Attribute the LeNet eager-step host cost: cProfile + wall split."""
+import cProfile, pstats, io, time
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+net = gluon.nn.HybridSequential()
+with net.name_scope():
+    net.add(
+        gluon.nn.Conv2D(20, kernel_size=5, activation="tanh"),
+        gluon.nn.MaxPool2D(pool_size=2, strides=2),
+        gluon.nn.Conv2D(50, kernel_size=5, activation="tanh"),
+        gluon.nn.MaxPool2D(pool_size=2, strides=2),
+        gluon.nn.Flatten(),
+        gluon.nn.Dense(500, activation="tanh"),
+        gluon.nn.Dense(10),
+    )
+net.initialize(mx.initializer.Xavier())
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+trainer = gluon.Trainer(net.collect_params(), "sgd",
+                        {"learning_rate": 0.02, "momentum": 0.9})
+rng = np.random.RandomState(0)
+x = nd.array(rng.rand(128, 1, 28, 28).astype(np.float32))
+y = nd.array(rng.randint(0, 10, 128).astype(np.float32))
+
+def step():
+    with autograd.record():
+        loss = loss_fn(net(x), y)
+    loss.backward()
+    trainer.step(128)
+    return loss
+
+for _ in range(5):
+    float(step().mean().asscalar())  # warmup + compile
+
+N = 30
+t0 = time.perf_counter()
+for _ in range(N):
+    step()
+# do NOT sync inside the window; sync once at the end
+t1 = time.perf_counter()
+float(step().mean().asscalar())
+print(f"async wall/step: {(t1-t0)/N*1e3:.2f} ms")
+
+t0 = time.perf_counter()
+for _ in range(N):
+    float(step().mean().asscalar())
+t1 = time.perf_counter()
+print(f"synced wall/step: {(t1-t0)/N*1e3:.2f} ms  ({128*N/(t1-t0):.0f} img/s)")
+
+pr = cProfile.Profile()
+pr.enable()
+for _ in range(N):
+    step()
+pr.disable()
+s = io.StringIO()
+ps = pstats.Stats(pr, stream=s).sort_stats("cumulative")
+ps.print_stats(35)
+print(s.getvalue())

@@ -110,14 +110,24 @@ def predict_mode():
 class _Node:
     """One recorded invocation (reference: autograd tape node / AGInfo)."""
 
-    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_out", "freed")
+    __slots__ = ("vjp_fn", "inputs", "out_avals", "multi_out", "freed",
+                 "bulk_key", "bwd_fn", "xs")
 
-    def __init__(self, vjp_fn, inputs, out_avals, multi_out):
+    def __init__(self, vjp_fn, inputs, out_avals, multi_out,
+                 bulk_key=None, bwd_fn=None, xs=None):
         self.vjp_fn = vjp_fn
         self.inputs = inputs  # list of (NDArray | None) — None for untracked
         self.out_avals = out_avals  # [(shape, dtype)] per output
         self.multi_out = multi_out
         self.freed = False
+        # bulked-backward support: a structural identity for the op's
+        # computation (the per-op jit cache key), the pure (xs, ct) ->
+        # input-cotangents callable, and the captured primal operands.
+        # None bulk_key = node not bulkable (custom Function, staged
+        # CachedOp, un-jittable op) — backward falls back to per-op replay.
+        self.bulk_key = bulk_key
+        self.bwd_fn = bwd_fn
+        self.xs = xs
 
 
 class _AGInfo:
@@ -164,7 +174,7 @@ def _has_float0(ct):
     return any(getattr(c, "dtype", None) == jax.dtypes.float0 for c in cts)
 
 
-def _record_cached(fwd, bwd, fn, args, datas):
+def _record_cached(fwd, bwd, fn, args, datas, bulk_key=None):
     """Tape node over CACHED jitted callables (imperative._fwd_jit /
     _bwd_jit): the forward is one pjit fast-path call, and the backward
     recomputes the forward inside one cached pjit instead of holding a
@@ -186,12 +196,186 @@ def _record_cached(fwd, bwd, fn, args, datas):
             return jax.vjp(fn, *xs)[1](ct)
         return bwd(xs, ct)
 
-    node = _Node(vjp, inputs, avals, multi)
+    node = _Node(vjp, inputs, avals, multi,
+                 bulk_key=bulk_key, bwd_fn=bwd, xs=xs)
     return outs, node
+
+
+def _record_deferred(bwd, fn, args, out_avals, multi, bulk_key):
+    """Tape node for a BULK-QUEUED op: primal operands are not concrete
+    yet; the queue's flush writes them into ``node.xs`` before any
+    backward can run (backward reads head values, which flushes)."""
+    inputs = [a if _is_tracked(a) else None for a in args]
+    node = _Node(None, inputs, out_avals, multi,
+                 bulk_key=bulk_key, bwd_fn=bwd, xs=None)
+
+    def vjp(ct):
+        xs = node.xs
+        if xs is None:
+            from .imperative import flush_bulk
+
+            flush_bulk()
+            xs = node.xs
+        if _has_float0(ct):
+            return jax.vjp(fn, *xs)[1](ct)
+        return bwd(xs, ct)
+
+    node.vjp_fn = vjp
+    return node
 
 
 def _mark_output(nd: NDArray, node: _Node, index: int):
     nd._ag = _AGInfo(node, index)
+
+
+# ------------------------------------------------------- bulked backward
+# The reference answered per-op engine-push cost with bulked segments
+# (``MXNET_GLUON_EXEC_BULK_SIZE``, ``src/imperative/cached_op.cc``
+# [unverified]); our per-op cost is the per-EXECUTABLE round trip, so the
+# analogue is: compile the whole tape traversal into ONE jitted program
+# (each node's cached bwd inlines into it) keyed by the tape's structure.
+# A stable training loop hits the same fingerprint every step: backward
+# collapses from ~#ops launches to one. MXTPU_BULK_BWD=0 disables.
+_BULK_BWD_CACHE: dict = {}
+_BULK_BWD_CAP = 256
+
+
+def _bulk_enabled() -> bool:
+    from .base import env_bool
+
+    return env_bool("MXTPU_BULK_BWD", True)
+
+
+def _try_bulk_backward(head_targets, order, retain_graph):
+    """One-launch backward. head_targets: [(node, out_idx, ct_or_None,
+    head_aval)] — ct None means the default ones cotangent (built inside
+    the trace, saving its launch too). Returns {id(leaf): total} or None
+    when the tape is not bulkable."""
+    if not _bulk_enabled() or is_recording() or len(order) < 2:
+        return None
+    pos_of = {id(n): i for i, n in enumerate(order)}
+    leaf_slots: dict = {}
+    leaf_arrs: List[NDArray] = []
+    desc = []
+    bwds = []
+    xs_all = []
+    for n in order:
+        if n.bulk_key is None or n.bwd_fn is None or n.xs is None \
+                or n.freed:
+            return None
+        for (_, dtype) in n.out_avals:
+            if not (jnp.issubdtype(dtype, jnp.floating)
+                    or jnp.issubdtype(dtype, jnp.complexfloating)):
+                return None  # float0 cotangents: per-op fallback
+        wiring = []
+        for arr in n.inputs:
+            if arr is None or arr._ag is None:
+                wiring.append(None)
+            elif arr._ag.node is None:
+                lid = id(arr)
+                if lid not in leaf_slots:
+                    leaf_slots[lid] = len(leaf_arrs)
+                    leaf_arrs.append(arr)
+                wiring.append(("leaf", leaf_slots[lid]))
+            else:
+                p = pos_of.get(id(arr._ag.node))
+                if p is None:
+                    return None
+                wiring.append(("node", p, arr._ag.index))
+        xs_avals = tuple(
+            (x.shape, str(x.dtype)) if hasattr(x, "shape")
+            else ("py", type(x).__name__) for x in n.xs)
+        desc.append((n.bulk_key, tuple(
+            (s, str(jnp.dtype(d))) for s, d in n.out_avals),
+            tuple(wiring), xs_avals, n.multi_out))
+        bwds.append(n.bwd_fn)
+        xs_all.append(n.xs)
+
+    heads_desc = []
+    head_ops = []
+    for node, oi, ct, aval in head_targets:
+        p = pos_of.get(id(node))
+        if p is None:
+            return None
+        heads_desc.append((p, oi, ct is not None, aval))
+        if ct is not None:
+            head_ops.append(ct)
+
+    fp = (tuple(desc), tuple(heads_desc),
+          tuple((a.data.shape, str(a.data.dtype)) for a in leaf_arrs))
+    entry = _BULK_BWD_CACHE.get(fp)
+    if entry is None:
+        # static reachability: which nodes fire and which leaves receive
+        # cotangents is a pure function of the structure — decide once
+        have = set()
+        for p, oi, _, _ in heads_desc:
+            have.add((p, oi))
+        fires = []
+        leaf_hit = set()
+        for pos, (_, out_avals, wiring, _, _) in enumerate(desc):
+            fire = any((pos, i) in have for i in range(len(out_avals)))
+            fires.append(fire)
+            if not fire:
+                continue
+            for w in wiring:
+                if w is None:
+                    continue
+                if w[0] == "leaf":
+                    leaf_hit.add(w[1])
+                else:
+                    have.add((w[1], w[2]))
+        hit_list = sorted(leaf_hit)
+
+        def traversal(xs_all, head_ops):
+            cot: dict = {}
+            gi = 0
+            for (p, oi, has, (hshape, hdtype)) in heads_desc:
+                if has:
+                    ct = head_ops[gi]
+                    gi += 1
+                else:
+                    ct = jnp.ones(hshape, hdtype)
+                prev = cot.get((p, oi))
+                cot[(p, oi)] = ct if prev is None else prev + ct
+            totals = {}
+            for pos, (_, out_avals, wiring, _, multi) in enumerate(desc):
+                if not fires[pos]:
+                    continue
+                outs = []
+                for i, (shape, dtype) in enumerate(out_avals):
+                    c = cot.pop((pos, i), None)
+                    outs.append(jnp.zeros(shape, dtype) if c is None else c)
+                ct_arg = tuple(outs) if multi else outs[0]
+                in_cts = bwds[pos](xs_all[pos], ct_arg)
+                for w, ict in zip(wiring, in_cts):
+                    if w is None or ict is None:
+                        continue
+                    if w[0] == "leaf":
+                        prev = totals.get(w[1])
+                        totals[w[1]] = ict if prev is None else prev + ict
+                    else:
+                        key = (w[1], w[2])
+                        prev = cot.get(key)
+                        cot[key] = ict if prev is None else prev + ict
+            return tuple(totals[s] for s in hit_list)
+
+        if len(_BULK_BWD_CACHE) >= _BULK_BWD_CAP:
+            _BULK_BWD_CACHE.pop(next(iter(_BULK_BWD_CACHE)))
+        entry = _BULK_BWD_CACHE[fp] = (jax.jit(traversal), hit_list)
+
+    fn, hit_list = entry
+    try:
+        results = fn(tuple(xs_all), tuple(head_ops))
+    except Exception:  # structural edge the trace rejects: fall back
+        _BULK_BWD_CACHE.pop(fp, None)
+        return None
+    if not retain_graph:
+        for n in order:
+            n.vjp_fn = None
+            n.bwd_fn = None
+            n.xs = None
+            n.freed = True
+    return [(leaf_arrs[s], r) for s, r in zip(hit_list, results)]
 
 
 # ----------------------------------------------------------------- backward
@@ -206,6 +390,9 @@ def backward(
     train_mode: bool = True,
 ):
     """Reverse pass from ``heads`` (reference: ``Imperative::Backward``)."""
+    from .imperative import flush_bulk
+
+    flush_bulk()  # resolve any queued forward ops (fills node.xs)
     _BACKWARD_EPOCH[0] += 1
     heads = list(heads)
     if head_grads is None:
@@ -220,23 +407,48 @@ def backward(
     leaves = {}
 
     roots = []
+    head_targets = []  # (node, out_idx, ct_or_None, head_aval) for bulk
+    bulk_ok = True
     for h, hg in zip(heads, head_grads):
         if h._ag is None:
             raise MXNetError(
                 "cannot differentiate: output is not connected to any "
                 "variable created under autograd.record() with attach_grad()"
             )
-        g = hg.data if isinstance(hg, NDArray) else (hg if hg is not None else jnp.ones_like(h.data))
         node = h._ag.node
         if node is None:  # head IS a leaf variable
+            g = hg.data if isinstance(hg, NDArray) else (
+                hg if hg is not None else jnp.ones_like(h.data))
             leaf_cts.setdefault(id(h), []).append(g)
             leaves[id(h)] = h
+            bulk_ok = False
             continue
-        key = (id(node), h._ag.index)
-        cotangents.setdefault(key, []).append(g)
+        ct = hg.data if isinstance(hg, NDArray) else hg
+        head_targets.append(
+            (node, h._ag.index, ct, (h.data.shape, h.data.dtype)))
         roots.append(node)
 
     order = _toposort(roots)
+
+    if bulk_ok:
+        bulk = _try_bulk_backward(head_targets, order, retain_graph)
+        if bulk is not None:
+            for leaf, total in bulk:
+                req = leaf._grad_req
+                if req == "null":
+                    continue
+                if leaf._grad is None:
+                    leaf._grad = NDArray(jnp.zeros_like(leaf.data))
+                total = total.astype(leaf.data.dtype)
+                if req == "write":
+                    leaf._grad._rebind(total)
+                elif req == "add":
+                    leaf._grad._rebind(leaf._grad.data + total)
+            return
+
+    for node, oi, ct, (hshape, hdtype) in head_targets:
+        g = ct if ct is not None else jnp.ones(hshape, hdtype)
+        cotangents.setdefault((id(node), oi), []).append(g)
 
     node_by_id = {id(n): n for n in order}
     for node in order:  # already reverse topological
@@ -270,6 +482,8 @@ def backward(
         in_cts = node.vjp_fn(ct_arg)
         if not retain_graph:
             node.vjp_fn = None
+            node.bwd_fn = None
+            node.xs = None  # or the primal operand buffers stay alive
             node.freed = True
         for arr, ict in zip(node.inputs, in_cts):
             if arr is None or ict is None:

@@ -21,9 +21,12 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Optional, Sequence
 
+import numpy as _np
+from jax.core import Tracer as _Tracer
+
 from .base import MXNetError
 from .engine import engine
-from .ndarray.ndarray import NDArray
+from .ndarray.ndarray import NDArray, _Pending
 from .ops.registry import Operator, get as get_op
 
 __all__ = ["invoke", "invoke_fn"]
@@ -69,13 +72,28 @@ def invoke_fn(fn: Callable, *args, _jit_key=_DERIVE, **static_params):
         _jit_key = _fn_jit_key(fn)
     if _jit_key is not None and _EAGER_FWD_CACHE.get(_jit_key) is _FAILED:
         _jit_key = None
+    if _jit_key is not None and _bulk_fwd_enabled():
+        lazy = [_lazy_data(a) for a in args]
+        if any(isinstance(d, _Tracer) for d in lazy):
+            # inside an outer jax trace (TrainStep/hybridize staging):
+            # deferring would leak tracers out of the transform — run now
+            q = None
+        else:
+            q = _try_enqueue(_jit_key, fn, args, lazy,
+                             autograd._should_record(args))
+        if q is not None:
+            outs, multi, node = q
+            if node is not None:
+                for i, o in enumerate(outs):
+                    autograd._mark_output(o, node, i)
+            return tuple(outs) if multi else outs[0]
     datas = [a.data if isinstance(a, NDArray) else a for a in args]
     if autograd._should_record(args):
         if _jit_key is not None:
             try:
                 outs, node = autograd._record_cached(
                     _fwd_jit(_jit_key, fn), _bwd_jit(_jit_key, fn),
-                    fn, args, datas)
+                    fn, args, datas, bulk_key=_jit_key)
                 return _wrap_outputs(outs, rec_nodes=node)
             except Exception:
                 outs, node = autograd._record(fn, args, datas)
@@ -165,6 +183,267 @@ def _jit_enabled() -> bool:
         and engine().is_async()
 
 
+# ----------------------------------------------- forward bulking (queue)
+# The reference bulked contiguous eager op pushes into engine segments
+# (``MXNET_GLUON_EXEC_BULK_SIZE``, ``src/imperative/imperative_utils.h``
+# [unverified]); the TPU analogue: queue eligible op calls as _Pending
+# NDArrays (shape/dtype known from a cached abstract eval) and flush the
+# run as ONE jitted segment — one executable launch instead of one per
+# op, which is the whole cost on a dispatch-latency-bound backend. Any
+# value read (.data/.asnumpy/non-bulkable op) flushes, so laziness is
+# invisible: the worst case is a segment of length 1.
+
+
+def _bulk_size() -> int:
+    from .base import env_int
+
+    return env_int("MXNET_GLUON_EXEC_BULK_SIZE", 15)
+
+
+_AVAL_CACHE: dict = {}  # (op key, input aval key) -> (out structs, multi)
+_SEG_CACHE: dict = {}   # segment structural key -> jitted runner
+_SEG_CAP = 512
+
+
+class _BulkEntry:
+    __slots__ = ("key", "fn", "datas", "chunks", "pendings", "node")
+
+    def __init__(self, key, fn, datas, chunks, pendings, node):
+        self.key = key
+        self.fn = fn
+        self.datas = datas      # captured operands (values / _Pending)
+        self.chunks = chunks    # output _Chunk cells to write back
+        self.pendings = pendings
+        self.node = node        # deferred tape node (or None)
+
+
+def _resolve(d):
+    if type(d) is _Pending:
+        return d.value
+    return d
+
+
+def _lazy_data(a):
+    """Operand capture WITHOUT forcing the queue: a live _Pending stays a
+    slot reference; everything else is its concrete value."""
+    if isinstance(a, NDArray):
+        if a._view is None:
+            d = a._chunk.data
+            if type(d) is _Pending and d.value is not None:
+                return d.value
+            return d
+        return a.data  # views force (rare on the hot path)
+    return a
+
+
+class _BulkQueue:
+    def __init__(self):
+        self.entries = []
+        # queues are thread-local, but the NDArrays holding their
+        # _Pending outputs are shareable: a foreign thread's flush must
+        # wait out an in-flight flush, not observe its half-done state
+        import threading
+
+        self._lock = threading.RLock()
+
+    def enqueue(self, key, fn, datas, out_structs, multi, node):
+        pendings = [
+            _Pending(self, s.shape, s.dtype,
+                     getattr(s, "weak_type", False))
+            for s in out_structs
+        ]
+        outs = [NDArray(p) for p in pendings]
+        chunks = [o._chunk for o in outs]
+        self.entries.append(
+            _BulkEntry(key, fn, tuple(datas), chunks, pendings, node))
+        if len(self.entries) >= _bulk_size():
+            self.flush()
+        return outs, multi
+
+    def flush(self):
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        entries, self.entries = self.entries, []
+        if not entries:
+            return
+        slot_of = {}
+        for pos, e in enumerate(entries):
+            for oi, p in enumerate(e.pendings):
+                slot_of[id(p)] = (pos, oi)
+        ext = []
+        parts = []
+        wirings = []
+        for e in entries:
+            wiring = []
+            for d in e.datas:
+                if type(d) is _Pending and d.value is None:
+                    tgt = slot_of.get(id(d))
+                    if tgt is None:  # foreign queue leak: force it now
+                        d.queue.flush()
+                        wiring.append(("ext", len(ext),
+                                       (d.value.shape, str(d.value.dtype))))
+                        ext.append(d.value)
+                    else:
+                        wiring.append(("slot",) + tgt)
+                else:
+                    v = _resolve(d)
+                    if hasattr(v, "shape") and hasattr(v, "dtype"):
+                        wiring.append(("ext", len(ext),
+                                       (tuple(v.shape), str(v.dtype))))
+                    else:
+                        wiring.append(("ext", len(ext),
+                                       ("py", type(v).__name__)))
+                    ext.append(v)
+            wirings.append(wiring)
+            parts.append((e.key, tuple(wiring), len(e.pendings)))
+        seg_key = tuple(parts)
+        runner = _SEG_CACHE.get(seg_key)
+        if runner is None:
+            fns = [e.fn for e in entries]
+            multis = [len(e.pendings) for e in entries]
+            wir = [tuple(w) for w in wirings]
+
+            def run(ext_ops):
+                vals = []
+                for i, fn in enumerate(fns):
+                    args = []
+                    for w in wir[i]:
+                        if w[0] == "ext":
+                            args.append(ext_ops[w[1]])
+                        else:
+                            args.append(vals[w[1]][w[2]])
+                    o = fn(*args)
+                    vals.append(tuple(o) if isinstance(o, (tuple, list))
+                                else (o,))
+                flat = []
+                for v in vals:
+                    flat.extend(v)
+                return tuple(flat)
+
+            import jax
+
+            if len(_SEG_CACHE) >= _SEG_CAP:
+                _SEG_CACHE.pop(next(iter(_SEG_CACHE)))
+            runner = _SEG_CACHE[seg_key] = jax.jit(run)
+        if runner is _FAILED:
+            self._flush_fallback(entries)
+            return
+        try:
+            results = runner(tuple(ext))
+        except Exception:
+            _SEG_CACHE[seg_key] = _FAILED
+            self._flush_fallback(entries)
+            return
+        k = 0
+        for e in entries:
+            for chunk, p in zip(e.chunks, e.pendings):
+                p.value = results[k]
+                if chunk.data is p:
+                    chunk.data = results[k]
+                    chunk.version += 1
+                k += 1
+            if e.node is not None:
+                e.node.xs = tuple(_resolve(d) for d in e.datas)
+
+    def _flush_fallback(self, entries):
+        """Per-entry execution through the per-op jit cache — correctness
+        backstop when the fused segment refuses to trace."""
+        for e in entries:
+            datas = [_resolve(d) for d in e.datas]
+            try:
+                outs = _fwd_jit(e.key, e.fn)(*datas)
+            except Exception:
+                outs = e.fn(*datas)
+                _EAGER_FWD_CACHE[e.key] = _FAILED
+            outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+            for chunk, p, v in zip(e.chunks, e.pendings, outs_t):
+                p.value = v
+                if chunk.data is p:
+                    chunk.data = v
+                    chunk.version += 1
+            if e.node is not None:
+                e.node.xs = tuple(datas)
+
+
+import threading as _threading  # noqa: E402
+
+_QUEUE_TLS = _threading.local()
+
+
+def _queue() -> _BulkQueue:
+    q = getattr(_QUEUE_TLS, "q", None)
+    if q is None:
+        q = _QUEUE_TLS.q = _BulkQueue()
+    return q
+
+
+def flush_bulk():
+    """Flush any queued eager ops (public sync seam; waitall calls it)."""
+    _queue().flush()
+
+
+def _bulk_fwd_enabled() -> bool:
+    from .base import env_bool
+
+    return _bulk_size() > 0 and env_bool("MXTPU_BULK_FWD", True)
+
+
+def _aval_key(d):
+    # np.dtype objects hash by value — no stringification on the hot
+    # path; weak_type is part of the promotion semantics so it must be
+    # part of the key (a weak f32 scalar times bf16 gives bf16)
+    if type(d) is _Pending:
+        return (d.shape, d.dtype, d.weak_type)
+    if hasattr(d, "shape") and hasattr(d, "dtype"):
+        return (tuple(d.shape), d.dtype, getattr(d, "weak_type", False))
+    return ("py", type(d))
+
+
+def _try_enqueue(key, fn, args, datas, record):
+    """Queue this op call; returns (outs, node) of _Pending NDArrays, or
+    None when the op must execute now (unknown aval, scalar-output probes
+    are fine — only trace failures disqualify)."""
+    from . import autograd
+
+    akey = (key, tuple(_aval_key(d) for d in datas))
+    hit = _AVAL_CACHE.get(akey)
+    if hit is _FAILED:
+        return None
+    if hit is None:
+        import jax
+
+        try:
+            spec = [
+                jax.ShapeDtypeStruct(
+                    d.shape, _np.dtype(d.dtype),
+                    weak_type=getattr(d, "weak_type", False))
+                if (type(d) is _Pending
+                    or (hasattr(d, "shape") and hasattr(d, "dtype")))
+                else d
+                for d in datas
+            ]
+            out = jax.eval_shape(fn, *spec)
+        except Exception:
+            _AVAL_CACHE[akey] = _FAILED
+            return None
+        multi = isinstance(out, (tuple, list))
+        structs = tuple(out) if multi else (out,)
+        if len(_AVAL_CACHE) >= _EAGER_CACHE_CAP:
+            _AVAL_CACHE.pop(next(iter(_AVAL_CACHE)))
+        hit = _AVAL_CACHE[akey] = (structs, multi)
+    structs, multi = hit
+    node = None
+    if record:
+        node = autograd._record_deferred(
+            _bwd_jit(key, fn), fn, args,
+            [(s.shape, _np.dtype(s.dtype)) for s in structs], multi,
+            bulk_key=key)
+    outs, multi = _queue().enqueue(key, fn, datas, structs, multi, node)
+    return outs, multi, node
+
+
 def _op_jit_key(op, params):
     """Cache key for a registered-op dispatch; None = do not jit."""
     if not _jit_enabled() or op.name in _EAGER_JIT_DENY \
@@ -220,6 +499,13 @@ def _fn_jit_key(fn):
         return key
     code = getattr(fn, "__code__", None)
     if code is None:
+        # jnp ufuncs (NDArray arithmetic dispatches them directly) have
+        # no __code__ but are pure stateless globals: key by the object
+        # (kept alive by the cache, so id reuse cannot alias)
+        import jax.numpy as jnp
+
+        if isinstance(fn, jnp.ufunc):
+            return ("ufunc", fn)
         return None
     cells = ()
     if fn.__closure__:

@@ -59,6 +59,23 @@ class _Chunk:
         self.version += 1
 
 
+class _Pending:
+    """Placeholder value for an op output still waiting in the forward
+    bulk queue (imperative._BulkQueue): carries the aval so shape/dtype
+    peeks don't force execution; reading ``.data`` flushes the queue.
+    The reference's analogue is an engine var not yet written
+    (``Engine::WaitForVar`` blocks on read; SURVEY §3.1)."""
+
+    __slots__ = ("queue", "shape", "dtype", "weak_type", "value")
+
+    def __init__(self, queue, shape, dtype, weak_type=False):
+        self.queue = queue
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.weak_type = weak_type  # promotion semantics survive the queue
+        self.value = None  # concrete array, set by flush()
+
+
 class _View:
     """View descriptor: how to derive this array from its parent."""
 
@@ -141,7 +158,7 @@ class NDArray:
             self._chunk = None
             self._root = _view.parent._root_array()
         else:
-            if not isinstance(data, jax.Array):
+            if not isinstance(data, jax.Array) and type(data) is not _Pending:
                 data = jnp.asarray(data)
             if ctx is not None:
                 data = jax.device_put(data, ctx.jax_device())
@@ -154,9 +171,16 @@ class NDArray:
     # ------------------------------------------------------------ data cell
     @property
     def data(self) -> jax.Array:
-        """Current functional value of this array (lazy for views)."""
+        """Current functional value of this array (lazy for views);
+        forces the forward bulk queue when the value is still pending."""
         if self._view is None:
-            return self._chunk.data
+            d = self._chunk.data
+            if type(d) is _Pending:
+                if d.value is None:
+                    d.queue.flush()
+                d = d.value
+                self._chunk.data = d
+            return d
         root = self._root_array()
         if self._cache is not None and self._cache_version == root._chunk.version:
             return self._cache
@@ -188,10 +212,14 @@ class NDArray:
     # ------------------------------------------------------------ properties
     @property
     def shape(self) -> Tuple[int, ...]:
+        if self._view is None:
+            return tuple(self._chunk.data.shape)  # peeks _Pending avals
         return tuple(self.data.shape)
 
     @property
     def dtype(self):
+        if self._view is None:
+            return _np.dtype(str(self._chunk.data.dtype))
         return _np.dtype(str(self.data.dtype))
 
     @property
@@ -204,6 +232,8 @@ class NDArray:
 
     @property
     def ctx(self) -> Context:
+        if self._view is None and type(self._chunk.data) is _Pending:
+            return current_context()  # placement resolves at flush
         d = self.data
         try:
             dev = next(iter(d.devices()))
@@ -679,5 +709,7 @@ def from_jax(data: jax.Array) -> NDArray:
 
 def waitall():
     from ..engine import wait_for_all
+    from ..imperative import flush_bulk
 
+    flush_bulk()
     wait_for_all()

@@ -237,6 +237,11 @@ class Optimizer:
     # ------------------------------------------------------- fused dispatch
     def _apply(self, fn, weight, grad, states, lr, wd, **static_hypers):
         """Run a pure fused-update op and rebind weight/states in place."""
+        # the update DONATES weight+state buffers; any queued eager op
+        # that captured them must execute first or it reads deleted memory
+        from ..imperative import flush_bulk
+
+        flush_bulk()
         hypers = dict(static_hypers)
         rescale = float(hypers.pop("rescale_grad", self.rescale_grad))
         hypers.setdefault(

@@ -393,6 +393,9 @@ class TrainStep:
     # ----------------------------------------------------------------- call
     def __call__(self, *batch_and_label):
         """Run one step. Last argument is the label; returns loss NDArray."""
+        from ..imperative import flush_bulk
+
+        flush_bulk()  # donated operands may be captured in the eager queue
         *batch, label = batch_and_label
         batch = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                  for b in batch]

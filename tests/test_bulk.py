@@ -1,0 +1,228 @@
+"""Forward bulk queue + bulked backward semantics (round-5; reference
+analogue: engine bulked segments, ``MXNET_GLUON_EXEC_BULK_SIZE``,
+``src/imperative/imperative_utils.h`` [unverified]).
+
+The invariants that must hold for laziness to be invisible:
+value reads flush; shape/dtype peek WITHOUT flushing; operands are
+captured by value at enqueue (later mutation cannot retroactively change
+a queued op); the bulked backward is numerically identical to per-op
+replay; every kill switch restores the old path.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, imperative, nd
+from mxnet_tpu.ndarray.ndarray import _Pending
+
+
+def _pending(a):
+    return type(a._chunk.data) is _Pending
+
+
+def test_shape_peek_does_not_flush():
+    x = nd.array(np.ones((4, 5), np.float32))
+    y = x * 2.0 + 1.0
+    assert _pending(y)
+    assert y.shape == (4, 5) and y.dtype == np.float32
+    assert _pending(y), "shape/dtype peek must not force the queue"
+    np.testing.assert_allclose(y.asnumpy(), np.full((4, 5), 3.0))
+    assert not _pending(y)
+
+
+def test_capture_by_value_mutation_after_enqueue():
+    """w is mutated in place AFTER an op consuming it was queued: the
+    queued op must see the value at call time, not the mutated one."""
+    w = nd.array(np.ones((3,), np.float32))
+    y = w * 10.0  # queued against w == 1
+    w += 5.0      # in-place rebind (w's read does NOT flush y's queue...
+    # ...necessarily; either way y must be 10, not 60)
+    np.testing.assert_allclose(y.asnumpy(), [10.0, 10.0, 10.0])
+    np.testing.assert_allclose(w.asnumpy(), [6.0, 6.0, 6.0])
+
+
+def test_rebind_of_pending_not_clobbered_by_flush():
+    x = nd.array(np.ones((2,), np.float32))
+    y = x + 1.0           # pending
+    y._rebind((x * 0.0).data)  # user replaces y's value before flush
+    imperative.flush_bulk()
+    np.testing.assert_allclose(y.asnumpy(), [0.0, 0.0])
+
+
+def test_segment_contains_multiple_ops():
+    imperative.flush_bulk()
+    before = len(imperative._SEG_CACHE)
+    x = nd.array(np.random.rand(4, 4).astype(np.float32))
+    y = ((x * 2.0) + 1.0).tanh() - 0.5
+    y.asnumpy()
+    grew = len(imperative._SEG_CACHE) - before
+    assert grew >= 1  # the chain compiled as segment(s), not per-op
+
+
+def test_bulk_parity_with_disabled():
+    rng = np.random.RandomState(0)
+    xs = rng.rand(8, 6).astype(np.float32)
+
+    def run():
+        x = nd.array(xs)
+        y = nd.dot(x, x.T)
+        z = (y.tanh() * 0.5 + y).sum(axis=1)
+        return z.asnumpy()
+
+    on = run()
+    os.environ["MXTPU_BULK_FWD"] = "0"
+    try:
+        off = run()
+    finally:
+        os.environ.pop("MXTPU_BULK_FWD")
+    np.testing.assert_allclose(on, off, rtol=1e-6, atol=1e-7)
+
+
+def test_backward_bulk_parity():
+    rng = np.random.RandomState(1)
+    xs = rng.rand(5, 4).astype(np.float32)
+
+    def run():
+        w = nd.array(xs)
+        w.attach_grad()
+        with autograd.record():
+            y = (w * w).tanh()
+            loss = (y * 3.0).sum()
+        loss.backward()
+        return w.grad.asnumpy()
+
+    g_bulk = run()
+    os.environ["MXTPU_BULK_BWD"] = "0"
+    try:
+        g_plain = run()
+    finally:
+        os.environ.pop("MXTPU_BULK_BWD")
+    np.testing.assert_allclose(g_bulk, g_plain, rtol=1e-6, atol=1e-7)
+
+
+def test_grad_add_accumulates_through_bulk():
+    w = nd.array(np.ones((3,), np.float32))
+    w.attach_grad(grad_req="add")
+    for _ in range(2):
+        with autograd.record():
+            loss = (w * w).sum()
+        loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [4.0, 4.0, 4.0])
+
+
+def test_denied_op_interleaves_correctly():
+    """A deny-listed (RNG) op in the middle of a chain: earlier queued
+    ops must flush before it consumes their values."""
+    mx.random.seed(7)
+    x = nd.array(np.full((64, 64), 2.0, np.float32))
+    y = x * 3.0  # queued
+    with autograd.train_mode():
+        d = nd.Dropout(y, p=0.5)  # denied: consumes y.data -> flush
+    out = d.asnumpy()
+    kept = out[out != 0]
+    np.testing.assert_allclose(kept, np.full_like(kept, 12.0))  # 6 / (1-p)
+
+
+def test_head_grads_respected_in_bulk_backward():
+    w = nd.array(np.ones((4,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        y = w * 2.0
+        z = y + 1.0
+    z.backward(nd.array(np.asarray([1.0, 2.0, 3.0, 4.0], np.float32)))
+    np.testing.assert_allclose(w.grad.asnumpy(), [2.0, 4.0, 6.0, 8.0])
+
+
+def test_retain_graph_allows_second_backward():
+    w = nd.array(np.ones((2,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        y = (w * 3.0).tanh()
+        loss = y.sum()
+    loss.backward(retain_graph=True)
+    g1 = w.grad.asnumpy().copy()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), g1)
+
+
+def test_queue_caps_at_bulk_size():
+    imperative.flush_bulk()
+    x = nd.array(np.ones((2, 2), np.float32))
+    y = x
+    for _ in range(imperative._bulk_size() + 3):
+        y = y + 1.0
+    # the queue must have auto-flushed at the cap: at most (cap - 1)
+    # entries remain pending
+    assert len(imperative._queue().entries) < imperative._bulk_size()
+    y.asnumpy()
+
+
+def test_pending_never_escapes_to_user_numpy():
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    y = x * 2.0
+    arr = np.asarray(y)  # __array__ path
+    np.testing.assert_allclose(arr, xs := np.arange(6).reshape(2, 3) * 2.0)
+    assert float((y + 0.0).asscalar() if False else y.sum().asscalar()) == \
+        float(xs.sum())
+
+
+def test_donating_update_flushes_queue_first():
+    """Regression (round-5 suite): a forward output left pending while a
+    donating optimizer update consumes the same weight buffer — the
+    queue must flush before donation deletes its captured operand."""
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    net = nn.Dense(4)
+    net.initialize()
+    x = nd.array(np.random.rand(2, 3).astype(np.float32))
+    out = net(x)  # enqueued, never read
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.1})
+    with autograd.record():
+        loss = (net(x) * 1.0).sum()
+    loss.backward()
+    trainer.step(2)  # per-param path donates weight buffers
+    out.asnumpy()  # must not read deleted memory
+
+
+def test_weak_scalar_promotion_through_queue():
+    """Advisor round-5 review: a weak-typed scalar operand must keep its
+    promotion semantics through the queue — bf16 * scalar stays bf16,
+    and the peeked dtype agrees with the delivered one."""
+    x = nd.array(np.ones((3,), np.float32)).astype("bfloat16")
+    s = nd.array(2.0)  # weak f32 scalar array
+    y = x * s
+    peek = y.dtype
+    got = y.asnumpy()
+    assert str(got.dtype) == "bfloat16", got.dtype
+    assert str(peek) == str(got.dtype), (peek, got.dtype)
+
+
+def test_runtime_bulk_size_change_respected():
+    """MXNET_GLUON_EXEC_BULK_SIZE is re-read per call (base.get_env
+    contract), so flipping it at runtime takes effect."""
+    imperative.flush_bulk()
+    os.environ["MXNET_GLUON_EXEC_BULK_SIZE"] = "0"
+    try:
+        x = nd.array(np.ones((2,), np.float32))
+        y = x + 1.0
+        from mxnet_tpu.ndarray.ndarray import _Pending as _P
+        assert type(y._chunk.data) is not _P  # executed immediately
+    finally:
+        os.environ.pop("MXNET_GLUON_EXEC_BULK_SIZE")
+
+
+def test_backward_releases_primal_buffers():
+    """After a non-retained backward, nodes must not keep primal operand
+    buffers (xs) alive through the loss reference."""
+    w = nd.array(np.ones((4,), np.float32))
+    w.attach_grad()
+    with autograd.record():
+        loss = (w * 3.0).sum()
+    loss.backward()
+    node = loss._ag.node
+    assert node.freed and node.xs is None and node.bwd_fn is None
