@@ -11,37 +11,17 @@ Sweeps V x (B*S), forward+backward per step, profiler device timing
 from __future__ import annotations
 
 import argparse
-import collections
-import glob
-import gzip
-import json
-import shutil
-import tempfile
 
 import numpy as np
 
 
 def device_ms(fn, args, iters=6):
-    """Median-free: profiler-sum of device op time per call."""
-    import jax
+    """Profiler-sum of device op time per call, in ms (shared helper:
+    metadata-driven lane detection lives in benchmarks/common.py)."""
+    from .common import device_us
 
-    out = fn(*args)
-    jax.block_until_ready(out)
-    d = tempfile.mkdtemp(prefix="lce_")
-    jax.profiler.start_trace(d)
-    for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    jax.profiler.stop_trace()
-    tot = 0.0
-    path = glob.glob(f"{d}/plugins/profile/*/*.trace.json.gz")[0]
-    with gzip.open(path) as f:
-        tr = json.load(f)
-    for e in tr["traceEvents"]:
-        if e.get("ph") == "X" and e.get("pid") == 3 and e.get("tid") == 3:
-            tot += e.get("dur", 0)
-    shutil.rmtree(d, ignore_errors=True)
-    return tot / iters / 1e3
+    us = device_us(fn, args, iters=iters)
+    return us / 1e3 if us is not None else float("nan")
 
 
 def main():

@@ -143,6 +143,14 @@ DEFAULT_SPECS = {
     "_contrib_DeformableConvolution": (
         [(2, 8, 16, 16), (2, 18, 16, 16), (8, 8, 3, 3)],
         {"kernel": (3, 3), "pad": (1, 1), "num_filter": 8, "no_bias": True}),
+    "_contrib_ModulatedDeformableConvolution": (
+        [(2, 8, 16, 16), (2, 18, 16, 16), (2, 9, 16, 16), (8, 8, 3, 3)],
+        {"kernel": (3, 3), "pad": (1, 1), "num_filter": 8, "no_bias": True}),
+    "_contrib_PSROIPooling": ([(1, 196, 32, 32), (8, 5)],
+                              {"output_dim": 4, "pooled_size": 7,
+                               "spatial_scale": 1.0}),
+    "linalg_gesvd": ([(4, 64, 64)], {}),
+    "sample_multinomial": ([(64, 128)], {"shape": (16,)}),
     "_contrib_flash_attention": ([(2, 4, 512, 64)] * 3, {}),
     "_contrib_AdaptiveAvgPooling2D": ([(8, 16, 32, 32)],
                                       {"output_size": 7}),
@@ -157,7 +165,8 @@ _INT_INPUT = {"take": [1], "Embedding": [0], "SoftmaxOutput": [1],
               "linear_cross_entropy": [2]}
 
 
-def bench_op(name, shapes, params, warmup=2, runs=20, dtype=np.float32):
+def bench_op(name, shapes, params, warmup=2, runs=20, dtype=np.float32,
+             device=False):
     import jax
 
     from mxnet_tpu.ops import registry
@@ -212,12 +221,21 @@ def bench_op(name, shapes, params, warmup=2, runs=20, dtype=np.float32):
         jit_us = (time.perf_counter() - t0) / runs * 1e6
     except Exception as e:  # noqa: BLE001
         jit_us = None
+    dev_us = None
+    if device and jit_us is not None:
+        from .common import device_us
+
+        try:
+            dev_us = device_us(jfn, args)
+        except Exception:  # noqa: BLE001 - profiler unavailable (CPU rigs)
+            dev_us = None
     return {"op": name, "dtype": np.dtype(dtype).name,
             "eager_us": round(eager_us, 1),
-            "jit_us": round(jit_us, 1) if jit_us is not None else None}
+            "jit_us": round(jit_us, 1) if jit_us is not None else None,
+            "device_us": round(dev_us, 1) if dev_us is not None else None}
 
 
-def run(ops=None, warmup=2, runs=20, dtypes=("float32",)):
+def run(ops=None, warmup=2, runs=20, dtypes=("float32",), device=False):
     specs = DEFAULT_SPECS if not ops else {
         k: v for k, v in DEFAULT_SPECS.items()
         if k in ops or k.removeprefix("_contrib_") in ops
@@ -228,7 +246,8 @@ def run(ops=None, warmup=2, runs=20, dtypes=("float32",)):
     for name, (shapes, params) in specs.items():
         for dt in dtypes:
             dtype = jnp.bfloat16 if dt == "bfloat16" else np.dtype(dt)
-            row = bench_op(name, shapes, params, warmup, runs, dtype=dtype)
+            row = bench_op(name, shapes, params, warmup, runs, dtype=dtype,
+                           device=device)
             if row is None:
                 continue
             rows.append(row)
@@ -236,23 +255,28 @@ def run(ops=None, warmup=2, runs=20, dtypes=("float32",)):
                 print(f"{name:28s} [{dt:8s}] ERROR {row['error']}")
             else:
                 j = f"{row['jit_us']:10.1f}"                     if row["jit_us"] is not None else "       n/a"
+                dv = row.get("device_us")
+                dv = f"   device {dv:9.1f} us" if dv is not None else ""
                 print(f"{name:28s} [{dt:8s}] eager "
-                      f"{row['eager_us']:10.1f} us   jit {j} us")
+                      f"{row['eager_us']:10.1f} us   jit {j} us{dv}")
     return rows
 
 
 def write_markdown(rows, path):
     """Markdown report (the reference harness wrote one per category)."""
     lines = ["# opperf report", "",
-             "| op | dtype | eager (us) | jit (us) |", "|---|---|---|---|"]
+             "| op | dtype | eager (us) | jit (us) | device (us) |",
+             "|---|---|---|---|---|"]
     for r in rows:
         if "error" in r:
-            lines.append(f"| {r['op']} | — | ERROR | {r['error']} |")
+            lines.append(f"| {r['op']} | — | ERROR | {r['error']} | — |")
         else:
             j = r["jit_us"] if r["jit_us"] is not None else "n/a"
+            d = r.get("device_us")
+            d = d if d is not None else "n/a"
             lines.append(
                 f"| {r['op']} | {r.get('dtype', 'float32')} | "
-                f"{r['eager_us']} | {j} |"
+                f"{r['eager_us']} | {j} | {d} |"
             )
     with open(path, "w") as f:
         f.write("\n".join(lines) + "\n")
@@ -269,8 +293,12 @@ def main():
                     help="print one JSON line with all rows")
     ap.add_argument("--md", default=None,
                     help="write a markdown report to this path")
+    ap.add_argument("--device", action="store_true",
+                    help="add a profiler-counted DEVICE time column (the "
+                         "wall columns sit at the tunnel dispatch floor)")
     args = ap.parse_args()
-    rows = run(args.ops, args.warmup, args.runs, tuple(args.dtypes))
+    rows = run(args.ops, args.warmup, args.runs, tuple(args.dtypes),
+               device=args.device)
     if args.json:
         print(json.dumps({"opperf": rows}))
     if args.md:

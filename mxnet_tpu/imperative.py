@@ -141,7 +141,7 @@ _EAGER_JIT_DENY = {
     "_random_uniform", "_random_normal", "_random_gamma",
     "_random_exponential", "_random_poisson", "_random_randint",
     "sample_uniform", "sample_normal", "sample_gamma",
-    "sample_exponential", "sample_poisson",
+    "sample_exponential", "sample_poisson", "sample_multinomial",
 }
 _FAILED = object()
 
@@ -223,6 +223,18 @@ def _resolve(d):
     return d
 
 
+def _resolve_strict(d):
+    """Resolve an operand, re-raising the producing op's failure for a
+    dead pending instead of handing None downstream."""
+    if type(d) is _Pending:
+        if d.value is None:
+            raise d.error or MXNetError(
+                "bulk-queued operand was never produced (upstream op "
+                "failed)")
+        return d.value
+    return d
+
+
 def _lazy_data(a):
     """Operand capture WITHOUT forcing the queue: a live _Pending stays a
     slot reference; everything else is its concrete value."""
@@ -261,6 +273,15 @@ class _BulkQueue:
         return outs, multi
 
     def flush(self):
+        # resolve cross-thread dependencies BEFORE taking our own lock:
+        # flushing a foreign queue while holding ours could ABBA-deadlock
+        # two threads exchanging NDArrays. Our entries list is only ever
+        # appended by this thread, so scanning it lock-free is safe.
+        for e in self.entries:
+            for d in e.datas:
+                if type(d) is _Pending and d.value is None \
+                        and d.error is None and d.queue is not self:
+                    d.queue.flush()
         with self._lock:
             self._flush_locked()
 
@@ -280,11 +301,13 @@ class _BulkQueue:
             for d in e.datas:
                 if type(d) is _Pending and d.value is None:
                     tgt = slot_of.get(id(d))
-                    if tgt is None:  # foreign queue leak: force it now
-                        d.queue.flush()
+                    if tgt is None:
+                        # foreign-queue pending (pre-resolved in flush();
+                        # raced or failed cases surface the op's error)
+                        v = _resolve_strict(d)
                         wiring.append(("ext", len(ext),
-                                       (d.value.shape, str(d.value.dtype))))
-                        ext.append(d.value)
+                                       (tuple(v.shape), str(v.dtype))))
+                        ext.append(v)
                     else:
                         wiring.append(("slot",) + tgt)
                 else:
@@ -349,14 +372,25 @@ class _BulkQueue:
 
     def _flush_fallback(self, entries):
         """Per-entry execution through the per-op jit cache — correctness
-        backstop when the fused segment refuses to trace."""
+        backstop when the fused segment refuses to trace. A failing
+        entry must not poison its siblings: every entry still executes
+        (or records its error on its pendings), and the FIRST failure
+        re-raises after the sweep."""
+        first_err = None
         for e in entries:
-            datas = [_resolve(d) for d in e.datas]
             try:
-                outs = _fwd_jit(e.key, e.fn)(*datas)
-            except Exception:
-                outs = e.fn(*datas)
-                _EAGER_FWD_CACHE[e.key] = _FAILED
+                datas = [_resolve_strict(d) for d in e.datas]
+                try:
+                    outs = _fwd_jit(e.key, e.fn)(*datas)
+                except Exception:
+                    outs = e.fn(*datas)
+                    _EAGER_FWD_CACHE[e.key] = _FAILED
+            except Exception as exc:  # noqa: BLE001 - recorded per pending
+                for p in e.pendings:
+                    p.error = exc
+                if first_err is None:
+                    first_err = exc
+                continue
             outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
             for chunk, p, v in zip(e.chunks, e.pendings, outs_t):
                 p.value = v
@@ -365,6 +399,8 @@ class _BulkQueue:
                     chunk.version += 1
             if e.node is not None:
                 e.node.xs = tuple(datas)
+        if first_err is not None:
+            raise first_err
 
 
 import threading as _threading  # noqa: E402

@@ -226,14 +226,17 @@ def create(name="local") -> KVStore:
     reduction). 'dist_sync'/'dist_async' → distributed store over the jax
     coordinator (requires `mxnet_tpu.parallel.init_process_group`).
 
-    SEMANTICS NOTE: 'dist_async' is accepted for API compatibility but
-    runs with 'dist_sync' semantics. The reference's async mode let each
-    worker push/pull against the parameter server without waiting for
-    the others; the TPU-native transport is XLA collectives, which are
-    inherently bulk-synchronous — there is no parameter server to be
-    asynchronous against. Code written for dist_async runs correctly
-    (synchronous execution satisfies async's contract), just without the
-    staleness/throughput trade the reference offered.
+    SEMANTICS NOTE: 'dist_async' implements BOUNDED-STALENESS semantics
+    (round-5): with an updater set (``kv.set_optimizer``, the analogue
+    of the reference's server-side updater) each push applies LOCALLY
+    with no cross-host wait — reads may be stale — and every
+    ``MXTPU_ASYNC_STALENESS_BOUND`` pushes (default 8) the replicas
+    reconcile with one parameter-averaging collective. This is local
+    SGD / periodic averaging: the collectives-native analogue of the
+    reference's parameter-server async, with the staleness bound the
+    server's consistency knob provided. Workers must push each key at
+    the same cadence (the reconcile is a collective). Without an
+    updater it degrades to dist_sync semantics.
     """
     if not isinstance(name, str):
         raise MXNetError("name must be a string")
@@ -247,15 +250,17 @@ def create(name="local") -> KVStore:
 
         if kind == "dist_async" and not _ASYNC_WARNED[0]:
             # runtime signal, not just a docstring (advisor round 3):
-            # ported scripts get different throughput/staleness behavior
+            # ported scripts get a DIFFERENT async than the reference's
             import warnings
 
             warnings.warn(
-                "kv.create('dist_async') runs with dist_sync semantics on "
-                "this backend: XLA collectives are bulk-synchronous and "
-                "there is no parameter server to be asynchronous against. "
-                "Results are correct; the async staleness/throughput trade "
-                "does not exist here.",
+                "kv.create('dist_async') runs bounded-staleness local "
+                "apply + periodic parameter averaging (every "
+                "MXTPU_ASYNC_STALENESS_BOUND=8 pushes per key), not a "
+                "parameter-server async: pushes return without cross-host "
+                "waits and pulls may read stale replicas, reconciled at "
+                "the bound. Requires kv.set_optimizer; workers must push "
+                "each key at the same cadence.",
                 RuntimeWarning,
                 stacklevel=2,
             )

@@ -33,6 +33,25 @@ class KVStoreDist(KVStore):
         self._rank = 0
         self._num_workers = 1
         self._initialized_dist = False
+        # dist_async: bounded-staleness mode (round-4 verdict item 8).
+        # The reference's async let each worker hit the parameter server
+        # without waiting; with collectives as the only transport, the
+        # TPU-native analogue is LOCAL apply (push returns without any
+        # cross-host wait) plus a parameter-averaging collective every
+        # `staleness_bound` pushes per key — local SGD / periodic
+        # averaging, which bounds divergence exactly the way the
+        # reference's staleness bound did. Requires updater-on-store
+        # (like the reference's server-side updater) and the SPMD
+        # contract that workers push each key at the same cadence (the
+        # reconcile is a collective; mismatched cadence hangs like any
+        # mismatched collective).
+        self._async = kv_type == "dist_async"
+        self._push_counts: dict = {}
+        self._warned_compress = False
+        from ..base import env_int
+
+        self._staleness_bound = max(1, env_int(
+            "MXTPU_ASYNC_STALENESS_BOUND", 8))
         self._maybe_init_dist()
 
     def _maybe_init_dist(self):
@@ -68,6 +87,10 @@ class KVStoreDist(KVStore):
             if k not in self._data:
                 raise MXNetError(f"key {k} not initialized in kvstore")
             datas = [v.data for v in vals]
+            if self._async and self._updater is not None \
+                    and self._num_workers > 1:
+                self._push_async(k, datas)
+                continue
             # reference worker order (``kvstore_dist.h`` [unverified]):
             # aggregate the local device replicas FIRST, then compress
             # once per worker, then ship — so the wire carries one
@@ -86,6 +109,39 @@ class KVStoreDist(KVStore):
                               self._data[k])
             else:
                 self._data[k]._rebind(agg)
+
+    def _push_async(self, k, datas):
+        """Bounded-staleness push: apply the LOCAL gradient immediately
+        (no cross-host wait — the worker runs ahead on its own replica,
+        reads are allowed to be stale), then every ``staleness_bound``
+        pushes reconcile the replicas with one parameter-averaging
+        collective. Ref: dist_async server-side updater + staleness
+        bound (``src/kvstore/kvstore_dist_server.h`` [unverified])."""
+        agg = datas[0]
+        for v in datas[1:]:
+            agg = agg + v
+        if self._compression is not None and not self._warned_compress:
+            # the local apply transmits nothing, so quantizing it would
+            # add error while saving zero wire bytes; the reconcile ships
+            # full weights (averaging quantized weights is not the
+            # gradient-compression contract). Signal instead of silently
+            # degrading.
+            self._warned_compress = True
+            import warnings
+
+            warnings.warn(
+                "gradient compression has no wire transfer to compress "
+                "under dist_async local-apply; ignored (the periodic "
+                "reconcile ships full-precision parameters)",
+                RuntimeWarning, stacklevel=3)
+        self._updater(int(k) if k.isdigit() else k, NDArray(agg),
+                      self._data[k])
+        c = self._push_counts.get(k, 0) + 1
+        self._push_counts[k] = c
+        if c % self._staleness_bound == 0:
+            w = self._data[k].data
+            avg = self._cross_host_sum(w) / self._num_workers
+            self._data[k]._rebind(avg)
 
     def _cross_host_sum_compressed(self, k, agg):
         """Real wire-byte 2-bit transfer: quantize + error-feedback on the

@@ -66,7 +66,7 @@ class _Pending:
     The reference's analogue is an engine var not yet written
     (``Engine::WaitForVar`` blocks on read; SURVEY §3.1)."""
 
-    __slots__ = ("queue", "shape", "dtype", "weak_type", "value")
+    __slots__ = ("queue", "shape", "dtype", "weak_type", "value", "error")
 
     def __init__(self, queue, shape, dtype, weak_type=False):
         self.queue = queue
@@ -74,6 +74,7 @@ class _Pending:
         self.dtype = dtype
         self.weak_type = weak_type  # promotion semantics survive the queue
         self.value = None  # concrete array, set by flush()
+        self.error = None  # producing-op exception, if the flush failed
 
 
 class _View:
@@ -178,6 +179,13 @@ class NDArray:
             if type(d) is _Pending:
                 if d.value is None:
                     d.queue.flush()
+                if d.value is None:
+                    # the producing op failed during flush; surface ITS
+                    # error here instead of storing None and crashing
+                    # somewhere unrelated later
+                    err = d.error or MXNetError(
+                        "bulk-queued op failed to produce this value")
+                    raise err
                 d = d.value
                 self._chunk.data = d
             return d

@@ -321,6 +321,68 @@ def roi_pooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0, **kw):
     return jax.vmap(one_roi)(rois)
 
 
+@register("_contrib_PSROIPooling", aliases=["PSROIPooling", "psroipooling"])
+def psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                  pooled_size=7, group_size=0, **kw):
+    """Position-sensitive ROI pooling (R-FCN; reference:
+    ``src/operator/contrib/psroi_pooling.cc`` [unverified]).
+
+    data (B, C, H, W) with C = output_dim * group_size**2; rois (R, 5)
+    rows [batch_idx, x1, y1, x2, y2] -> (R, output_dim, ps, ps). Output
+    bin (i, j) of class channel k AVERAGES its own channel slice
+    c = (k * gs + gy) * gs + gx over the bin's pixels (reference hard
+    integer bins: floor/ceil bounds, empty bin -> 0).
+
+    TPU-first formulation: per-bin membership is a pair of static-shape
+    range masks (like ROIPooling above) so the whole op is masked
+    reductions + one static gather — no dynamic shapes, fully
+    differentiable w.r.t. data."""
+    ps = int(pooled_size)
+    gs = int(group_size) or ps
+    B, C, H, W = data.shape
+    K = int(output_dim) if output_dim else C // (gs * gs)
+    if C != K * gs * gs:
+        raise ValueError(
+            f"PSROIPooling: C={C} must equal output_dim*group_size^2 "
+            f"= {K}*{gs}^2")
+    rows = jnp.arange(H)
+    cols = jnp.arange(W)
+    bins = jnp.arange(ps)
+    # channel index per (k, i, j): position-sensitive slice selection
+    gy = (jnp.arange(ps) * gs) // ps
+    cidx = ((jnp.arange(K)[:, None, None] * gs + gy[None, :, None]) * gs
+            + gy[None, None, :])  # (K, ps, ps)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        img = data[bidx]  # (C, H, W)
+        x1 = jnp.round(roi[1]) * spatial_scale
+        y1 = jnp.round(roi[2]) * spatial_scale
+        x2 = jnp.round(roi[3] + 1.0) * spatial_scale
+        y2 = jnp.round(roi[4] + 1.0) * spatial_scale
+        bh = jnp.maximum(y2 - y1, 0.1) / ps
+        bw = jnp.maximum(x2 - x1, 0.1) / ps
+        sh = jnp.clip(jnp.floor(y1 + bins * bh), 0, H).astype(jnp.int32)
+        eh = jnp.clip(jnp.ceil(y1 + (bins + 1) * bh), 0, H).astype(jnp.int32)
+        sw = jnp.clip(jnp.floor(x1 + bins * bw), 0, W).astype(jnp.int32)
+        ew = jnp.clip(jnp.ceil(x1 + (bins + 1) * bw), 0, W).astype(jnp.int32)
+        mask_r = (rows[None, :] >= sh[:, None]) & \
+            (rows[None, :] < eh[:, None])   # (ps, H)
+        mask_c = (cols[None, :] >= sw[:, None]) & \
+            (cols[None, :] < ew[:, None])   # (ps, W)
+        # per-bin sums as two masked matmuls (MXU path)
+        t = jnp.einsum("ih,chw->ciw", mask_r.astype(img.dtype), img)
+        sums = jnp.einsum("ciw,jw->cij", t, mask_c.astype(img.dtype))
+        cnt = (eh - sh)[:, None] * (ew - sw)[None, :]  # (ps, ps)
+        avg = sums / jnp.maximum(cnt, 1)[None]
+        avg = jnp.where((cnt > 0)[None], avg, 0.0)     # empty bin -> 0
+        ii = jnp.arange(ps)[:, None]
+        jj = jnp.arange(ps)[None, :]
+        return avg[cidx, ii[None], jj[None]]           # (K, ps, ps)
+
+    return jax.vmap(one_roi)(rois)
+
+
 # ----------------------------------------------------------- pooling/resize
 def _adaptive_matrix(in_size: int, out_size: int):
     w = _np.zeros((out_size, in_size), dtype=_np.float32)
@@ -839,27 +901,11 @@ def rcnn_target_sampler(rois, gt_boxes, num_sample=128, pos_ratio=0.25,
 
 
 # ------------------------------------------------------ deformable conv
-@register("_contrib_DeformableConvolution",
-          aliases=["DeformableConvolution", "deformable_convolution"])
-def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
-                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
-                           num_filter=None, num_deformable_group=1,
-                           num_group=1, no_bias=False, **kw):
-    """Deformable convolution v1 (reference:
-    ``src/operator/contrib/deformable_convolution.cc`` [unverified]).
-
-    data (B, C, H, W); offset (B, 2*G*kh*kw, H', W') with per-position
-    (dy, dx) for every kernel tap, G = num_deformable_group (channel
-    groups sharing an offset field); weight (O, C/num_group, kh, kw).
-
-    TPU-first formulation: the deformed sampling is ONE vectorized
-    bilinear gather (jax.scipy map_coordinates order=1, zero padding
-    outside — the reference's im2col-with-offsets), producing the
-    (B, C, kh*kw, H', W') column tensor, and the conv collapses to a
-    single einsum on the MXU. Fully differentiable w.r.t. data, offset,
-    and weight through XLA autodiff — the reference hand-wrote those
-    three backward kernels.
-    """
+def _deform_columns(data, offset, kernel, stride, dilate, pad,
+                    num_deformable_group=1, num_group=1):
+    """Deformed im2col: ONE vectorized bilinear gather (map_coordinates
+    order=1, zeros outside) -> (B, C, kh*kw, Ho, Wo). Shared by
+    DeformableConvolution v1 and the modulated v2."""
     from jax.scipy.ndimage import map_coordinates
 
     if num_group != 1:
@@ -908,8 +954,74 @@ def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
     sample_b = jax.vmap(sample_g, in_axes=(0, 0, 0))             # batch
     dg = data.reshape(B, G, cg, H, W)
     cols = sample_b(dg, sy, sx)          # (B, G, cg, K, Ho, Wo)
-    cols = cols.reshape(B, C, K, Ho, Wo)
+    return cols.reshape(B, C, K, Ho, Wo)
 
+
+@register("_contrib_DeformableConvolution",
+          aliases=["DeformableConvolution", "deformable_convolution"])
+def deformable_convolution(data, offset, weight, bias=None, kernel=(3, 3),
+                           stride=(1, 1), dilate=(1, 1), pad=(0, 0),
+                           num_filter=None, num_deformable_group=1,
+                           num_group=1, no_bias=False, **kw):
+    """Deformable convolution v1 (reference:
+    ``src/operator/contrib/deformable_convolution.cc`` [unverified]).
+
+    data (B, C, H, W); offset (B, 2*G*kh*kw, H', W') with per-position
+    (dy, dx) for every kernel tap, G = num_deformable_group (channel
+    groups sharing an offset field); weight (O, C/num_group, kh, kw).
+
+    TPU-first formulation: the deformed sampling is ONE vectorized
+    bilinear gather (jax.scipy map_coordinates order=1, zero padding
+    outside — the reference's im2col-with-offsets), producing the
+    (B, C, kh*kw, H', W') column tensor, and the conv collapses to a
+    single einsum on the MXU. Fully differentiable w.r.t. data, offset,
+    and weight through XLA autodiff — the reference hand-wrote those
+    three backward kernels.
+    """
+    B, C, H, W = data.shape
+    cols = _deform_columns(data, offset, kernel, stride, dilate, pad,
+                           num_deformable_group=num_deformable_group,
+                           num_group=num_group)
+    wflat = weight.reshape(weight.shape[0], C, cols.shape[2])
+    out = jnp.einsum("bckhw,ock->bohw", cols, wflat)
+    if bias is not None and not no_bias:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out
+
+
+@register("_contrib_ModulatedDeformableConvolution",
+          aliases=["ModulatedDeformableConvolution",
+                   "modulated_deformable_convolution"])
+def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
+                                     kernel=(3, 3), stride=(1, 1),
+                                     dilate=(1, 1), pad=(0, 0),
+                                     num_filter=None,
+                                     num_deformable_group=1, num_group=1,
+                                     no_bias=False, **kw):
+    """Deformable convolution v2 (reference:
+    ``src/operator/contrib/modulated_deformable_convolution.cc``
+    [unverified]): v1 plus a learned per-tap modulation scalar —
+    ``mask`` (B, G*kh*kw, H', W'), already sigmoid-activated by the
+    caller per the reference contract — multiplying each sampled column.
+
+    Same TPU-first formulation as v1: one vectorized bilinear gather
+    builds the column tensor, the modulation is a broadcast multiply
+    XLA fuses into it, and the conv is a single MXU einsum; all three
+    hand-written reference backward kernels come from autodiff."""
+    kh, kw = (kernel, kernel) if isinstance(kernel, int) else tuple(kernel)
+    B, C, H, W = data.shape
+    G = int(num_deformable_group)
+    K = kh * kw
+    cols = _deform_columns(data, offset, kernel, stride, dilate, pad,
+                           num_deformable_group=G, num_group=num_group)
+    Ho, Wo = cols.shape[-2:]
+    if mask.shape != (B, G * K, Ho, Wo):
+        raise ValueError(
+            f"mask shape {mask.shape} must be (B, G*kh*kw, Ho, Wo) = "
+            f"({B}, {G * K}, {Ho}, {Wo})")
+    m = mask.reshape(B, G, 1, K, Ho, Wo)
+    cols = (cols.reshape(B, G, C // G, K, Ho, Wo) * m).reshape(
+        B, C, K, Ho, Wo)
     wflat = weight.reshape(weight.shape[0], C, K)
     out = jnp.einsum("bckhw,ock->bohw", cols, wflat)
     if bias is not None and not no_bias:

@@ -189,3 +189,14 @@ def linalg_gelqf(A, **kw):
     orthonormal rows (reference gelqf)."""
     q, r = jnp.linalg.qr(_t(A), mode="reduced")
     return _t(r), _t(q)
+
+
+@register("linalg_gesvd", aliases=["_linalg_gesvd", "SVD"], num_outputs=3)
+def linalg_gesvd(A, **kw):
+    """Singular value decomposition of (..., m, n) A with m <= n:
+    A = U diag(L) V, V with orthonormal ROWS (reference gesvd layout:
+    ``src/operator/tensor/la_op.cc`` [unverified] returns UT/L/V such
+    that A = UT * diag(L) * V). Lowers to jnp.linalg.svd
+    (XLA's one-sided Jacobi on TPU)."""
+    u, s, vt = jnp.linalg.svd(A, full_matrices=False)
+    return u, s, vt

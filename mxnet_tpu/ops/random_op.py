@@ -122,3 +122,33 @@ register("sample_exponential", differentiable=False)(
 register("sample_poisson", differentiable=False)(_per_element(_vmap_draw(
     lambda k, p, tail: jax.random.poisson(k, p[0], tail).astype(
         jnp.float32)), key_fn=_threefry_key))
+
+
+@register("sample_multinomial", aliases=["_sample_multinomial"],
+          differentiable=False)
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                      **kw):
+    """Categorical draws from probability rows (reference
+    ``sample_multinomial``, ``src/operator/random/multisample_op.cc``
+    [unverified]): data (..., K) of (unnormalized-OK) probabilities ->
+    int draws of shape data.shape[:-1] + shape; ``get_prob=True`` also
+    returns the log-probability of each draw (the REINFORCE helper,
+    matching the reference's second output)."""
+    d = jnp.asarray(data)
+    tail = _shape(shape)
+    n_draw = 1
+    for t in tail:
+        n_draw *= int(t)
+    flat = d.reshape(-1, d.shape[-1]).astype(jnp.float32)
+    logp = jnp.log(jnp.clip(flat, 1e-37, None))
+    logp = logp - jax.scipy.special.logsumexp(logp, axis=-1,
+                                              keepdims=True)
+    keys = jax.random.split(_key(), flat.shape[0])
+    draws = jax.vmap(
+        lambda k, lp: jax.random.categorical(k, lp, shape=(n_draw,))
+    )(keys, logp)  # (N, n_draw)
+    out = draws.reshape(d.shape[:-1] + tail).astype(_dt(dtype))
+    if get_prob:
+        lp = jnp.take_along_axis(logp, draws, axis=1)
+        return out, lp.reshape(d.shape[:-1] + tail).astype(jnp.float32)
+    return out

@@ -137,6 +137,8 @@ def init_process_group(coordinator_address: str, num_processes: int,
     the reference's nightly dist tests), so pick gloo before the backend is
     instantiated — harmless for TPU runs, where the TPU client syncs through
     the coordination service itself."""
+    if jax.distributed.is_initialized():
+        return  # idempotent: a second KVStore/TrainStep must not re-join
     try:
         jax.config.update("jax_cpu_collectives_implementation", "gloo")
     except Exception:  # older jaxlib without gloo: single-node CPU fallback

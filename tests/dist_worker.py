@@ -68,6 +68,39 @@ def main():
               f"(wire bytes/worker: {kv.last_push_wire_bytes})")
         return 0
 
+    if mode == "async":
+        # bounded-staleness dist_async (round-5): local apply, stale
+        # reads, parameter-averaging reconcile at the bound
+        from mxnet_tpu import optimizer as opt
+
+        lr = 0.1
+        bound = int(os.environ["MXTPU_ASYNC_STALENESS_BOUND"])
+        assert bound == 2
+        kv2 = mx.kv.create("dist_async")
+        kv2.set_optimizer(opt.SGD(learning_rate=lr))
+        kv2.init("w", nd.ones((3,)))
+        g = rank + 1.0  # workers push DIFFERENT gradients
+
+        # push 1: applied locally, NO reconcile -> replicas DIVERGE
+        kv2.push("w", nd.ones((3,)) * g)
+        out = nd.zeros((3,))
+        kv2.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), 1.0 - lr * g, rtol=1e-5)
+
+        # push 2 hits the bound: local apply THEN average across workers
+        kv2.push("w", nd.ones((3,)) * g)
+        kv2.pull("w", out=out)
+        locals_ = [1.0 - lr * 2 * (r + 1) for r in range(nworkers)]
+        want = sum(locals_) / nworkers
+        np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-5)
+
+        # push 3: diverges again from the common reconciled base
+        kv2.push("w", nd.ones((3,)) * g)
+        kv2.pull("w", out=out)
+        np.testing.assert_allclose(out.asnumpy(), want - lr * g, rtol=1e-5)
+        print(f"worker {rank}/{nworkers}: dist_async bounded-staleness OK")
+        return 0
+
     # init must be identical on all workers (reference requirement)
     kv.init("0", nd.zeros((4, 3)))
     kv.init("big", nd.ones((8,)) * 100)

@@ -134,3 +134,14 @@ print("REF" + json.dumps(losses))
     # reduction-order noise only
     np.testing.assert_allclose(ranks[0]["losses"], ref_losses,
                                rtol=1e-4, atol=1e-5)
+
+
+def test_two_process_dist_async_bounded_staleness():
+    """dist_async (round-5): pushes apply locally (replicas diverge —
+    the stale-read contract), and the staleness bound triggers a
+    parameter-averaging reconcile; workers assert the exact local,
+    reconciled, and re-diverged values."""
+    env = dict(os.environ, DIST_TEST_MODE="async",
+               MXTPU_ASYNC_STALENESS_BOUND="2")
+    rc = _launch_with_env(2, [sys.executable, _WORKER], env)
+    assert rc == 0

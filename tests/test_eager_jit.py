@@ -155,11 +155,11 @@ def test_dist_async_warns_once():
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         mx.kv.create("dist_async")
-        assert any("dist_sync semantics" in str(x.message) for x in w)
+        assert any("bounded-staleness" in str(x.message) for x in w)
     with warnings.catch_warnings(record=True) as w:
         warnings.simplefilter("always")
         mx.kv.create("dist_async")
-        assert not any("dist_sync semantics" in str(x.message) for x in w)
+        assert not any("bounded-staleness" in str(x.message) for x in w)
 
 
 def test_proposal_flat_layout():

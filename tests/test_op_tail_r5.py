@@ -1,0 +1,179 @@
+"""Round-5 op tail: PSROIPooling, ModulatedDeformableConvolution,
+linalg_gesvd (nd-level SVD), sample_multinomial (reference:
+``src/operator/contrib/psroi_pooling.cc``,
+``modulated_deformable_convolution.cc``, ``tensor/la_op.cc``,
+``random/multisample_op.cc`` [unverified])."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+rng = np.random.RandomState(0)
+
+
+# --------------------------------------------------------- PSROIPooling
+def test_psroi_pooling_selects_position_channels():
+    """Each output bin must read its OWN channel slice: a feature map
+    where channel c is constant c makes the expected output exactly the
+    channel index of each (k, i, j) bin."""
+    ps, gs, K = 3, 3, 2
+    C = K * gs * gs
+    data = np.broadcast_to(
+        np.arange(C, dtype=np.float32)[None, :, None, None],
+        (1, C, 12, 12)).copy()
+    rois = np.asarray([[0, 0, 0, 11, 11]], np.float32)
+    out = nd.contrib.PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=K, pooled_size=ps).asnumpy()
+    assert out.shape == (1, K, ps, ps)
+    gy = (np.arange(ps) * gs) // ps
+    want = ((np.arange(K)[:, None, None] * gs + gy[None, :, None]) * gs
+            + gy[None, None, :]).astype(np.float32)
+    np.testing.assert_allclose(out[0], want)
+
+
+def test_psroi_pooling_averages_bins():
+    ps = 2
+    C = 1 * ps * ps
+    data = rng.rand(2, C, 8, 8).astype(np.float32)
+    rois = np.asarray([[1, 0, 0, 7, 7]], np.float32)
+    out = nd.contrib.PSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0,
+        output_dim=1, pooled_size=ps).asnumpy()
+    # bin (0,0) of the only class reads channel 0, rows 0..3, cols 0..3
+    np.testing.assert_allclose(out[0, 0, 0, 0],
+                               data[1, 0, 0:4, 0:4].mean(), rtol=1e-5)
+    # bin (1,1) reads channel 3, rows 4..7, cols 4..7
+    np.testing.assert_allclose(out[0, 0, 1, 1],
+                               data[1, 3, 4:8, 4:8].mean(), rtol=1e-5)
+
+
+def test_psroi_pooling_gradient():
+    ps = 2
+    data = rng.rand(1, ps * ps, 6, 6).astype(np.float64)
+    rois = nd.array(np.asarray([[0, 0, 0, 5, 5]], np.float32))
+
+    def f(d):
+        return nd.contrib.PSROIPooling(d, rois, spatial_scale=1.0,
+                                       output_dim=1, pooled_size=ps)
+
+    check_numeric_gradient(f, [data], rtol=3e-2, atol=1e-3)
+
+
+def test_psroi_pooling_bad_channels_raises():
+    with pytest.raises(Exception, match="output_dim"):
+        nd.contrib.PSROIPooling(
+            nd.array(np.zeros((1, 7, 4, 4), np.float32)),
+            nd.array(np.asarray([[0, 0, 0, 3, 3]], np.float32)),
+            output_dim=2, pooled_size=2)
+
+
+# ------------------------------------- ModulatedDeformableConvolution
+def _mdc_shapes(B=1, C=4, H=6, W=6, O=3, k=3, G=1):
+    data = rng.rand(B, C, H, W).astype(np.float32)
+    Ho = Wo = H - k + 1
+    off = (rng.rand(B, 2 * G * k * k, Ho, Wo).astype(np.float32) - 0.5)
+    m = 1.0 / (1.0 + np.exp(-rng.rand(B, G * k * k, Ho, Wo)
+                            .astype(np.float32)))
+    w = rng.rand(O, C, k, k).astype(np.float32) * 0.2
+    return data, off, m, w
+
+
+def test_modulated_matches_v1_with_unit_mask():
+    data, off, m, w = _mdc_shapes()
+    ones = np.ones_like(m)
+    v2 = nd.contrib.ModulatedDeformableConvolution(
+        nd.array(data), nd.array(off), nd.array(ones), nd.array(w),
+        kernel=(3, 3), num_filter=3, no_bias=True).asnumpy()
+    v1 = nd.contrib.DeformableConvolution(
+        nd.array(data), nd.array(off), nd.array(w),
+        kernel=(3, 3), num_filter=3, no_bias=True).asnumpy()
+    np.testing.assert_allclose(v2, v1, rtol=1e-5, atol=1e-6)
+
+
+def test_modulated_mask_scales_contributions():
+    """mask==0 must zero the sampled columns: output becomes the bias
+    (here zero)."""
+    data, off, m, w = _mdc_shapes()
+    zeros = np.zeros_like(m)
+    v2 = nd.contrib.ModulatedDeformableConvolution(
+        nd.array(data), nd.array(off), nd.array(zeros), nd.array(w),
+        kernel=(3, 3), num_filter=3, no_bias=True).asnumpy()
+    np.testing.assert_allclose(v2, 0.0, atol=1e-7)
+
+
+def test_modulated_gradients():
+    data, off, m, w = _mdc_shapes(C=2, O=2, H=5, W=5)
+
+    def f(d, o, mm, ww):
+        return nd.contrib.ModulatedDeformableConvolution(
+            d, o, mm, ww, kernel=(3, 3), num_filter=2, no_bias=True)
+
+    check_numeric_gradient(
+        f, [data.astype(np.float64), off.astype(np.float64),
+            m.astype(np.float64), w.astype(np.float64)],
+        rtol=3e-2, atol=1e-3)
+
+
+# --------------------------------------------------------- linalg_gesvd
+def test_gesvd_reconstructs():
+    A = rng.rand(3, 5).astype(np.float32)
+    U, L, V = nd.linalg_gesvd(nd.array(A))
+    rec = U.asnumpy() @ np.diag(L.asnumpy()) @ V.asnumpy()
+    np.testing.assert_allclose(rec, A, rtol=1e-4, atol=1e-5)
+    # V has orthonormal rows
+    np.testing.assert_allclose(V.asnumpy() @ V.asnumpy().T, np.eye(3),
+                               rtol=1e-4, atol=1e-5)
+    # singular values descending, non-negative
+    s = L.asnumpy()
+    assert (s[:-1] >= s[1:] - 1e-6).all() and (s >= 0).all()
+
+
+def test_gesvd_gradient():
+    A = rng.rand(3, 4).astype(np.float64) + np.eye(3, 4)
+
+    def f(a):
+        return nd.linalg_gesvd(a)[1].sum()  # d(sum of singular values)
+
+    check_numeric_gradient(f, [A], rtol=3e-2, atol=1e-3)
+
+
+def test_svd_alias_resolves():
+    from mxnet_tpu.ops import registry
+
+    assert registry.maybe_get("SVD") is not None
+    assert registry.maybe_get("SwapAxis") is not None  # round-4 probe fix
+
+
+# --------------------------------------------------- sample_multinomial
+def test_sample_multinomial_statistics():
+    mx.random.seed(3)
+    probs = nd.array(np.asarray([[0.1, 0.2, 0.7],
+                                 [0.8, 0.1, 0.1]], np.float32))
+    draws = nd.sample_multinomial(probs, shape=(4000,)).asnumpy()
+    assert draws.shape == (2, 4000)
+    assert draws.dtype == np.int32
+    f0 = (draws[0] == 2).mean()
+    f1 = (draws[1] == 0).mean()
+    assert abs(f0 - 0.7) < 0.05, f0
+    assert abs(f1 - 0.8) < 0.05, f1
+
+
+def test_sample_multinomial_get_prob():
+    mx.random.seed(4)
+    probs = nd.array(np.asarray([[0.25, 0.75]], np.float32))
+    out, logp = nd.sample_multinomial(probs, shape=(64,), get_prob=True)
+    o, lp = out.asnumpy(), logp.asnumpy()
+    want = np.where(o == 1, np.log(0.75), np.log(0.25))
+    np.testing.assert_allclose(lp, want, rtol=1e-5)
+
+
+def test_sample_multinomial_scalar_shape():
+    mx.random.seed(5)
+    probs = nd.array(np.asarray([[0.0, 1.0, 0.0]], np.float32))
+    out = nd.sample_multinomial(probs).asnumpy()
+    assert out.shape == (1,)
+    assert (out == 1).all()
