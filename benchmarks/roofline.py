@@ -49,6 +49,7 @@ def main():
     ap.add_argument("--steps", type=int, default=5)
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--skip-trace", action="store_true")
+    ap.add_argument("--remat", default=None, choices=(None, "full", "dots"))
     args = ap.parse_args()
 
     from . import trace_config as tc
@@ -57,7 +58,8 @@ def main():
     if args.config == "resnet50":
         step, x, y, items = tc.build_resnet50(args.batch or 64, args.layout)
     elif args.config == "transformer":
-        step, x, y, items = tc.build_transformer(args.batch or 64)
+        step, x, y, items = tc.build_transformer(args.batch or 64,
+                                                 remat=args.remat)
     else:
         raise SystemExit(f"unsupported config {args.config}")
 

@@ -38,7 +38,7 @@ def build_resnet50(batch=64, layout="NCHW"):
     return step, x, y, batch
 
 
-def build_transformer(batch=32, seq=64):
+def build_transformer(batch=32, seq=64, remat=None):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, optimizer as opt
     from mxnet_tpu.gluon.model_zoo.transformer import transformer_base
@@ -55,7 +55,8 @@ def build_transformer(batch=32, seq=64):
         return ce(logits.reshape(-1, logits.shape[-1]), label.reshape(-1))
 
     step = TrainStep(net, loss_fn, opt.Adam(learning_rate=1e-4),
-                     compute_dtype="bfloat16", state_dtype="bfloat16")
+                     compute_dtype="bfloat16", state_dtype="bfloat16",
+                     remat=remat)
     rng = np.random.RandomState(0)
     src = nd.array(rng.randint(0, 32000, (batch, seq)), dtype="int32")
     tgt = nd.array(rng.randint(0, 32000, (batch, seq)), dtype="int32")

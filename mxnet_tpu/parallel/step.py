@@ -155,7 +155,8 @@ class TrainStep:
                  data_spec: Optional[PartitionSpec] = None,
                  param_rules: Sequence[Tuple[str, PartitionSpec]] = (),
                  donate: bool = True, grad_accum: int = 1,
-                 compute_dtype=None, state_dtype=None, steps_per_call: int = 1):
+                 compute_dtype=None, state_dtype=None, steps_per_call: int = 1,
+                 remat: Optional[str] = None):
         self._net = net
         self._loss = loss_fn
         self._optimizer = optimizer
@@ -184,6 +185,14 @@ class TrainStep:
         self._state_dtype = (
             jnp.dtype(state_dtype) if state_dtype is not None else None
         )
+        # rematerialization (jax.checkpoint over the traced forward):
+        # trades recompute FLOPs for residual HBM traffic — the standard
+        # lever when the step is memory-bound. 'dots' keeps matmul
+        # outputs resident (the usual transformer policy); 'full'
+        # recomputes everything.
+        if remat not in (None, "full", "dots"):
+            raise MXNetError("remat must be None, 'full', or 'dots'")
+        self._remat = remat
         self._params = list(net.collect_params().items())
         for name, p in self._params:
             if p._data is None:
@@ -301,6 +310,12 @@ class TrainStep:
                 Lm = L.data.astype(jnp.float32).mean()
             aux = {name2param_inv[id(p)]: v for p, v in sink.items()}
             return Lm, aux
+
+        if self._remat is not None:
+            policy = None if self._remat == "full" else \
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            forward_loss = jax.checkpoint(
+                forward_loss, policy=policy, static_argnums=())
 
         # rescale_grad is a dynamic operand: AMP dynamic loss scaling and
         # batch-size changes fold into it per step and must not retrace.

@@ -120,3 +120,15 @@ def test_mp_still_learns():
         L = step(mx.nd.array(X), mx.nd.array(Y))
     l1 = float(L.asscalar())
     assert l1 < l0 * 0.7
+
+
+def test_remat_parity():
+    """TrainStep(remat=...) must not change numerics — only the
+    recompute schedule (round-5: the transformer roofline's negative
+    result keeps the option for long-sequence regimes)."""
+    base = _run_step(opt.Adam(learning_rate=0.01))
+    for mode in ("dots", "full"):
+        got = _run_step(opt.Adam(learning_rate=0.01), remat=mode)
+        for k in base:
+            np.testing.assert_allclose(got[k], base[k], rtol=1e-5,
+                                       atol=1e-6, err_msg=f"{mode}:{k}")
