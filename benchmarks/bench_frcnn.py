@@ -71,7 +71,7 @@ def main():
     # params donated — same contract as every other config's TrainStep;
     # STEPS_PER_CALL steps scanned per dispatch (tunnel amortization,
     # same as every other round-4 config)
-    STEPS_PER_CALL = 5
+    STEPS_PER_CALL = 20
 
     def one_step(vals, xb, gtb):
         L, grads = jax.value_and_grad(loss_fn)(vals, xb, gtb)

@@ -54,7 +54,7 @@ def main():
                              spatial_scale=1.0, sample_ratio=2)
         return kept, pooled
 
-    CALLS_PER_DISPATCH = 10
+    CALLS_PER_DISPATCH = 64
 
     @jax.jit
     def head_n(deltas, anchors, scores, feats):
