@@ -4,8 +4,8 @@ from __future__ import annotations
 
 
 def main():
-    from . import (bench_frcnn, bench_lenet, bench_resnet50, bench_ssd,
-                   bench_transformer)
+    from . import (bench_frcnn, bench_lenet, bench_module, bench_resnet50,
+                   bench_ssd, bench_transformer)
 
     bench_lenet.main()
     bench_resnet50.main()
@@ -15,6 +15,7 @@ def main():
     bench_transformer.main()
     bench_ssd.main()
     bench_frcnn.main()
+    bench_module.main()
 
 
 if __name__ == "__main__":
