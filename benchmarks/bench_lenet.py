@@ -55,8 +55,14 @@ def main():
     run_bench(
         "lenet_mnist_imperative_images_per_sec", "images/sec", CEILING,
         step, lambda loss: float(loss.mean().asscalar()), BATCH,
-        warmup=3, steps=30,
+        warmup=3, steps=120,
     )
+    # steps=120 (round 5): with the host loop bulked to ~3.6 ms/step the
+    # 4 windows were dominated by the fixed ~90 ms tunnel sync RTT each
+    # pays on its single 4-byte fetch; longer windows amortize that fixed
+    # cost the same way the training configs' steps_per_call scans do.
+    # The sync still waits for the WINDOW'S ENTIRE queued work, so the
+    # rate is sustained throughput, not queueing.
 
 
 if __name__ == "__main__":
