@@ -137,6 +137,7 @@ _EAGER_JIT_DENY = {
     "RNN",       # dropout path inside the scan body
     "Custom",    # python-callback custom ops manage their own tape/state
     "unique",    # data-dependent output shape
+    "_contrib_boolean_mask",  # data-dependent output shape (host mask)
     # registry random samplers: key drawn in the body, same freeze hazard
     "_random_uniform", "_random_normal", "_random_gamma",
     "_random_exponential", "_random_poisson", "_random_randint",

@@ -421,7 +421,8 @@ def bilinear_resize(data, height=None, width=None, scale_height=None,
     return jax.image.resize(data, (n, c, oh, ow), method="bilinear")
 
 
-@register("_contrib_count_sketch", differentiable=False)
+@register("_contrib_count_sketch", aliases=["count_sketch"],
+          differentiable=False)
 def count_sketch(data, h, s, out_dim=None, **kw):  # rarely used; minimal
     idx = h.astype(jnp.int32)
     signed = data * s
@@ -1027,3 +1028,119 @@ def modulated_deformable_convolution(data, offset, mask, weight, bias=None,
     if bias is not None and not no_bias:
         out = out + bias.reshape(1, -1, 1, 1)
     return out
+
+
+# ------------------------------------------------------- round-5 contrib tail
+@register("_contrib_quadratic", aliases=["quadratic"])
+def quadratic(data, a=0.0, b=0.0, c=0.0, **kw):
+    """a*x^2 + b*x + c (the reference's tutorial contrib op,
+    ``src/operator/contrib/quadratic_op.cc`` [unverified])."""
+    return a * jnp.square(data) + b * data + c
+
+
+@register("_contrib_allclose", aliases=["allclose"], differentiable=False)
+def allclose_op(a, b, rtol=1e-5, atol=1e-8, equal_nan=False, **kw):
+    """1.0 iff allclose (reference ``_contrib_allclose``)."""
+    return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                        equal_nan=equal_nan).astype(jnp.float32).reshape(1)
+
+
+@register("_contrib_index_copy", aliases=["index_copy"])
+def index_copy(old, index, new, **kw):
+    """Copy rows of ``new`` into ``old`` at ``index`` (reference
+    ``src/operator/contrib/index_copy.cc`` [unverified]); functional
+    result, differentiable through both data inputs."""
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_index_array", aliases=["index_array"],
+          differentiable=False)
+def index_array(data, axes=None, **kw):
+    """Per-element N-d indices (reference ``index_array``): output
+    data.shape + (len(axes),)."""
+    nd_ = data.ndim
+    ax = tuple(axes) if axes is not None else tuple(range(nd_))
+    grids = jnp.meshgrid(*[jnp.arange(s) for s in data.shape],
+                         indexing="ij")
+    return jnp.stack([grids[a] for a in ax], axis=-1).astype(jnp.int32)
+
+
+def _grad_mult_fwd(data, scalar):
+    return data, scalar
+
+
+def _grad_mult_bwd(res, ct):
+    return ct * res, None
+
+
+@jax.custom_vjp
+def _grad_mult(data, scalar):
+    return data
+
+
+_grad_mult.defvjp(lambda d, s: (d, s), lambda s, ct: (ct * s, None))
+
+
+@register("_contrib_gradientmultiplier", aliases=["gradientmultiplier"])
+def gradientmultiplier(data, scalar=1.0, **kw):
+    """Identity forward, gradient scaled by ``scalar`` (reference
+    ``src/operator/contrib/gradient_multiplier_op.cc`` [unverified] —
+    the GRL building block with negative scalar)."""
+    return _grad_mult(data, jnp.asarray(scalar, data.dtype))
+
+
+@jax.custom_vjp
+def _rounded_ste(data):
+    return jnp.round(data)
+
+
+_rounded_ste.defvjp(lambda d: (jnp.round(d), None), lambda _, ct: (ct,))
+
+
+@register("_contrib_round_ste", aliases=["round_ste", "rounded_ste",
+                                         "_contrib_rounded_ste"])
+def round_ste(data, **kw):
+    """Straight-through round (reference ``_contrib_round_ste``,
+    quantization-aware training)."""
+    return _rounded_ste(data)
+
+
+@jax.custom_vjp
+def _sign_ste(data):
+    return jnp.sign(data)
+
+
+_sign_ste.defvjp(lambda d: (jnp.sign(d), None), lambda _, ct: (ct,))
+
+
+@register("_contrib_sign_ste", aliases=["sign_ste"])
+def sign_ste(data, **kw):
+    return _sign_ste(data)
+
+
+@register("_contrib_boolean_mask", aliases=["boolean_mask"],
+          differentiable=False)
+def boolean_mask(data, index, axis=0, **kw):
+    """Select rows where index != 0 (reference
+    ``src/operator/contrib/boolean_mask.cc`` [unverified]).
+
+    Data-dependent OUTPUT SHAPE: like ``unique``, this op cannot live
+    under jit/bulking (it is deny-listed) — it materializes the mask on
+    host and returns the packed selection, matching the reference's
+    dynamic-shape contract."""
+    import numpy as _onp
+
+    m = _onp.asarray(index) != 0
+    return jnp.take(data, jnp.asarray(_onp.nonzero(m)[0]), axis=axis)
+
+
+@register("_contrib_edge_id", aliases=["edge_id"], differentiable=False)
+def edge_id(data, u, v, **kw):
+    """Edge ids for (u, v) pairs in a dense adjacency-style matrix
+    (reference DGL helper ``src/operator/contrib/dgl_graph.cc``
+    [unverified]): returns data[u[i], v[i]] per pair, -1 where the
+    entry is zero (no edge)."""
+    uu = u.astype(jnp.int32)
+    vv = v.astype(jnp.int32)
+    vals = data[uu, vv]
+    return jnp.where(vals != 0, vals, -1.0).astype(data.dtype)

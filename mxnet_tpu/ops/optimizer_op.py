@@ -294,3 +294,141 @@ def multi_mp_sgd_mom_update(*wgmw32, lrs=0.01, wds=0.0, momentum=0.0,
         nw32 = w32 + nm
         out.extend([nw32.astype(w.dtype), nm, nw32])
     return tuple(out)
+
+
+# ------------------------------------------------ round-5 optimizer tail
+@register("ftml_update", mutates_input=0, differentiable=False)
+def ftml_update(weight, grad, d, v, z, lr=0.0025, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, t=1, wd=0.0, rescale_grad=1.0,
+                clip_grad=-1.0, **kw):
+    """FTML (reference ``ftml_update``, ``src/operator/optimizer_op.cc``
+    [unverified]; Zheng & Kwok 2017): follow-the-moving-leader."""
+    g = grad * rescale_grad + wd * weight
+    if clip_grad >= 0:
+        g = jnp.clip(g, -clip_grad, clip_grad)
+    tf = jnp.float32(t)
+    new_v = beta2 * v + (1.0 - beta2) * jnp.square(g)
+    d_t = (1.0 - beta1 ** tf) / lr * (
+        jnp.sqrt(new_v / (1.0 - beta2 ** tf)) + epsilon)
+    sigma = d_t - beta1 * d
+    new_z = beta1 * z + (1.0 - beta1) * g - sigma * weight
+    new_w = -new_z / d_t
+    return new_w.astype(weight.dtype), d_t, new_v, new_z
+
+
+@register("_contrib_group_adagrad_update",
+          aliases=["group_adagrad_update"], mutates_input=0,
+          differentiable=False)
+def group_adagrad_update(weight, grad, history, lr=0.01, rescale_grad=1.0,
+                         clip_gradient=-1.0, epsilon=1e-5, **kw):
+    """Row-wise (grouped) AdaGrad (reference
+    ``src/operator/contrib/optimizer_op.cc`` [unverified]): one history
+    scalar per ROW of the weight (embedding-style)."""
+    g = grad * rescale_grad
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    mean_sq = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)))
+    new_h = history + mean_sq.reshape(history.shape)
+    denom = jnp.sqrt(new_h).reshape((-1,) + (1,) * (g.ndim - 1)) + epsilon
+    return (weight - lr * g / denom).astype(weight.dtype), new_h
+
+
+@register("_contrib_multi_adamw_update", aliases=["multi_adamw_update"],
+          differentiable=False, num_outputs=None)
+def multi_adamw_update(*wgmv, lrs=0.001, wds=0.0, etas=1.0, beta1=0.9,
+                       beta2=0.999, epsilon=1e-8, clip_gradient=-1.0,
+                       num_weights=None, rescale_grad=1.0, **kw):
+    """Multi-tensor AdamW (reference ``_contrib_multi_adamw_update``
+    [unverified]): interleaved (w, g, m, v) x N -> (w', m', v') x N;
+    ``etas`` is the per-tensor schedule multiplier the contrib op took."""
+    n = num_weights or len(wgmv) // 4
+    lrs, wds = _norm_seq(lrs, n), _norm_seq(wds, n)
+    etas = _norm_seq(etas, n)
+    out = []
+    for i in range(n):
+        w, g, m, v = wgmv[4 * i:4 * i + 4]
+        gg = g * rescale_grad
+        if clip_gradient >= 0:
+            gg = jnp.clip(gg, -clip_gradient, clip_gradient)
+        nm = beta1 * m + (1.0 - beta1) * gg
+        nv = beta2 * v + (1.0 - beta2) * jnp.square(gg)
+        upd = nm / (jnp.sqrt(nv) + epsilon) + wds[i] * w
+        out.extend([(w - etas[i] * lrs[i] * upd).astype(w.dtype), nm, nv])
+    return tuple(out)
+
+
+@register("preloaded_multi_sgd_update", differentiable=False,
+          num_outputs=None)
+def preloaded_multi_sgd_update(*args, rescale_grad=1.0, clip_gradient=-1.0,
+                               num_weights=None, **kw):
+    """Reference ``preloaded_multi_sgd_update`` [unverified]: like
+    multi_sgd_update but lrs/wds arrive as DEVICE arrays (trailing two
+    operands) so schedule changes never re-trace."""
+    lrs, wds = args[-2], args[-1]
+    wg = args[:-2]
+    n = num_weights or len(wg) // 2
+    clip = clip_gradient if clip_gradient >= 0 else None
+    out = []
+    for i in range(n):
+        w, g = wg[2 * i], wg[2 * i + 1]
+        gg = _apply_wd_rescale(w, g, wds[i], rescale_grad, clip)
+        out.append(w - lrs[i] * gg)
+    return tuple(out)
+
+
+@register("preloaded_multi_sgd_mom_update", differentiable=False,
+          num_outputs=None)
+def preloaded_multi_sgd_mom_update(*args, momentum=0.0, rescale_grad=1.0,
+                                   clip_gradient=-1.0, num_weights=None,
+                                   **kw):
+    lrs, wds = args[-2], args[-1]
+    wgm = args[:-2]
+    n = num_weights or len(wgm) // 3
+    clip = clip_gradient if clip_gradient >= 0 else None
+    out = []
+    for i in range(n):
+        w, g, m = wgm[3 * i], wgm[3 * i + 1], wgm[3 * i + 2]
+        gg = _apply_wd_rescale(w, g, wds[i], rescale_grad, clip)
+        nm = momentum * m - lrs[i] * gg
+        out.extend([w + nm, nm])
+    return tuple(out)
+
+
+@register("_contrib_lans_update_phase1", aliases=["lans_update_phase1"],
+          differentiable=False)
+def lans_update_phase1(weight, grad, mean, var, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, t=1, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, **kw):
+    """LANS phase 1 (reference ``src/operator/contrib/adamw.cc`` LANS
+    [unverified]; Zheng et al. 2020): gradient is NORMALIZED before the
+    moments; returns the two candidate update directions interleaved
+    along a leading axis of 2 (m-part, g-part) plus new moments."""
+    g = grad * rescale_grad
+    gnorm = jnp.sqrt(jnp.sum(jnp.square(g)))
+    g = g / jnp.maximum(gnorm, 1e-12)
+    if clip_gradient >= 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    tf = jnp.float32(t)
+    nm = beta1 * mean + (1.0 - beta1) * g
+    nv = beta2 * var + (1.0 - beta2) * jnp.square(g)
+    m_hat = nm / (1.0 - beta1 ** tf)
+    v_hat = nv / (1.0 - beta2 ** tf)
+    r1 = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * weight
+    r2 = g / (jnp.sqrt(v_hat) + epsilon) + wd * weight
+    return jnp.stack([r1, r2]), nm, nv
+
+
+@register("_contrib_lans_update_phase2", aliases=["lans_update_phase2"],
+          mutates_input=0, differentiable=False)
+def lans_update_phase2(weight, gpair, wnorm, gnorms, lr=0.001, beta1=0.9,
+                       lower_bound=-1.0, upper_bound=-1.0, **kw):
+    """LANS phase 2: trust-ratio-scaled blend of the two phase-1
+    directions; gpair is the stacked (2, ...) output of phase 1,
+    gnorms the (2,) norms of those directions."""
+    ratio = jnp.where(gnorms > 0, wnorm / jnp.maximum(gnorms, 1e-12), 1.0)
+    if lower_bound >= 0:
+        ratio = jnp.maximum(ratio, lower_bound)
+    if upper_bound >= 0:
+        ratio = jnp.minimum(ratio, upper_bound)
+    step = beta1 * ratio[0] * gpair[0] + (1.0 - beta1) * ratio[1] * gpair[1]
+    return (weight - lr * step).astype(weight.dtype)

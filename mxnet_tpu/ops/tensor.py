@@ -680,3 +680,65 @@ def crop(data, *like, offset=(0, 0), h_w=(0, 0), num_args=1,
                 f"Crop: offset ({oy}, {ox}) + target ({th}, {tw}) runs "
                 f"past the input ({H}, {W})")
     return data[:, :, oy:oy + th, ox:ox + tw]
+
+# ----------------------------------------------------------- round-5 tail
+# shape/size probes, moments, full, AMP casts, all-finite guards
+# (reference: ``src/operator/tensor/elemwise_unary_op_basic.cc``,
+# ``src/operator/all_finite.cc``, ``src/operator/tensor/amp_cast.cc``
+# [unverified])
+# int32 (not the reference's int64): jax x64 is off by default and
+# would silently truncate anyway — match the backend's native width
+register("shape_array", differentiable=False)(
+    lambda data, **kw: jnp.asarray(data.shape, jnp.int32)
+)
+register("size_array", differentiable=False)(
+    lambda data, **kw: jnp.asarray(
+        functools.reduce(lambda a, b: a * b, data.shape, 1), jnp.int32)
+)
+
+
+@register("moments")
+def moments(data, axes=None, keepdims=False, **kw):
+    """(mean, var) in one pass (reference ``moments``)."""
+    ax = tuple(axes) if axes is not None else None
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.mean(jnp.square(data - mean), axis=ax, keepdims=keepdims)
+    if not keepdims:
+        mean = mean.reshape(var.shape)
+    return mean, var
+
+
+register("amp_cast")(
+    lambda data, dtype="float32", **kw: data.astype(jnp.dtype(dtype))
+)
+
+
+@register("amp_multicast", num_outputs=None)
+def amp_multicast(*data, num_outputs=None, cast_narrow=False, **kw):
+    """Cast every input to a common dtype: the WIDEST by default (the
+    reference's mixed-precision harmonizer), the narrowest with
+    ``cast_narrow``."""
+    dts = [d.dtype for d in data]
+    target = dts[0]
+    for dt in dts[1:]:
+        wider = jnp.promote_types(target, dt)
+        if cast_narrow:
+            target = dt if wider == target else target
+        else:
+            target = wider
+    return tuple(d.astype(target) for d in data)
+
+
+@register("all_finite", differentiable=False)
+def all_finite(data, init_output=True, **kw):
+    """1.0 iff every element is finite (reference ``all_finite`` — the
+    AMP loss-scale overflow probe)."""
+    return jnp.isfinite(data).all().astype(jnp.float32).reshape(1)
+
+
+@register("multi_all_finite", differentiable=False)
+def multi_all_finite(*data, num_arrays=None, init_output=True, **kw):
+    ok = jnp.asarray(True)
+    for d in data:
+        ok = ok & jnp.isfinite(d).all()
+    return ok.astype(jnp.float32).reshape(1)

@@ -698,6 +698,44 @@ class FTRL(Optimizer):
 
 
 @register
+class FTML(Optimizer):
+    """Follow the Moving Leader (reference ``FTML`` optimizer over
+    ``ftml_update`` [unverified]; Zheng & Kwok 2017)."""
+
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8,
+                 learning_rate=0.0025, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # d
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # v
+            NDArray(jnp.zeros(weight.shape, weight.data.dtype)),  # z
+        )
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        d, v, z = state
+        # inline (AdaGrad-style) rather than _apply: t changes per step
+        # and would retrace a static-hyper jit every call
+        nw, nd_, nv, nz = _fused.ftml_update(
+            weight.data, grad.data, d.data, v.data, z.data, lr=lr,
+            beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon,
+            t=self._index_update_count[index], wd=wd,
+            rescale_grad=self.rescale_grad,
+            clip_grad=self.clip_gradient
+            if self.clip_gradient is not None else -1.0)
+        weight._rebind(nw)
+        d._rebind(nd_)
+        v._rebind(nv)
+        z._rebind(nz)
+
+
+@register
 class SGLD(Optimizer):
     """Stochastic gradient Langevin dynamics."""
 

@@ -1,0 +1,217 @@
+"""Round-5 op tail, second batch: AMP guards (amp_cast/amp_multicast/
+all_finite/multi_all_finite), shape/size/moments/STE/contrib misc, and
+the optimizer-op tail (ftml, group_adagrad, multi_adamw, preloaded
+multi-sgd, lans). Reference: ``src/operator/tensor/amp_cast.cc``,
+``all_finite.cc``, ``contrib/optimizer_op.cc``, ``contrib/adamw.cc``
+[unverified]."""
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+rng = np.random.RandomState(1)
+
+
+def test_shape_size_array():
+    x = nd.array(rng.rand(3, 5).astype(np.float32))
+    np.testing.assert_array_equal(nd.shape_array(x).asnumpy(), [3, 5])
+    assert int(nd.size_array(x).asnumpy()) == 15
+
+
+def test_moments():
+    x = rng.rand(4, 6).astype(np.float32)
+    mean, var = nd.moments(nd.array(x), axes=(1,))
+    np.testing.assert_allclose(mean.asnumpy(), x.mean(1), rtol=1e-5)
+    np.testing.assert_allclose(var.asnumpy(), x.var(1), rtol=1e-5)
+    mean2, var2 = nd.moments(nd.array(x), axes=(0,), keepdims=True)
+    assert var2.shape == (1, 6)
+
+
+def test_amp_cast_and_multicast():
+    x = nd.array(rng.rand(2, 3).astype(np.float32))
+    y = nd.amp_cast(x, dtype="float16")
+    assert y.dtype == np.float16
+    a = nd.array(rng.rand(2, 2).astype(np.float16))
+    b = nd.array(rng.rand(2, 2).astype(np.float32))
+    ca, cb = nd.amp_multicast(a, b, num_outputs=2)
+    assert ca.dtype == np.float32 and cb.dtype == np.float32
+
+
+def test_all_finite_probes():
+    ok = nd.array(np.ones((4,), np.float32))
+    bad = nd.array(np.asarray([1.0, np.inf, 0.0], np.float32))
+    assert float(nd.all_finite(ok).asnumpy()[0]) == 1.0
+    assert float(nd.all_finite(bad).asnumpy()[0]) == 0.0
+    assert float(nd.multi_all_finite(ok, ok, num_arrays=2)
+                 .asnumpy()[0]) == 1.0
+    assert float(nd.multi_all_finite(ok, bad, num_arrays=2)
+                 .asnumpy()[0]) == 0.0
+
+
+def test_quadratic_and_gradient():
+    x = rng.rand(3, 3).astype(np.float64)
+    out = nd.contrib.quadratic(nd.array(x), a=2.0, b=-1.0, c=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * x * x - x + 0.5,
+                               rtol=1e-6)
+    check_numeric_gradient(
+        lambda d: nd.contrib.quadratic(d, a=2.0, b=-1.0, c=0.5), [x])
+
+
+def test_allclose_op():
+    a = nd.array(np.ones((3,), np.float32))
+    b = nd.array(np.ones((3,), np.float32) + 1e-7)
+    assert float(nd.contrib.allclose(a, b).asnumpy()[0]) == 1.0
+    c = nd.array(np.ones((3,), np.float32) + 1.0)
+    assert float(nd.contrib.allclose(a, c).asnumpy()[0]) == 0.0
+
+
+def test_index_copy_and_gradient():
+    old = rng.rand(5, 3).astype(np.float64)
+    new = rng.rand(2, 3).astype(np.float64)
+    idx = nd.array(np.asarray([1, 3], np.int32))
+    out = nd.contrib.index_copy(nd.array(old), idx, nd.array(new))
+    want = old.copy()
+    want[[1, 3]] = new
+    np.testing.assert_allclose(out.asnumpy(), want, rtol=1e-6)
+    check_numeric_gradient(
+        lambda o, n: nd.contrib.index_copy(o, idx, n), [old, new])
+
+
+def test_index_array():
+    x = nd.array(np.zeros((2, 3), np.float32))
+    out = nd.contrib.index_array(x).asnumpy()
+    assert out.shape == (2, 3, 2)
+    assert out[1, 2, 0] == 1 and out[1, 2, 1] == 2
+    out_ax = nd.contrib.index_array(x, axes=(1,)).asnumpy()
+    assert out_ax.shape == (2, 3, 1)
+    np.testing.assert_array_equal(out_ax[:, :, 0], [[0, 1, 2], [0, 1, 2]])
+
+
+def test_gradientmultiplier_scales_only_gradient():
+    x = nd.array(rng.rand(4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.contrib.gradientmultiplier(x, scalar=-0.5)
+        loss = (y * 3.0).sum()
+    loss.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy(), rtol=1e-6)
+    np.testing.assert_allclose(x.grad.asnumpy(), -1.5 * np.ones(4),
+                               rtol=1e-6)
+
+
+def test_straight_through_estimators():
+    x = nd.array(np.asarray([-1.2, 0.4, 2.6], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        loss = nd.contrib.round_ste(x).sum()
+    loss.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones(3))  # identity
+    with autograd.record():
+        loss2 = nd.contrib.sign_ste(x).sum()
+    loss2.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.ones(3))
+
+
+def test_boolean_mask_dynamic_shape():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    idx = nd.array(np.asarray([1, 0, 1, 0], np.float32))
+    out = nd.contrib.boolean_mask(data, idx).asnumpy()
+    assert out.shape == (2, 3)
+    np.testing.assert_array_equal(out, [[0, 1, 2], [6, 7, 8]])
+
+
+def test_edge_id():
+    adj = np.zeros((4, 4), np.float32)
+    adj[0, 1] = 7.0
+    adj[2, 3] = 9.0
+    u = nd.array(np.asarray([0, 2, 1], np.int32))
+    v = nd.array(np.asarray([1, 3, 1], np.int32))
+    out = nd.contrib.edge_id(nd.array(adj), u, v).asnumpy()
+    np.testing.assert_array_equal(out, [7.0, 9.0, -1.0])
+
+
+# ------------------------------------------------------- optimizer ops
+def test_ftml_optimizer_converges():
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(5)
+    net = nn.Dense(1)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "ftml",
+                            {"learning_rate": 0.05})
+    X = rng.rand(32, 4).astype(np.float32)
+    Y = (X @ np.asarray([[1.0], [-2.0], [0.5], [3.0]], np.float32))
+    loss_fn = gluon.loss.L2Loss()
+    first = None
+    for _ in range(60):
+        with autograd.record():
+            L = loss_fn(net(nd.array(X)), nd.array(Y))
+        L.backward()
+        trainer.step(32)
+        v = float(L.mean().asscalar())
+        first = first if first is not None else v
+    assert v < first * 0.3, (first, v)
+
+
+def test_group_adagrad_rowwise_history():
+    w = nd.array(np.ones((3, 4), np.float32))
+    g = nd.array(np.full((3, 4), 2.0, np.float32))
+    h = nd.array(np.zeros((3,), np.float32))
+    nw, nh = nd.contrib.group_adagrad_update(w, g, h, lr=0.1)
+    np.testing.assert_allclose(nh.asnumpy(), [4.0, 4.0, 4.0])  # mean g^2
+    np.testing.assert_allclose(nw.asnumpy(),
+                               1.0 - 0.1 * 2.0 / (2.0 + 1e-5),
+                               rtol=1e-4)
+
+
+def test_multi_adamw_matches_single():
+    w = rng.rand(4, 4).astype(np.float32)
+    g = rng.rand(4, 4).astype(np.float32)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    outs = nd.contrib.multi_adamw_update(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v),
+        lrs=0.01, wds=0.1, etas=1.0, num_weights=1)
+    nw = outs[0].asnumpy()
+    # hand-rolled single AdamW step (beta defaults)
+    nm = 0.1 * g
+    nv = 0.001 * g * g
+    want = w - 0.01 * (nm / (np.sqrt(nv) + 1e-8) + 0.1 * w)
+    np.testing.assert_allclose(nw, want, rtol=1e-4)
+
+
+def test_preloaded_multi_sgd_device_hypers():
+    w1 = rng.rand(3).astype(np.float32)
+    g1 = rng.rand(3).astype(np.float32)
+    w2 = rng.rand(2).astype(np.float32)
+    g2 = rng.rand(2).astype(np.float32)
+    lrs = nd.array(np.asarray([0.1, 0.2], np.float32))
+    wds = nd.array(np.zeros(2, np.float32))
+    o1, o2 = nd.preloaded_multi_sgd_update(
+        nd.array(w1), nd.array(g1), nd.array(w2), nd.array(g2),
+        lrs, wds, num_weights=2)
+    np.testing.assert_allclose(o1.asnumpy(), w1 - 0.1 * g1, rtol=1e-5)
+    np.testing.assert_allclose(o2.asnumpy(), w2 - 0.2 * g2, rtol=1e-5)
+
+
+def test_lans_two_phase():
+    w = rng.rand(4, 4).astype(np.float32)
+    g = rng.rand(4, 4).astype(np.float32)
+    m = np.zeros_like(w)
+    v = np.zeros_like(w)
+    pair, nm, nv = nd.contrib.lans_update_phase1(
+        nd.array(w), nd.array(g), nd.array(m), nd.array(v), t=1, wd=0.01)
+    assert pair.shape == (2, 4, 4)
+    wnorm = nd.array(np.asarray(np.linalg.norm(w), np.float32))
+    p = pair.asnumpy()
+    gnorms = nd.array(np.asarray(
+        [np.linalg.norm(p[0]), np.linalg.norm(p[1])], np.float32))
+    nw = nd.contrib.lans_update_phase2(
+        nd.array(w), pair, wnorm, gnorms, lr=0.01)
+    assert nw.shape == w.shape
+    assert np.isfinite(nw.asnumpy()).all()
+    assert not np.allclose(nw.asnumpy(), w)
