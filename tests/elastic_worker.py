@@ -1,0 +1,72 @@
+"""Worker body for the elastic-restart test: a 2-process global-mesh
+training job where rank 1 dies mid-run on the first attempt; the
+relaunched attempt resumes from the latest COMMITTED sharded checkpoint
+and finishes. Exercises SURVEY §5 failure recovery end-to-end:
+crash -> launcher teardown -> relaunch -> checkpoint restore -> resume.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if "MXNET_TPU_PROC_ID" in os.environ and __name__ == "__main__":
+    flags = [f for f in os.environ.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append("--xla_force_host_platform_device_count=4")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+
+def main():
+    from jax.sharding import Mesh
+
+    from mxnet_tpu.parallel import init_process_group
+
+    coord = os.environ["MXNET_TPU_COORDINATOR"]
+    nproc = int(os.environ["MXNET_TPU_NUM_PROCS"])
+    pid = int(os.environ["MXNET_TPU_PROC_ID"])
+    attempt = int(os.environ.get("MXNET_TPU_RESTART_COUNT", "0"))
+    init_process_group(coord, nproc, pid)
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint as ck, nd
+    from tests.test_trainstep_checkpoint import (_make_step, TP_RULES,
+                                                 X, Y, _params)
+
+    mesh = Mesh(np.array(jax.devices()).reshape(4, 2), ("data", "model"))
+    step = _make_step(mesh, TP_RULES, seed=11)
+
+    ckdir = os.environ["ELASTIC_CKPT"]
+    start = 0
+    if ck.latest_step(ckdir) is not None:
+        ck.load_checkpoint(ckdir, train_step=step)
+        start = step._t
+        print(f"worker {pid} attempt {attempt}: resumed from step {start}")
+    if attempt >= 1:
+        # the crash happened after step 3 committed; resume must see it
+        assert start >= 3, f"resume lost progress: start={start}"
+
+    for t in range(start + 1, 7):
+        step(nd.array(X), nd.array(Y))
+        ck.save_checkpoint(ckdir, t, train_step=step)
+        if attempt == 0 and t == 3 and pid == 1:
+            time.sleep(2)  # let rank 0 finish committing step 3
+            print("worker 1: simulating mid-training crash")
+            os._exit(13)
+
+    if pid == 0:
+        np.savez(os.environ["ELASTIC_OUT"], **_params(step))
+    print(f"worker {pid} attempt {attempt}: finished at step {step._t}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

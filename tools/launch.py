@@ -130,6 +130,33 @@ def launch_local(num_procs: int, command, coordinator: str | None = None,
     return rc
 
 
+def launch_elastic(num_procs: int, command, max_restarts: int = 0,
+                   coordinator: str | None = None,
+                   timeout: float | None = None):
+    """Restart-based failure recovery (SURVEY §5: the reference
+    ecosystem's answer to worker failure was checkpoint + full-job
+    restart — there is no partial-membership mode in a bulk-synchronous
+    collectives job, so ELASTIC here means: when any worker dies, tear
+    the job down and relaunch ALL workers, which resume from the latest
+    committed checkpoint (``mxnet_tpu.checkpoint`` /
+    ``TrainStep.load_checkpoint``). Each attempt gets a fresh
+    coordinator port; ``MXNET_TPU_RESTART_COUNT`` tells workers which
+    attempt they are."""
+    attempts = max_restarts + 1
+    rc = 0
+    for attempt in range(attempts):
+        os.environ["MXNET_TPU_RESTART_COUNT"] = str(attempt)
+        rc = launch_local(num_procs, command,
+                          coordinator=None if coordinator is None
+                          else coordinator, timeout=timeout)
+        if rc == 0:
+            return 0
+        print(f"launch: attempt {attempt + 1}/{attempts} failed rc={rc}"
+              + ("; restarting from the latest checkpoint"
+                 if attempt + 1 < attempts else "; giving up"))
+    return rc
+
+
 def launch_ssh(hosts, command, coordinator: str | None = None):
     """One process per host via ssh (reference ssh tracker semantics)."""
     num = len(hosts)
@@ -175,6 +202,11 @@ def main(argv=None):
         help="host:port of the jax.distributed coordinator "
         "(default: this host, a free port)",
     )
+    ap.add_argument(
+        "--max-restarts", type=int, default=0,
+        help="relaunch the whole job up to N times when a worker dies "
+        "(workers resume from the latest committed checkpoint)",
+    )
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     command = args.command
@@ -183,7 +215,12 @@ def main(argv=None):
     if not command:
         ap.error("no worker command given")
     if args.launcher == "local":
-        rc = launch_local(args.num_workers, command, args.coordinator)
+        if args.max_restarts > 0:
+            rc = launch_elastic(args.num_workers, command,
+                                max_restarts=args.max_restarts,
+                                coordinator=args.coordinator)
+        else:
+            rc = launch_local(args.num_workers, command, args.coordinator)
     else:
         if not args.hostfile:
             ap.error("--launcher ssh requires --hostfile")
