@@ -1,0 +1,77 @@
+"""Resumable training with sharded checkpoints (round-5).
+
+The flagship pattern: a TrainStep training run checkpoints every step
+through ``CheckpointManager`` (commit-marker protocol, keep-K rolling
+cleanup) and — killed at any point — resumes bit-compatibly: parameters,
+optimizer moments, the device PRNG key and the step counter all restore.
+Multi-host runs write per-process shards (no gather); see
+``tools/launch.py --max-restarts`` for automatic relaunch.
+
+    python examples/resume_training.py --steps 8 --ckpt-dir /tmp/ck
+    # simulate a crash, then run the SAME command again to resume:
+    python examples/resume_training.py --steps 8 --ckpt-dir /tmp/ck \
+        --interrupt-at 4
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--interrupt-at", type=int, default=None,
+                    help="exit (simulating a crash) after this step")
+    ap.add_argument("--keep", type=int, default=3)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint as ck, gluon, nd, optimizer as opt
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import TrainStep
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 8).astype(np.float32)
+    Y = (X @ rng.rand(8, 1).astype(np.float32))
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(32, activation="relu"), nn.Dense(1))
+    net.initialize()
+    net(nd.array(X))
+    step = TrainStep(net, gluon.loss.L2Loss(),
+                     opt.Adam(learning_rate=0.05))
+
+    mgr = ck.CheckpointManager(args.ckpt_dir, keep=args.keep)
+    meta = mgr.restore_latest(train_step=step)
+    start = step._t
+    if meta is not None:
+        print(f"resumed from committed step {meta['step']} "
+              f"(train step counter {start})")
+    else:
+        print("no checkpoint found; starting fresh")
+
+    for t in range(start + 1, args.steps + 1):
+        loss = step(nd.array(X), nd.array(Y))
+        lv = float(loss.asscalar())
+        mgr.save(t, train_step=step)
+        print(f"step {t}: loss {lv:.6f}")
+        if args.interrupt_at is not None and t == args.interrupt_at:
+            print("simulating crash (checkpoint committed; rerun the "
+                  "same command to resume)")
+            raise SystemExit(17)
+
+    print(f"done at step {step._t}: final loss {lv:.6f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -74,3 +74,25 @@ def test_onnx_interchange_example(tmp_path):
 def test_long_context_attention_example():
     out = _run("long_context_attention.py", "--seq", "512")
     assert "long-context attention parity OK" in out
+
+
+def test_resume_training_example(tmp_path):
+    """Crash at step 4, rerun the same command, resume to step 8; the
+    resumed run must pick up the committed step and the loss must keep
+    falling across the interruption."""
+    env = dict(os.environ)
+    r1 = subprocess.run(
+        [sys.executable, os.path.join(_EX, "resume_training.py"),
+         "--steps", "8", "--ckpt-dir", str(tmp_path / "ck"),
+         "--interrupt-at", "4"],
+        capture_output=True, text=True, env=env, timeout=420)
+    assert r1.returncode == 17, r1.stdout[-1500:] + r1.stderr[-1500:]
+    assert "simulating crash" in r1.stdout
+    l1 = [float(m) for m in re.findall(r"loss ([0-9.]+)", r1.stdout)]
+
+    out = _run("resume_training.py", "--steps", "8",
+               "--ckpt-dir", str(tmp_path / "ck"))
+    assert "resumed from committed step 4" in out
+    assert "done at step 8" in out
+    l2 = [float(m) for m in re.findall(r"loss ([0-9.]+)", out)]
+    assert l2[-1] < l1[0] * 0.5, (l1, l2)
