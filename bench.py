@@ -131,5 +131,33 @@ def main():
     }))
 
 
+def _watchdog(seconds=540):
+    """The tunneled chip sometimes becomes UNREACHABLE (observed
+    2026-07-31: even an 8x8 matmul hangs indefinitely); a hang would
+    leave the driver with NO line at all. A daemon THREAD (signal
+    handlers can't preempt a main thread blocked inside the tunnel's C
+    RPC) emits the error JSON and hard-exits if the bench exceeds the
+    budget — good windows finish in ~2-5 minutes including compile."""
+    import os
+    import threading
+
+    def boom():
+        print(json.dumps({
+            "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+            "value": 0.0,
+            "unit": "tokens/sec",
+            "vs_baseline": 0.0,
+            "error": f"watchdog: no result within {seconds}s "
+                     "(tunnel unreachable or pathologically slow)",
+        }), flush=True)
+        os._exit(0)
+
+    t = threading.Timer(seconds, boom)
+    t.daemon = True
+    t.start()
+    return t
+
+
 if __name__ == "__main__":
+    _watchdog()
     main()
