@@ -75,19 +75,35 @@ def _build(batch, seq):
     return step, ids, labels
 
 
+def _telemetry_fields(step_times=None, compile_time_s=None):
+    """step_time_p50/p95, compile_time_s, hbm_peak_bytes — null-safe on
+    CPU and on telemetry import failure (the bench must still print its
+    line)."""
+    try:
+        from benchmarks.common import telemetry_fields
+
+        return telemetry_fields(step_times=step_times,
+                                compile_time_s=compile_time_s)
+    except Exception:  # noqa: BLE001 - schema stays stable regardless
+        return {"step_time_p50": None, "step_time_p95": None,
+                "compile_time_s": compile_time_s, "hbm_peak_bytes": None}
+
+
 def main():
     # import ONCE up front: a structural failure (bad module, registry bug)
     # must surface as itself, not as a re-import artifact from a retry
     try:
         import mxnet_tpu  # noqa: F401
     except Exception as e:  # noqa: BLE001
-        print(json.dumps({
+        row = {
             "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
             "value": 0.0,
             "unit": "tokens/sec",
             "vs_baseline": 0.0,
             "error": f"import failed: {type(e).__name__}: {e}"[:300],
-        }))
+        }
+        row.update(_telemetry_fields())
+        print(json.dumps(row))
         return
     first_err = None
     for attempt_batch in (64, 32, 16):
@@ -95,40 +111,55 @@ def main():
             step, ids, labels = _build(attempt_batch, SEQ)
             # warmup / compile; sync via host transfer — block_until_ready
             # does not actually block on the tunneled TPU backend
+            t0 = time.perf_counter()
             for _ in range(3):
                 loss = step(ids, labels)
             float(loss.asscalar())
+            compile_s = time.perf_counter() - t0
             tokens_per_window = (
                 CALLS_PER_WINDOW * STEPS_PER_CALL * attempt_batch * SEQ
             )
             rates = []
+            step_times = []  # per-optimizer-step wall, from SYNCED windows
             for _ in range(WINDOWS):
                 t0 = time.perf_counter()
                 for _ in range(CALLS_PER_WINDOW):
                     loss = step(ids, labels)
                 float(loss.asscalar())
-                rates.append(tokens_per_window / (time.perf_counter() - t0))
+                elapsed = time.perf_counter() - t0
+                rates.append(tokens_per_window / elapsed)
+                # async dispatch returns immediately, so only the synced
+                # window total is an honest wall figure; per-call splits
+                # would report dispatch latency as step time
+                step_times.append(
+                    elapsed / (CALLS_PER_WINDOW * STEPS_PER_CALL))
             value = statistics.median(rates)
             ceiling = 1.9e5  # BASELINE.md derived 45%-MFU bound (v4)
-            print(json.dumps({
+            row = {
                 "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
                 "value": round(value, 1),
                 "unit": "tokens/sec",
                 "vs_baseline": round(value / ceiling, 4),
                 "best": round(max(rates), 1),
                 "windows": [round(r, 1) for r in rates],
-            }))
+            }
+            row.update(_telemetry_fields(
+                step_times=step_times,
+                compile_time_s=round(compile_s, 3)))
+            print(json.dumps(row))
             return
         except Exception as e:  # noqa: BLE001 - retry smaller batch (OOM)
             if first_err is None:
                 first_err = e
-    print(json.dumps({
+    row = {
         "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
         "value": 0.0,
         "unit": "tokens/sec",
         "vs_baseline": 0.0,
         "error": f"{type(first_err).__name__}: {first_err}"[:300],
-    }))
+    }
+    row.update(_telemetry_fields())
+    print(json.dumps(row))
 
 
 def _watchdog(seconds=540):
@@ -142,15 +173,20 @@ def _watchdog(seconds=540):
     import threading
 
     def boom():
-        print(json.dumps({
+        row = {
             "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
             "value": 0.0,
             "unit": "tokens/sec",
             "vs_baseline": 0.0,
             "error": f"watchdog: no result within {seconds}s "
                      "(tunnel unreachable or pathologically slow)",
-        }), flush=True)
-        os._exit(0)
+        }
+        row.update(_telemetry_fields())
+        print(json.dumps(row), flush=True)
+        # nonzero: the error JSON and the process status must agree — a
+        # hung run exiting 0 recorded tunnel outages as clean runs
+        # (ADVICE round 5, observed in BENCH_r05)
+        os._exit(1)
 
     t = threading.Timer(seconds, boom)
     t.daemon = True
@@ -159,5 +195,7 @@ def _watchdog(seconds=540):
 
 
 if __name__ == "__main__":
-    _watchdog()
+    _timer = _watchdog()
     main()
+    # a legitimately slow-but-successful run must not be shot mid-teardown
+    _timer.cancel()

@@ -7,6 +7,55 @@ import statistics
 import time
 
 
+def _quantile(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    rank = (p / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def telemetry_fields(step_times=None, compile_time_s=None):
+    """Uniform bench-row telemetry columns, null-safe everywhere.
+
+    ``step_time_p50/p95`` come from the measured ``step_times`` (seconds)
+    when the caller timed its own steps, else from the telemetry
+    registry's ``trainer/step_time_s`` histogram (populated when
+    ``MXNET_TELEMETRY=1`` and the workload steps through a Trainer).
+    ``compile_time_s`` falls back to the ``jax.monitoring`` compile-event
+    total; ``hbm_peak_bytes`` is None on backends without memory stats
+    (CPU).
+    """
+    fields = {
+        "step_time_p50": None,
+        "step_time_p95": None,
+        "compile_time_s": compile_time_s,
+        "hbm_peak_bytes": None,
+    }
+    report = None
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        report = _tel.report()
+        fields["hbm_peak_bytes"] = _tel.hbm_peak_bytes()
+    except Exception:  # noqa: BLE001 - telemetry must never kill a bench
+        _tel = None
+    if step_times:
+        s = sorted(step_times)
+        fields["step_time_p50"] = round(_quantile(s, 50), 6)
+        fields["step_time_p95"] = round(_quantile(s, 95), 6)
+    elif report is not None:
+        fields["step_time_p50"] = report.get("step_time_p50")
+        fields["step_time_p95"] = report.get("step_time_p95")
+    if fields["compile_time_s"] is None and report is not None:
+        fields["compile_time_s"] = report.get("compile_time_s")
+    return fields
+
+
 def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
               warmup=3, steps=20, windows=4):
     """Time ``step_fn`` and print the driver JSON line.
@@ -17,37 +66,52 @@ def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
     the MEDIAN window rate is the metric of record (the honest central
     figure), with the best window and the full list reported alongside
     (a best-only figure selects favorable noise; advisor round-2 finding).
+
+    Every row also carries ``step_time_p50/p95`` (per-step wall from the
+    timed windows), ``compile_time_s`` (warmup+compile wall) and
+    ``hbm_peak_bytes`` (None on CPU) — the telemetry columns the perf
+    roadmap diagnoses from.
     """
     try:
+        t0 = time.perf_counter()
         for _ in range(warmup):
             out = step_fn()
         sync_fn(out)
+        compile_s = time.perf_counter() - t0
         per = max(1, steps // windows)
         rates = []
+        step_times = []
         for _ in range(windows):
             t0 = time.perf_counter()
             for _ in range(per):
                 out = step_fn()
             sync_fn(out)
-            rates.append(per * items_per_step / (time.perf_counter() - t0))
+            elapsed = time.perf_counter() - t0
+            rates.append(per * items_per_step / elapsed)
+            step_times.append(elapsed / per)
         value = statistics.median(rates)
-        print(json.dumps({
+        row = {
             "metric": metric,
             "value": round(value, 1),
             "unit": unit,
             "vs_baseline": round(value / ceiling, 4),
             "best": round(max(rates), 1),
             "windows": [round(r, 1) for r in rates],
-        }))
+        }
+        row.update(telemetry_fields(step_times=step_times,
+                                    compile_time_s=round(compile_s, 3)))
+        print(json.dumps(row))
         return value
     except Exception as e:  # noqa: BLE001 - driver wants a line either way
-        print(json.dumps({
+        row = {
             "metric": metric,
             "value": 0.0,
             "unit": unit,
             "vs_baseline": 0.0,
             "error": f"{type(e).__name__}: {e}"[:300],
-        }))
+        }
+        row.update(telemetry_fields())
+        print(json.dumps(row))
         return 0.0
 
 

@@ -68,6 +68,7 @@ for _mod, _aliases in [
     ("recordio", ()),
     ("io", ()),
     ("image", ()),
+    ("telemetry", ()),
     ("profiler", ()),
     ("amp", ()),
     ("runtime", ()),

@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as _np
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
 
@@ -35,6 +36,14 @@ def save_checkpoint(directory, step, net=None, trainer=None, extra=None,
     process 0 only (replicated by construction on those paths). Safe to
     call from every process.
     """
+    with (_tel.span("checkpoint.save", {"step": int(step)})
+          if _tel._ENABLED else _tel.NULL_SPAN):
+        return _save_checkpoint(directory, step, net, trainer, extra,
+                                train_step)
+
+
+def _save_checkpoint(directory, step, net=None, trainer=None, extra=None,
+                     train_step=None):
     path = os.path.join(directory, f"step_{step}")
     os.makedirs(path, exist_ok=True)
     if train_step is not None:
@@ -72,6 +81,7 @@ def save_checkpoint(directory, step, net=None, trainer=None, extra=None,
     # commit marker last: partial checkpoints are never loaded
     with open(os.path.join(path, "COMMITTED"), "w") as f:
         f.write("ok")
+    _tel.instant("checkpoint.commit", {"step": int(step), "path": path})
     return path
 
 
@@ -111,6 +121,14 @@ def latest_step(directory) -> Optional[int]:
 def load_checkpoint(directory, step=None, net=None, trainer=None,
                     train_step=None):
     """Load the given (or latest committed) checkpoint; returns metadata."""
+    with (_tel.span("checkpoint.restore",
+                    {"step": -1 if step is None else int(step)})
+          if _tel._ENABLED else _tel.NULL_SPAN):
+        return _load_checkpoint(directory, step, net, trainer, train_step)
+
+
+def _load_checkpoint(directory, step=None, net=None, trainer=None,
+                     train_step=None):
     if step is None:
         step = latest_step(directory)
         if step is None:

@@ -32,6 +32,7 @@ from typing import Callable, Dict, Optional, Union
 import jax
 import numpy as _np
 
+from . import telemetry as _tel
 from .base import MXNetError
 
 __all__ = ["save_sharded", "load_sharded", "is_committed"]
@@ -60,6 +61,26 @@ def save_sharded(directory: str, arrays: Dict[str, jax.Array],
     os.makedirs(directory, exist_ok=True)
     proc = jax.process_index()
     nproc = jax.process_count()
+    # a re-save into a crashed attempt's directory (elastic restart)
+    # must not let is_committed() satisfy on the DEAD attempt's markers
+    # while this attempt is still writing: each process retracts its own
+    # commit FIRST (process 0 also retracts the meta the committed check
+    # reads), so only markers from the current attempt can commit
+    try:
+        os.unlink(os.path.join(directory, f"DONE.p{proc}"))
+    except FileNotFoundError:
+        pass
+    if proc == 0:
+        try:
+            os.unlink(os.path.join(directory, "ckpt_meta.json"))
+        except FileNotFoundError:
+            pass
+    with (_tel.span("checkpoint.save_sharded", {"process": proc})
+          if _tel._ENABLED else _tel.NULL_SPAN):
+        return _save_sharded_impl(directory, arrays, extra, proc, nproc)
+
+
+def _save_sharded_impl(directory, arrays, extra, proc, nproc):
     pieces = {}  # npz key -> numpy data
     index = []  # [{name, key, bounds}]
     for name, a in arrays.items():
@@ -94,6 +115,8 @@ def save_sharded(directory: str, arrays: Dict[str, jax.Array],
     # commit marker LAST: a partially-written process never commits
     with open(os.path.join(directory, f"DONE.p{proc}"), "w") as f:
         f.write("ok")
+    _tel.instant("checkpoint.shard_commit",
+                 {"process": proc, "path": directory})
     return directory
 
 
@@ -142,6 +165,12 @@ def load_sharded(
     at save time — each addressable shard's global slice is assembled
     from whichever saved pieces overlap it.
     """
+    with (_tel.span("checkpoint.load_sharded")
+          if _tel._ENABLED else _tel.NULL_SPAN):
+        return _load_sharded_impl(directory, shardings)
+
+
+def _load_sharded_impl(directory, shardings=None):
     if not is_committed(directory):
         raise MXNetError(
             f"sharded checkpoint {directory} is not committed "

@@ -15,6 +15,7 @@ import time
 from typing import List, Optional, Sequence
 
 from ... import autograd, metric as _metric
+from ... import telemetry as _tel
 from ...base import MXNetError
 from ...ndarray.ndarray import NDArray
 from ..trainer import Trainer
@@ -314,26 +315,37 @@ class Estimator:
         self.stop_training = False
 
         _dispatch(handlers, "train_begin", self)
+        epoch = 0
         while not self.stop_training:
-            _dispatch(handlers, "epoch_begin", self)
-            self.train_loss_metric.reset()
-            for batch in train_data:
-                data, label = _split_batch(batch)
-                _dispatch(handlers, "batch_begin", self, batch=batch)
-                with autograd.record():
-                    pred = self.net(data)
-                    L = self.loss(pred, label)
-                L.backward()
-                self.trainer.step(_batch_size(batch))
-                self.train_loss_metric.update(0, L)
-                _dispatch(handlers, "batch_end", self, batch=batch,
-                          pred=pred, label=label, loss=L)
-                self.stop_training = self.stop_training or any(
-                    getattr(h, "stop_training", False) for h in handlers
-                )
-                if self.stop_training:
-                    break
-            _dispatch(handlers, "epoch_end", self)
+            with (_tel.span("estimator.epoch", {"epoch": epoch})
+                  if _tel._ENABLED else _tel.NULL_SPAN):
+                _dispatch(handlers, "epoch_begin", self)
+                self.train_loss_metric.reset()
+                for batch in train_data:
+                    data, label = _split_batch(batch)
+                    _dispatch(handlers, "batch_begin", self, batch=batch)
+                    if _tel._ENABLED:
+                        with _tel.span("estimator.forward_backward"):
+                            with autograd.record():
+                                pred = self.net(data)
+                                L = self.loss(pred, label)
+                            L.backward()
+                    else:
+                        with autograd.record():
+                            pred = self.net(data)
+                            L = self.loss(pred, label)
+                        L.backward()
+                    self.trainer.step(_batch_size(batch))
+                    self.train_loss_metric.update(0, L)
+                    _dispatch(handlers, "batch_end", self, batch=batch,
+                              pred=pred, label=label, loss=L)
+                    self.stop_training = self.stop_training or any(
+                        getattr(h, "stop_training", False) for h in handlers
+                    )
+                    if self.stop_training:
+                        break
+                _dispatch(handlers, "epoch_end", self)
+            epoch += 1
             self.stop_training = self.stop_training or any(
                 getattr(h, "stop_training", False) for h in handlers
             )

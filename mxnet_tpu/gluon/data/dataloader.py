@@ -33,6 +33,7 @@ from multiprocessing import shared_memory as _shm
 
 import numpy as _np
 
+from ... import telemetry as _tel
 from ...ndarray.ndarray import NDArray
 from ...ndarray import array as nd_array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
@@ -272,7 +273,13 @@ class DataLoader:
     def __iter__(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
-                yield self._maybe_pin(self._load(indices))
+                if _tel._ENABLED:
+                    with _tel.span("dataloader.load",
+                                   {"batch": len(indices)}):
+                        batch = self._load(indices)
+                else:
+                    batch = self._load(indices)
+                yield self._maybe_pin(batch)
             return
         if self._thread_pool:
             yield from self._iter_threaded()
@@ -316,7 +323,14 @@ class DataLoader:
                     fut = futures.get()
                     if fut is None:
                         break
-                    yield self._maybe_pin(fut.result(timeout=self._timeout))
+                    if _tel._ENABLED:
+                        # time only the consumer-side wait: worker compute
+                        # already overlaps; the wait IS the input stall
+                        with _tel.span("dataloader.wait"):
+                            batch = fut.result(timeout=self._timeout)
+                    else:
+                        batch = fut.result(timeout=self._timeout)
+                    yield self._maybe_pin(batch)
             finally:
                 stop.set()
 
@@ -458,28 +472,35 @@ class DataLoader:
         for want_i in range(len(batches)):
             want = base + want_i
             deadline = _time.monotonic() + self._timeout
-            while want not in pending:
-                try:
-                    bid, status, payload = data_q.get(timeout=1.0)
-                except queue.Empty:
-                    dead = [i for i, p in enumerate(workers)
-                            if not p.is_alive()]
-                    if dead:
-                        codes = [workers[i].exitcode for i in dead]
-                        raise RuntimeError(
-                            f"DataLoader worker(s) {dead} died "
-                            f"(exitcode {codes}); restart the iterator"
-                        )
-                    if _time.monotonic() > deadline:
-                        raise RuntimeError(
-                            f"DataLoader batch {want} timed out after "
-                            f"{self._timeout}s (workers alive but stuck)"
-                        )
-                    continue
-                if status == "err":
-                    raise pickle.loads(payload)
-                pending[bid] = _unpack(payload)
+            with (_tel.span("dataloader.wait") if _tel._ENABLED
+                  else _tel.NULL_SPAN):
+                self._mp_wait(want, pending, workers, data_q, deadline)
             if next_submit < len(batches):
                 index_q.put((base + next_submit, batches[next_submit]))
                 next_submit += 1
             yield self._maybe_pin(_to_device(pending.pop(want)))
+
+    def _mp_wait(self, want, pending, workers, data_q, deadline):
+        import time as _time
+
+        while want not in pending:
+            try:
+                bid, status, payload = data_q.get(timeout=1.0)
+            except queue.Empty:
+                dead = [i for i, p in enumerate(workers)
+                        if not p.is_alive()]
+                if dead:
+                    codes = [workers[i].exitcode for i in dead]
+                    raise RuntimeError(
+                        f"DataLoader worker(s) {dead} died "
+                        f"(exitcode {codes}); restart the iterator"
+                    )
+                if _time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"DataLoader batch {want} timed out after "
+                        f"{self._timeout}s (workers alive but stuck)"
+                    )
+                continue
+            if status == "err":
+                raise pickle.loads(payload)
+            pending[bid] = _unpack(payload)

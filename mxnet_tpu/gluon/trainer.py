@@ -11,6 +11,7 @@ step needs no Trainer-level sync at all (the collective is compiled in).
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import Optional
 
 import numpy as _np
@@ -18,6 +19,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from .. import optimizer as opt
+from .. import telemetry as _tel
 from ..kvstore import KVStore as _KV
 from ..ndarray.ndarray import NDArray
 from .parameter import Parameter, ParameterDict
@@ -149,12 +151,27 @@ class Trainer:
 
     # ---------------------------------------------------------------- steps
     def step(self, batch_size, ignore_stale_grad=False):
-        """Rescale by 1/batch_size, sync grads, apply optimizer update."""
-        if not self._kv_initialized:
-            self._init_kvstore()
-        self._optimizer.rescale_grad = self._scale / batch_size
-        self._allreduce_grads()
-        self._update(ignore_stale_grad)
+        """Rescale by 1/batch_size, sync grads, apply optimizer update.
+
+        Disabled-telemetry overhead is the single ``_tel._ENABLED`` flag
+        check — no span or metric objects exist on that path."""
+        if not _tel._ENABLED:
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._optimizer.rescale_grad = self._scale / batch_size
+            self._allreduce_grads()
+            self._update(ignore_stale_grad)
+            return
+        t0 = _time.perf_counter()
+        with _tel.span("trainer.step", {"batch_size": int(batch_size)}):
+            if not self._kv_initialized:
+                self._init_kvstore()
+            self._optimizer.rescale_grad = self._scale / batch_size
+            with _tel.span("trainer.allreduce_grads"):
+                self._allreduce_grads()
+            with _tel.span("trainer.update"):
+                self._update(ignore_stale_grad)
+        _tel.record_step(int(batch_size), _time.perf_counter() - t0)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -164,7 +181,11 @@ class Trainer:
                 "allreduce_grads() when parameters are updated on kvstore "
                 "is not supported"
             )
-        self._allreduce_grads()
+        if _tel._ENABLED:
+            with _tel.span("trainer.allreduce_grads"):
+                self._allreduce_grads()
+        else:
+            self._allreduce_grads()
 
     def _allreduce_grads(self):
         if self._kvstore is None or self._kvstore.num_workers == 1:
@@ -190,7 +211,11 @@ class Trainer:
                 "supported; call step() instead"
             )
         self._optimizer.rescale_grad = self._scale / batch_size
-        self._update(ignore_stale_grad)
+        if _tel._ENABLED:
+            with _tel.span("trainer.update"):
+                self._update(ignore_stale_grad)
+        else:
+            self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
         updater = self._updaters[0]

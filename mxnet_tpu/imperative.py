@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional, Sequence
 import numpy as _np
 from jax.core import Tracer as _Tracer
 
+from . import telemetry as _tel
 from .base import MXNetError
 from .engine import engine
 from .ndarray.ndarray import NDArray, _Pending
@@ -284,7 +285,12 @@ class _BulkQueue:
                         and d.error is None and d.queue is not self:
                     d.queue.flush()
         with self._lock:
-            self._flush_locked()
+            if _tel._ENABLED and self.entries:
+                with _tel.span("imperative.bulk_flush",
+                               {"ops": len(self.entries)}):
+                    self._flush_locked()
+            else:
+                self._flush_locked()
 
     def _flush_locked(self):
         entries, self.entries = self.entries, []

@@ -19,8 +19,24 @@ import jax.numpy as jnp
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import optimizer as opt
+from .. import telemetry as _tel
 
 __all__ = ["KVStoreBase", "KVStore", "create"]
+
+
+def _nbytes(values) -> int:
+    """Logical payload bytes of a push/pull value tree (telemetry only —
+    called exclusively on the enabled path)."""
+    total = 0
+    stack = [values]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+        else:
+            data = getattr(v, "data", v)
+            total += int(getattr(data, "nbytes", 0) or 0)
+    return total
 
 
 class KVStoreBase:
@@ -76,6 +92,13 @@ class KVStore(KVStoreBase):
 
     # ---------------------------------------------------------------- API
     def init(self, key, value):
+        if _tel._ENABLED:
+            with _tel.span("kvstore.init"):
+                self._init_impl(key, value)
+        else:
+            self._init_impl(key, value)
+
+    def _init_impl(self, key, value):
         keys, values = _as_list(key), _as_list(value)
         for k, v in zip(keys, values):
             k = str(k)
@@ -84,6 +107,30 @@ class KVStore(KVStoreBase):
             self._data[k] = NDArray(jnp.array(v.data))
 
     def push(self, key, value, priority=0):
+        """Telemetry seam: span + bytes/latency metrics around the
+        subclass-specific ``_push_impl`` (dist overrides the impl, not
+        the wrapper, so both stores share the instrumentation)."""
+        if not _tel._ENABLED:
+            self._push_impl(key, value, priority)
+            return
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with _tel.span("kvstore.push", {"type": self._type}):
+            self._push_impl(key, value, priority)
+        reg = _tel.registry()
+        reg.histogram("kvstore/push_time_s").observe(
+            _time.perf_counter() - t0)
+        reg.counter("kvstore/push_bytes").inc(_nbytes(value))
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        if not _tel._ENABLED:
+            self._pull_impl(key, out, priority, ignore_sparse)
+            return
+        with _tel.span("kvstore.pull", {"type": self._type}):
+            self._pull_impl(key, out, priority, ignore_sparse)
+
+    def _push_impl(self, key, value, priority=0):
         keys = _as_list(key)
         for k, vals in zip(keys, self._grouped(keys, value)):
             k = str(k)
@@ -107,7 +154,7 @@ class KVStore(KVStoreBase):
             else:
                 self._data[k]._rebind(agg)
 
-    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+    def _pull_impl(self, key, out=None, priority=0, ignore_sparse=True):
         keys = _as_list(key)
         outs = self._grouped(keys, out)
         for k, dsts in zip(keys, outs):

@@ -19,6 +19,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from .. import telemetry as _tel
 from .kvstore import KVStore, KVStoreBase
 
 __all__ = ["KVStoreDist"]
@@ -80,7 +81,7 @@ class KVStoreDist(KVStore):
     def num_workers(self):
         return self._num_workers
 
-    def push(self, key, value, priority=0):
+    def _push_impl(self, key, value, priority=0):
         keys = _l(key)
         for k, vals in zip(keys, self._grouped(keys, value)):
             k = str(k)
@@ -163,6 +164,9 @@ class KVStoreDist(KVStore):
         gathered = multihost_utils.process_allgather(packed)  # (W, bytes)
         # bookkeeping for tests/telemetry: logical wire bytes this push
         self.last_push_wire_bytes = int(gathered.shape[-1])
+        if _tel._ENABLED:
+            _tel.registry().counter("kvstore/allreduce_wire_bytes").inc(
+                self.last_push_wire_bytes)
         total = None
         for w in range(gathered.shape[0]):
             dq = unpack_2bit(gathered[w], n, comp.threshold, agg.dtype)
@@ -176,7 +180,20 @@ class KVStoreDist(KVStore):
         # contributes its replica; result is identical on every host
         from ..parallel import all_reduce_eager
 
-        return all_reduce_eager(arr)
+        if not _tel._ENABLED:
+            return all_reduce_eager(arr)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with _tel.span("kvstore.allreduce",
+                       {"bytes": int(getattr(arr, "nbytes", 0) or 0)}):
+            out = all_reduce_eager(arr)
+        reg = _tel.registry()
+        reg.histogram("kvstore/allreduce_time_s").observe(
+            _time.perf_counter() - t0)
+        reg.counter("kvstore/allreduce_bytes").inc(
+            int(getattr(arr, "nbytes", 0) or 0))
+        return out
 
     def barrier(self):
         super().barrier()

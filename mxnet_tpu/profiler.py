@@ -6,13 +6,19 @@ JSON. On TPU the equivalent telemetry comes from XLA's profiler (XProf):
 ``jax.profiler`` emits a trace viewable in TensorBoard/Perfetto covering
 compiled-program timelines, HBM usage, and per-op device time. This module
 keeps the reference's API shape (set_config/start/stop/dump + scopes) over
-that machinery, plus host-side aggregate per-call stats for eager ops.
+that machinery.
+
+Host-side aggregate per-call stats live in the ``mx.telemetry`` metrics
+registry (the ``op/`` histogram family) — ONE telemetry spine: Scopes feed
+the same registry the trainer/kvstore/dataloader instrumentation uses, so
+``mx.telemetry.report()`` and ``profiler.dumps()`` read consistent data,
+and ``profiler.dump()`` merges the registry aggregates with any buffered
+telemetry spans into one Chrome trace.
 """
 
 from __future__ import annotations
 
 import atexit
-import collections
 import json
 import os
 import threading
@@ -21,6 +27,7 @@ from typing import Dict, Optional
 
 import jax
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = [
@@ -50,8 +57,8 @@ _CONFIG = {
     "aggregate_stats": False,
 }
 _STATE = {"running": False, "dir": None}
-_AGG = collections.defaultdict(lambda: [0, 0.0])  # name -> [count, total_s]
 _LOCK = threading.Lock()
+_OP_PREFIX = "op/"  # registry family holding per-op aggregate stats
 
 
 def set_config(**kwargs):
@@ -103,48 +110,59 @@ def resume(profile_process="worker"):
 
 
 def record_host_op(name: str, seconds: float):
-    """Hook used by the imperative layer when aggregate stats are enabled."""
-    with _LOCK:
-        entry = _AGG[name]
-        entry[0] += 1
-        entry[1] += seconds
+    """Hook used by the imperative layer when aggregate stats are enabled.
+
+    Rebased onto the telemetry registry: each op is a rolling histogram
+    under ``op/{name}`` (cumulative count/sum preserved), so the same
+    spine serves ``dumps()``, ``mx.telemetry.report()`` and the bench
+    schema."""
+    _telemetry.registry().histogram(_OP_PREFIX + name).observe(seconds)
+
+
+def _op_rows():
+    """(name, count, total_s) rows from the registry's op/ family."""
+    hists = _telemetry.registry().histograms_with_prefix(_OP_PREFIX)
+    return [(name[len(_OP_PREFIX):], h.count, h.sum)
+            for name, h in hists.items()]
 
 
 def dumps(reset=False) -> str:
     """Aggregate per-op stats table (reference: ``mx.profiler.dumps``)."""
-    with _LOCK:
-        rows = sorted(_AGG.items(), key=lambda kv: -kv[1][1])
-        lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(us)':>10}"]
-        for name, (count, total) in rows:
-            lines.append(
-                f"{name:<40}{count:>8}{total * 1e3:>12.2f}"
-                f"{total / max(count, 1) * 1e6:>10.1f}"
-            )
-        if reset:
-            _AGG.clear()
+    rows = sorted(_op_rows(), key=lambda r: -r[2])
+    lines = [f"{'Name':<40}{'Calls':>8}{'Total(ms)':>12}{'Avg(us)':>10}"]
+    for name, count, total in rows:
+        lines.append(
+            f"{name:<40}{count:>8}{total * 1e3:>12.2f}"
+            f"{total / max(count, 1) * 1e6:>10.1f}"
+        )
+    if reset:
+        _telemetry.registry().clear(prefix=_OP_PREFIX)
     return "\n".join(lines)
 
 
 def dump(finished=True, profile_process="worker"):
-    """Write host-side aggregate stats as Chrome-trace JSON; the XProf trace
-    directory (if any) sits next to it for TensorBoard."""
+    """Write host-side aggregate stats (plus any buffered telemetry spans)
+    as ONE Chrome-trace JSON; the XProf trace directory (if any) sits next
+    to it for TensorBoard."""
     stop()
     events = []
     ts = 0
-    with _LOCK:
-        for name, (count, total) in _AGG.items():
-            events.append(
-                {
-                    "name": name,
-                    "ph": "X",
-                    "ts": ts,
-                    "dur": total * 1e6,
-                    "pid": 0,
-                    "tid": 0,
-                    "args": {"calls": count},
-                }
-            )
-            ts += total * 1e6
+    for name, count, total in _op_rows():
+        events.append(
+            {
+                "name": name,
+                "ph": "X",
+                "ts": ts,
+                "dur": total * 1e6,
+                "pid": 0,
+                "tid": 0,
+                "args": {"calls": count},
+            }
+        )
+        ts += total * 1e6
+    log = _telemetry._LOG
+    if log is not None:
+        events.extend(log.chrome_events())
     with open(_CONFIG["filename"], "w") as f:
         json.dump({"traceEvents": events}, f)
 

@@ -1,0 +1,299 @@
+"""Unified training telemetry: step metrics, trace events, watchdog.
+
+One spine for "why was this step slow?" and "is the run alive?":
+
+- ``events``   — structured spans (Chrome-trace ``.json`` + append-only
+  JSONL), thread-safe nesting, zero overhead when disabled.
+- ``metrics``  — process-global registry (counters/gauges/rolling
+  histograms): per-step wall time, samples/sec, JAX compile events
+  (``jax.monitoring``), device memory, kvstore allreduce bytes/latency,
+  and ``profiler.py``'s per-op aggregates (``op/`` family).
+- ``watchdog`` — heartbeat file + stalled-step detection with thread
+  stack dumps, nonzero exit on hard hangs.
+
+Usage::
+
+    import mxnet_tpu as mx
+    mx.telemetry.enable()            # or MXNET_TELEMETRY=1 in the env
+    ... train ...
+    print(mx.telemetry.report())     # step-time p50/p95, samples/sec, ...
+    mx.telemetry.dump()              # chrome://tracing-loadable trace.json
+
+Env knobs: ``MXNET_TELEMETRY=1`` enables at import;
+``MXNET_TELEMETRY_DIR`` sets the output directory (default
+``./telemetry``); ``MXNET_TELEMETRY_WATCHDOG=1`` starts the watchdog on
+enable; ``MXNET_TELEMETRY_HARD_TIMEOUT_S`` arms the hard-hang exit.
+
+Hot paths gate on the module flag (``telemetry._ENABLED`` via
+``enabled()``) so a disabled build pays a single flag check per step —
+no span or metric objects are allocated.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional
+
+from .events import EventLog, NULL_SPAN
+from .metrics import Counter, Gauge, Histogram, Registry
+from .watchdog import Watchdog
+
+__all__ = [
+    "enable", "disable", "enabled", "span", "instant", "registry",
+    "report", "dump", "record_step", "start_watchdog", "stop_watchdog",
+    "hbm_peak_bytes", "device_memory_stats", "Registry", "Counter",
+    "Gauge", "Histogram", "Watchdog", "EventLog", "NULL_SPAN",
+]
+
+# module-level fast flag: hot paths read `telemetry._ENABLED` directly —
+# the whole disabled-mode cost is that one attribute load + branch
+_ENABLED = False
+_LOG: Optional[EventLog] = None
+_REGISTRY = Registry()
+_WATCHDOG: Optional[Watchdog] = None
+_LOCK = threading.RLock()
+_JAX_LISTENER_INSTALLED = False
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def registry() -> Registry:
+    """The process-global metrics registry (usable even when event
+    emission is disabled — metric objects are cheap and always live)."""
+    return _REGISTRY
+
+
+def default_dir() -> str:
+    return os.environ.get("MXNET_TELEMETRY_DIR", "telemetry")
+
+
+# ------------------------------------------------------------------ enable
+def enable(directory: Optional[str] = None, watchdog: Optional[bool] = None,
+           **watchdog_kwargs):
+    """Turn on span emission (+ optionally the watchdog); idempotent.
+
+    ``watchdog=None`` defers to ``MXNET_TELEMETRY_WATCHDOG``.
+    """
+    global _ENABLED, _LOG, _WATCHDOG
+    with _LOCK:
+        if _LOG is None:
+            _LOG = EventLog(directory or default_dir())
+        _ENABLED = True
+        _install_jax_compile_listener()
+        if watchdog is None:
+            watchdog = os.environ.get(
+                "MXNET_TELEMETRY_WATCHDOG", "0") not in ("0", "", "false")
+        if watchdog and _WATCHDOG is None:
+            start_watchdog(**watchdog_kwargs)
+    return _LOG
+
+
+def disable():
+    """Stop emitting; buffered events stay dumpable via ``dump()``."""
+    global _ENABLED, _WATCHDOG
+    with _LOCK:
+        _ENABLED = False
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
+            _WATCHDOG = None
+
+
+def reset():
+    """Full teardown (tests): drop the log, registry contents, watchdog."""
+    global _ENABLED, _LOG
+    with _LOCK:
+        disable()
+        if _LOG is not None:
+            _LOG.close()
+            _LOG = None
+        _REGISTRY.clear()
+
+
+# ------------------------------------------------------------------- spans
+def span(name: str, args: Optional[dict] = None):
+    """Context manager emitting one Chrome-trace span; a shared no-op
+    singleton when disabled (no allocation)."""
+    log = _LOG
+    if not _ENABLED or log is None:
+        return NULL_SPAN
+    return log.span(name, args)
+
+
+def instant(name: str, args: Optional[dict] = None):
+    log = _LOG
+    if _ENABLED and log is not None:
+        log.instant(name, args)
+
+
+# ------------------------------------------------------------------- steps
+def record_step(samples: int, seconds: float):
+    """Record one completed optimizer step: wall time + throughput
+    accounting, and watchdog progress. Called by ``Trainer.step`` (only
+    when telemetry is enabled) and available to custom loops."""
+    _REGISTRY.histogram("trainer/step_time_s").observe(seconds)
+    _REGISTRY.counter("trainer/steps").inc()
+    _REGISTRY.counter("trainer/samples").inc(samples)
+    wd = _WATCHDOG
+    if wd is not None:
+        wd.notify_step(seconds=seconds)
+    _update_memory_gauges()
+
+
+def _update_memory_gauges():
+    peak = hbm_peak_bytes()
+    if peak is not None:
+        _REGISTRY.gauge("device/hbm_peak_bytes").max(peak)
+
+
+# ---------------------------------------------------------------- watchdog
+def start_watchdog(directory: Optional[str] = None, interval: float = 5.0,
+                   stall_factor: float = 10.0, min_stall_s: float = 30.0,
+                   hard_timeout_s: Optional[float] = None,
+                   **kwargs) -> Watchdog:
+    global _WATCHDOG
+    with _LOCK:
+        if _WATCHDOG is not None:
+            return _WATCHDOG
+        if hard_timeout_s is None:
+            env = os.environ.get("MXNET_TELEMETRY_HARD_TIMEOUT_S")
+            hard_timeout_s = float(env) if env else None
+        _WATCHDOG = Watchdog(
+            directory or (_LOG.directory if _LOG else default_dir()),
+            interval=interval, stall_factor=stall_factor,
+            min_stall_s=min_stall_s, hard_timeout_s=hard_timeout_s,
+            **kwargs)
+        _WATCHDOG.start()
+        return _WATCHDOG
+
+
+def stop_watchdog():
+    global _WATCHDOG
+    with _LOCK:
+        if _WATCHDOG is not None:
+            _WATCHDOG.stop()
+            _WATCHDOG = None
+
+
+def watchdog() -> Optional[Watchdog]:
+    return _WATCHDOG
+
+
+def _on_watchdog_stall(state: dict):
+    """Watchdog -> telemetry bridge: count the stall and mark it in the
+    trace so the gap is visible next to the last completed span."""
+    _REGISTRY.counter("watchdog/stalls").inc()
+    instant("watchdog.stall", {
+        "step": state.get("step"),
+        "idle_s": state.get("idle_s"),
+        "stacks": state.get("stacks"),
+    })
+
+
+# ---------------------------------------------------------- device memory
+def device_memory_stats():
+    """Per-device ``memory_stats()`` dicts; empty list when the backend
+    exposes none (CPU)."""
+    try:
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            try:
+                ms = d.memory_stats()
+            except Exception:  # noqa: BLE001 - backend-dependent
+                ms = None
+            if ms:
+                out.append({"device": str(d), **ms})
+        return out
+    except Exception:  # noqa: BLE001 - jax not importable in odd envs
+        return []
+
+
+def hbm_peak_bytes() -> Optional[int]:
+    """Max peak-bytes-in-use over local devices; None on backends without
+    memory stats (CPU) — null-safe by construction."""
+    stats = device_memory_stats()
+    peaks = [s.get("peak_bytes_in_use") for s in stats
+             if s.get("peak_bytes_in_use") is not None]
+    return max(peaks) if peaks else None
+
+
+# ------------------------------------------------------------ jax compile
+def _install_jax_compile_listener():
+    """Route ``jax.monitoring`` duration events (jit tracing/compilation)
+    into the registry. Listener registration is append-only in jax, so
+    the callback itself checks the ENABLED flag."""
+    global _JAX_LISTENER_INSTALLED
+    if _JAX_LISTENER_INSTALLED:
+        return
+    try:
+        from jax import monitoring as _mon
+
+        def _on_duration(event, duration, **kwargs):
+            if not _ENABLED:
+                return
+            key = event.strip("/").replace("/", "_")
+            _REGISTRY.histogram(f"jax/{key}").observe(duration)
+            if "compil" in event or "backend_compile" in event:
+                _REGISTRY.histogram("jax/compile_time_s").observe(duration)
+
+        _mon.register_event_duration_secs_listener(_on_duration)
+        _JAX_LISTENER_INSTALLED = True
+    except Exception:  # noqa: BLE001 - jax without monitoring
+        _JAX_LISTENER_INSTALLED = True  # don't retry every enable()
+
+
+# ------------------------------------------------------------------ report
+def report() -> dict:
+    """One-call run summary: step-time percentiles, throughput, compile
+    time, HBM high-water mark, plus the full registry snapshot."""
+    _update_memory_gauges()
+    snap = _REGISTRY.snapshot()
+    step_hist = snap["histograms"].get("trainer/step_time_s")
+    compile_hist = snap["histograms"].get("jax/compile_time_s")
+    samples = snap["counters"].get("trainer/samples", 0)
+    step_sum = step_hist["sum"] if step_hist else 0.0
+    return {
+        "enabled": _ENABLED,
+        "steps": snap["counters"].get("trainer/steps", 0),
+        "step_time_p50": step_hist["p50"] if step_hist else None,
+        "step_time_p95": step_hist["p95"] if step_hist else None,
+        "step_time_p99": step_hist["p99"] if step_hist else None,
+        "samples_per_sec": (samples / step_sum) if step_sum > 0 else None,
+        "compile_time_s": compile_hist["sum"] if compile_hist else None,
+        "hbm_peak_bytes": snap["gauges"].get("device/hbm_peak_bytes"),
+        "watchdog_stalls": snap["counters"].get("watchdog/stalls", 0),
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+    }
+
+
+def dump(path: Optional[str] = None) -> Optional[str]:
+    """Write the Chrome-trace JSON (plus a ``report.json`` snapshot next
+    to it); returns the trace path, or None if never enabled."""
+    log = _LOG
+    if log is None:
+        return None
+    trace_path = log.dump(path)
+    try:
+        import json as _json
+
+        with open(os.path.join(log.directory, "report.json"), "w") as f:
+            _json.dump(report(), f, indent=2, default=str)
+    except OSError:
+        pass
+    return trace_path
+
+
+def jsonl_path() -> Optional[str]:
+    return _LOG.jsonl_path if _LOG is not None else None
+
+
+# auto-enable from the environment (MXNET_TELEMETRY=1 / true / yes)
+if os.environ.get("MXNET_TELEMETRY", "0").lower() not in ("0", "", "false",
+                                                          "no"):
+    enable()

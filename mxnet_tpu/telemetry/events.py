@@ -1,0 +1,196 @@
+"""Structured trace events: Chrome-trace spans + append-only JSONL.
+
+The reference instrumented every engine push and dumped Chrome-trace JSON
+(``src/profiler/profiler.cc`` [unverified]); this module is that spine for
+the TPU build's HOST side (the device timeline stays XProf's, see
+``profiler.py``). Every span is one Chrome complete event (``ph: "X"``)
+keyed by pid/tid, so nesting renders correctly in Perfetto/chrome://tracing
+by ts/dur containment; a thread-local stack additionally stamps each record
+with its ``depth`` and ``parent`` so the JSONL stream is self-describing
+without a viewer.
+
+Zero-overhead contract: when telemetry is disabled, ``span()`` returns a
+shared no-op singleton — no per-call allocation — and hot paths that cannot
+afford even that function call read the module flag directly
+(``telemetry._ENABLED``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+__all__ = ["EventLog", "span", "instant"]
+
+# monotonic origin for Chrome-trace timestamps (microseconds since process
+# telemetry init; Chrome traces only need a consistent origin per file)
+_T0 = time.perf_counter()
+
+
+def _now_us() -> float:
+    return (time.perf_counter() - _T0) * 1e6
+
+
+class _NullSpan:
+    """Shared disabled-mode span: one module-level instance, no state."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+_TLS = threading.local()
+
+
+def _stack():
+    s = getattr(_TLS, "stack", None)
+    if s is None:
+        s = _TLS.stack = []
+    return s
+
+
+class _Span:
+    __slots__ = ("_log", "name", "args", "_ts")
+
+    def __init__(self, log, name, args):
+        self._log = log
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        _stack().append(self.name)
+        self._ts = _now_us()
+        return self
+
+    def __exit__(self, *exc):
+        ts_end = _now_us()
+        stack = _stack()
+        stack.pop()
+        self._log.emit({
+            "name": self.name,
+            "ph": "X",
+            "ts": self._ts,
+            "dur": ts_end - self._ts,
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "depth": len(stack),
+            "parent": stack[-1] if stack else None,
+            "args": self.args or {},
+        })
+        return False
+
+
+class EventLog:
+    """Thread-safe event sink: bounded in-memory buffer (for the Chrome
+    dump) + immediate append-only JSONL (crash-durable: the stream
+    survives the hang the watchdog is there to catch)."""
+
+    MAX_EVENTS = 200_000  # bound the buffer; drops are counted, not silent
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+        self._events = []
+        self._dropped = 0
+        self._jsonl_path = os.path.join(directory, "events.jsonl")
+        self._jsonl = open(self._jsonl_path, "a", buffering=1)
+
+    @property
+    def jsonl_path(self) -> str:
+        return self._jsonl_path
+
+    # ------------------------------------------------------------- emit
+    def emit(self, event: dict):
+        try:
+            line = json.dumps(event)
+        except TypeError:
+            # non-serializable args: keep the span, stringify the payload
+            event = dict(event, args={"repr": repr(event.get("args"))})
+            line = json.dumps(event)
+        with self._lock:
+            if len(self._events) < self.MAX_EVENTS:
+                self._events.append(event)
+            else:
+                self._dropped += 1
+            try:
+                self._jsonl.write(line + "\n")
+            except ValueError:  # closed file during interpreter teardown
+                pass
+
+    def span(self, name: str, args: Optional[dict] = None) -> _Span:
+        return _Span(self, name, args)
+
+    def instant(self, name: str, args: Optional[dict] = None):
+        """Instant event (``ph: "i"``) — phase markers like checkpoint
+        commits and watchdog stall flags."""
+        self.emit({
+            "name": name,
+            "ph": "i",
+            "ts": _now_us(),
+            "s": "p",
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": args or {},
+        })
+
+    # ------------------------------------------------------------- dump
+    def chrome_events(self) -> list:
+        with self._lock:
+            events = list(self._events)
+        out = [{
+            "name": "process_name",
+            "ph": "M",
+            "pid": os.getpid(),
+            "args": {"name": "mxnet_tpu host telemetry"},
+        }]
+        for e in events:
+            ce = {k: e[k] for k in ("name", "ph", "ts", "pid", "tid")
+                  if k in e}
+            if "dur" in e:
+                ce["dur"] = e["dur"]
+            if "s" in e:
+                ce["s"] = e["s"]
+            args = dict(e.get("args") or {})
+            if e.get("parent"):
+                args["parent"] = e["parent"]
+            ce["args"] = args
+            out.append(ce)
+        return out
+
+    def dump(self, path: Optional[str] = None) -> str:
+        """Write the buffered spans as a Chrome-trace JSON file."""
+        path = path or os.path.join(self.directory, "trace.json")
+        with open(path, "w") as f:
+            json.dump({
+                "traceEvents": self.chrome_events(),
+                "displayTimeUnit": "ms",
+                "otherData": {"dropped_events": self._dropped},
+            }, f)
+        return path
+
+    def close(self):
+        with self._lock:
+            try:
+                self._jsonl.close()
+            except Exception:  # noqa: BLE001 - teardown
+                pass
+
+
+def span(log: Optional[EventLog], name: str, args: Optional[dict] = None):
+    return log.span(name, args) if log is not None else NULL_SPAN
+
+
+def instant(log: Optional[EventLog], name: str,
+            args: Optional[dict] = None):
+    if log is not None:
+        log.instant(name, args)

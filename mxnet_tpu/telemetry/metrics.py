@@ -1,0 +1,195 @@
+"""Metrics registry: counters, gauges, rolling histograms.
+
+One process-global registry is the telemetry spine: the trainer records
+step wall time and samples, the kvstore records allreduce bytes/latency,
+``profiler.py``'s aggregate per-op stats live here too (``op/`` prefix),
+and ``jax.monitoring`` compile events land under ``jax/``. The registry is
+always usable (metric objects are a few machine words); the telemetry
+ENABLED flag gates only hot-path instrumentation and event emission.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, Optional
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry"]
+
+
+class Counter:
+    """Monotonic counter (allreduce bytes, samples, stall count)."""
+
+    __slots__ = ("_value", "_lock")
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._value += n
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Gauge:
+    """Last-write-wins value (HBM high-water mark, queue depth)."""
+
+    __slots__ = ("_value",)
+
+    def __init__(self):
+        self._value = None
+
+    def set(self, v):
+        self._value = v
+
+    def max(self, v):
+        """Retain the high-water mark."""
+        if self._value is None or v > self._value:
+            self._value = v
+
+    @property
+    def value(self):
+        return self._value
+
+
+class Histogram:
+    """Rolling-window histogram with cumulative count/sum.
+
+    Percentiles come from the last ``window`` observations (a ring
+    buffer — O(window) memory regardless of run length); ``count`` and
+    ``sum`` are cumulative so rates (samples/sec over the whole run)
+    stay exact.
+    """
+
+    __slots__ = ("_ring", "_idx", "_filled", "_count", "_sum", "_min",
+                 "_max", "_lock", "window")
+
+    def __init__(self, window: int = 1024):
+        self.window = window
+        self._ring = [0.0] * window
+        self._idx = 0
+        self._filled = 0
+        self._count = 0
+        self._sum = 0.0
+        self._min = math.inf
+        self._max = -math.inf
+        self._lock = threading.Lock()
+
+    def observe(self, v: float):
+        v = float(v)
+        with self._lock:
+            self._ring[self._idx] = v
+            self._idx = (self._idx + 1) % self.window
+            if self._filled < self.window:
+                self._filled += 1
+            self._count += 1
+            self._sum += v
+            if v < self._min:
+                self._min = v
+            if v > self._max:
+                self._max = v
+
+    @property
+    def count(self):
+        return self._count
+
+    @property
+    def sum(self):
+        return self._sum
+
+    def _window_sorted(self):
+        with self._lock:
+            vals = self._ring[: self._filled]
+        return sorted(vals)
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Nearest-rank-with-interpolation percentile of the rolling
+        window; None when nothing was observed."""
+        vals = self._window_sorted()
+        if not vals:
+            return None
+        if len(vals) == 1:
+            return vals[0]
+        rank = (p / 100.0) * (len(vals) - 1)
+        lo = int(math.floor(rank))
+        hi = min(lo + 1, len(vals) - 1)
+        frac = rank - lo
+        return vals[lo] * (1 - frac) + vals[hi] * frac
+
+    def summary(self) -> dict:
+        vals = self._window_sorted()
+        if not vals:
+            return {"count": 0, "sum": 0.0, "mean": None, "min": None,
+                    "max": None, "p50": None, "p95": None, "p99": None}
+        return {
+            "count": self._count,
+            "sum": self._sum,
+            "mean": self._sum / self._count,
+            "min": self._min,
+            "max": self._max,
+            "p50": self.percentile(50),
+            "p95": self.percentile(95),
+            "p99": self.percentile(99),
+        }
+
+
+class Registry:
+    """Get-or-create metric registry, thread-safe, name-keyed."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, Counter] = {}
+        self._gauges: Dict[str, Gauge] = {}
+        self._histograms: Dict[str, Histogram] = {}
+
+    def counter(self, name: str) -> Counter:
+        with self._lock:
+            m = self._counters.get(name)
+            if m is None:
+                m = self._counters[name] = Counter()
+            return m
+
+    def gauge(self, name: str) -> Gauge:
+        with self._lock:
+            m = self._gauges.get(name)
+            if m is None:
+                m = self._gauges[name] = Gauge()
+            return m
+
+    def histogram(self, name: str, window: int = 1024) -> Histogram:
+        with self._lock:
+            m = self._histograms.get(name)
+            if m is None:
+                m = self._histograms[name] = Histogram(window)
+            return m
+
+    def clear(self, prefix: Optional[str] = None):
+        """Drop metrics (all, or those whose name starts with prefix) —
+        used by ``profiler.dumps(reset=True)`` for its ``op/`` family."""
+        with self._lock:
+            for d in (self._counters, self._gauges, self._histograms):
+                if prefix is None:
+                    d.clear()
+                else:
+                    for k in [k for k in d if k.startswith(prefix)]:
+                        del d[k]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            histograms = dict(self._histograms)
+        return {
+            "counters": {k: v.value for k, v in counters.items()},
+            "gauges": {k: v.value for k, v in gauges.items()},
+            "histograms": {k: v.summary() for k, v in histograms.items()},
+        }
+
+    def histograms_with_prefix(self, prefix: str):
+        with self._lock:
+            return {k: v for k, v in self._histograms.items()
+                    if k.startswith(prefix)}

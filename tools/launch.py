@@ -140,15 +140,17 @@ def launch_elastic(num_procs: int, command, max_restarts: int = 0,
     the job down and relaunch ALL workers, which resume from the latest
     committed checkpoint (``mxnet_tpu.checkpoint`` /
     ``TrainStep.load_checkpoint``). Each attempt gets a fresh
-    coordinator port; ``MXNET_TPU_RESTART_COUNT`` tells workers which
-    attempt they are."""
+    coordinator port (a user-supplied ``coordinator`` is honored on the
+    FIRST attempt only — relaunching on the dead attempt's port could
+    collide with TIME_WAIT sockets or stale coordination-service state);
+    ``MXNET_TPU_RESTART_COUNT`` tells workers which attempt they are."""
     attempts = max_restarts + 1
     rc = 0
     for attempt in range(attempts):
         os.environ["MXNET_TPU_RESTART_COUNT"] = str(attempt)
         rc = launch_local(num_procs, command,
-                          coordinator=None if coordinator is None
-                          else coordinator, timeout=timeout)
+                          coordinator=coordinator if attempt == 0
+                          else None, timeout=timeout)
         if rc == 0:
             return 0
         print(f"launch: attempt {attempt + 1}/{attempts} failed rc={rc}"

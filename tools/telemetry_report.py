@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Pretty-print a JSONL telemetry dump (``events.jsonl`` from
+``mx.telemetry``).
+
+Aggregates spans by name (count, total/mean/p50/p95/p99/max), lists
+instant events (checkpoint commits, watchdog stalls), and — when pointed
+at a telemetry DIRECTORY — also surfaces ``heartbeat.json`` and
+``report.json`` if present.
+
+Usage:
+  python tools/telemetry_report.py telemetry/            # a dump dir
+  python tools/telemetry_report.py telemetry/events.jsonl
+  python tools/telemetry_report.py events.jsonl --top 20 --sort total
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _quantile(sorted_vals, p):
+    if not sorted_vals:
+        return 0.0
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    rank = (p / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = rank - lo
+    return sorted_vals[lo] * (1 - frac) + sorted_vals[hi] * frac
+
+
+def load_events(path):
+    """Yield parsed JSONL records, skipping torn lines (the stream is
+    append-only and may end mid-write after a crash — that is the point
+    of the format)."""
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except ValueError:
+                print(f"  (skipping torn line {lineno})", file=sys.stderr)
+
+
+def summarize(events):
+    spans = {}
+    instants = []
+    for e in events:
+        ph = e.get("ph")
+        if ph == "X":
+            spans.setdefault(e.get("name", "?"), []).append(
+                float(e.get("dur", 0.0)))
+        elif ph == "i":
+            instants.append(e)
+    return spans, instants
+
+
+def format_spans(spans, top=None, sort="total"):
+    rows = []
+    for name, durs in spans.items():
+        s = sorted(durs)
+        total = sum(durs)
+        rows.append({
+            "name": name,
+            "count": len(durs),
+            "total_ms": total / 1e3,
+            "mean_ms": total / len(durs) / 1e3,
+            "p50_ms": _quantile(s, 50) / 1e3,
+            "p95_ms": _quantile(s, 95) / 1e3,
+            "p99_ms": _quantile(s, 99) / 1e3,
+            "max_ms": s[-1] / 1e3,
+        })
+    keys = {"total": "total_ms", "count": "count", "mean": "mean_ms",
+            "p95": "p95_ms", "name": "name"}
+    rev = sort != "name"
+    rows.sort(key=lambda r: r[keys.get(sort, "total_ms")], reverse=rev)
+    if top:
+        rows = rows[:top]
+    hdr = (f"{'Span':<32}{'Count':>8}{'Total(ms)':>12}{'Mean(ms)':>10}"
+           f"{'p50':>9}{'p95':>9}{'p99':>9}{'Max':>9}")
+    lines = [hdr, "-" * len(hdr)]
+    for r in rows:
+        lines.append(
+            f"{r['name']:<32}{r['count']:>8}{r['total_ms']:>12.2f}"
+            f"{r['mean_ms']:>10.3f}{r['p50_ms']:>9.3f}{r['p95_ms']:>9.3f}"
+            f"{r['p99_ms']:>9.3f}{r['max_ms']:>9.3f}")
+    return "\n".join(lines)
+
+
+def _print_json_file(path, title):
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except ValueError:
+        return
+    print(f"\n== {title} ({path}) ==")
+    print(json.dumps(data, indent=2, default=str)[:4000])
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("path", help="events.jsonl file or telemetry directory")
+    ap.add_argument("--top", type=int, default=None,
+                    help="show only the top N spans")
+    ap.add_argument("--sort", default="total",
+                    choices=["total", "count", "mean", "p95", "name"])
+    args = ap.parse_args(argv)
+
+    path = args.path
+    directory = None
+    if os.path.isdir(path):
+        directory = path
+        path = os.path.join(path, "events.jsonl")
+    if not os.path.exists(path):
+        ap.error(f"no events file at {path}")
+
+    spans, instants = summarize(load_events(path))
+    if not spans and not instants:
+        print(f"{path}: no events")
+        return 0
+    print(f"== Spans ({path}) ==")
+    if spans:
+        print(format_spans(spans, top=args.top, sort=args.sort))
+    else:
+        print("(none)")
+    if instants:
+        print(f"\n== Instant events ({len(instants)}) ==")
+        for e in instants:
+            args_str = json.dumps(e.get("args", {}), default=str)
+            print(f"  ts={e.get('ts', 0) / 1e6:>10.3f}s  "
+                  f"{e.get('name', '?'):<28} {args_str}")
+    if directory:
+        _print_json_file(os.path.join(directory, "heartbeat.json"),
+                         "Heartbeat")
+        _print_json_file(os.path.join(directory, "report.json"), "Report")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
