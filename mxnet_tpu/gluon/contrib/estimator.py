@@ -307,7 +307,13 @@ class Estimator:
 
     # ------------------------------------------------------------------ fit
     def fit(self, train_data, val_data=None, epochs=None,
-            event_handlers=None, batches=None, batch_size=None):
+            event_handlers=None, batches=None, batch_size=None,
+            prefetch=None):
+        """Drive training epochs. ``prefetch=N`` (or ``True``) is the
+        opt-in async device feed: each epoch's batches are pulled and
+        device_put by a background thread holding up to N staged batches
+        (``gluon.data.prefetch.prefetch_to_device``), so the next batch's
+        host->device transfer overlaps the current step."""
         if epochs is None and batches is None:
             raise MXNetError("fit needs epochs or batches")
         handlers = self._prepare_handlers(event_handlers, val_data, epochs,
@@ -321,29 +327,38 @@ class Estimator:
                   if _tel._ENABLED else _tel.NULL_SPAN):
                 _dispatch(handlers, "epoch_begin", self)
                 self.train_loss_metric.reset()
-                for batch in train_data:
-                    data, label = _split_batch(batch)
-                    _dispatch(handlers, "batch_begin", self, batch=batch)
-                    if _tel._ENABLED:
-                        with _tel.span("estimator.forward_backward"):
+                epoch_iter = self._epoch_iter(train_data, prefetch)
+                try:
+                    for batch in epoch_iter:
+                        data, label = _split_batch(batch)
+                        _dispatch(handlers, "batch_begin", self, batch=batch)
+                        if _tel._ENABLED:
+                            with _tel.span("estimator.forward_backward"):
+                                with autograd.record():
+                                    pred = self.net(data)
+                                    L = self.loss(pred, label)
+                                L.backward()
+                        else:
                             with autograd.record():
                                 pred = self.net(data)
                                 L = self.loss(pred, label)
                             L.backward()
-                    else:
-                        with autograd.record():
-                            pred = self.net(data)
-                            L = self.loss(pred, label)
-                        L.backward()
-                    self.trainer.step(_batch_size(batch))
-                    self.train_loss_metric.update(0, L)
-                    _dispatch(handlers, "batch_end", self, batch=batch,
-                              pred=pred, label=label, loss=L)
-                    self.stop_training = self.stop_training or any(
-                        getattr(h, "stop_training", False) for h in handlers
-                    )
-                    if self.stop_training:
-                        break
+                        self.trainer.step(_batch_size(batch))
+                        self.train_loss_metric.update(0, L)
+                        _dispatch(handlers, "batch_end", self, batch=batch,
+                                  pred=pred, label=label, loss=L)
+                        self.stop_training = self.stop_training or any(
+                            getattr(h, "stop_training", False)
+                            for h in handlers
+                        )
+                        if self.stop_training:
+                            break
+                finally:
+                    # an abandoned prefetch iterator must retire its
+                    # staging thread (early stop / handler exception)
+                    if epoch_iter is not train_data and \
+                            hasattr(epoch_iter, "close"):
+                        epoch_iter.close()
                 _dispatch(handlers, "epoch_end", self)
             epoch += 1
             self.stop_training = self.stop_training or any(
@@ -353,6 +368,18 @@ class Estimator:
                 train_data.reset()
         _dispatch(handlers, "train_end", self)
         return self
+
+    @staticmethod
+    def _epoch_iter(train_data, prefetch):
+        """One epoch's batch source: raw, or wrapped in the async device
+        feed when ``prefetch`` is set (a fresh single-use pipeline per
+        epoch — the staging thread dies with the epoch)."""
+        if not prefetch:
+            return train_data
+        from ..data.prefetch import prefetch_to_device
+
+        size = None if prefetch is True else int(prefetch)
+        return prefetch_to_device(train_data, size=size)
 
     def _prepare_handlers(self, event_handlers, val_data, epochs, batches):
         handlers = list(_as_list(event_handlers) if event_handlers else [])

@@ -215,9 +215,16 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=False, timeout=120,
-                 persistent_workers=True):
+                 persistent_workers=True, prefetch_to_device=None):
         self._dataset = dataset
         self._timeout = timeout
+        # prefetch_to_device=N (or True): each epoch's iterator is wrapped
+        # in gluon.data.prefetch.prefetch_to_device — a background thread
+        # keeps up to N batches staged ON DEVICE so the next transfer
+        # overlaps the current step's compute (True reads
+        # MXTPU_PREFETCH_DEFAULT). Distinct from `prefetch`, which bounds
+        # HOST batches in flight inside the worker pool.
+        self._prefetch_device = prefetch_to_device
         self._persistent_workers = bool(persistent_workers)
         if batch_sampler is None:
             if batch_size is None:
@@ -271,6 +278,15 @@ class DataLoader:
         return put(batch)
 
     def __iter__(self):
+        if self._prefetch_device:
+            from .prefetch import prefetch_to_device as _ptd
+
+            size = None if self._prefetch_device is True \
+                else int(self._prefetch_device)
+            return _ptd(self._iter_host(), size=size)
+        return self._iter_host()
+
+    def _iter_host(self):
         if self._num_workers == 0:
             for indices in self._batch_sampler:
                 if _tel._ENABLED:

@@ -1,0 +1,229 @@
+"""Asynchronous device-feed pipeline: prefetch batches TO THE DEVICE.
+
+The reference's ThreadedIter/prefetcher (SURVEY §3.3) overlapped disk +
+augmentation with training; its TPU-shaped gap is the host->device leg:
+``DataLoader`` (even with worker processes) hands the consumer HOST
+batches, and every ``TrainStep.__call__`` then pays a synchronous
+reshape/split plus per-input ``device_put`` before it can dispatch. On a
+dispatch-latency-bound backend that host work sits squarely on the
+critical path.
+
+``prefetch_to_device`` moves it off: a background thread pulls host
+batches from any iterable, applies the CONSUMER'S exact placement —
+``feed.device_put_batch`` when the feed (a ``TrainStep``) publishes its
+contract via ``feed_spec()``, plain default-device ``device_put``
+otherwise — and keeps a bounded queue of ``size`` batches already in
+flight on device, so the next batch's transfer overlaps the current
+step's compute::
+
+    pf = prefetch_to_device(loader, size=2, feed=step)
+    for batch in pf:          # DeviceBatch, already split + sharded
+        loss = step(batch)    # __call__ fast path: dispatch only
+
+Shutdown is clean in every direction: source exhaustion ends the
+iterator; a worker-side exception is re-raised at the consumer's next
+pull; abandoning the iterator (``break``, error, ``close()``, GC)
+unblocks and retires the worker thread.
+
+Telemetry (always-on registry metrics; spans only when enabled):
+``input/wait_ms`` histogram (time the consumer blocked waiting for a
+staged batch — the residual input stall after overlap), ``input/
+queue_depth`` gauge, ``input/batches`` counter, and an ``input.wait``
+span per pull.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time as _time
+
+import numpy as _np
+
+from ... import telemetry as _tel
+from ...base import get_env
+from ...ndarray.ndarray import NDArray
+
+__all__ = ["prefetch_to_device", "PrefetchIterator"]
+
+_OK, _ERR, _END = 0, 1, 2
+
+
+def _default_place(batch):
+    """Consumer-agnostic placement: numpy leaves -> device NDArrays on the
+    default device (structure preserved), issued from the worker thread so
+    the transfer overlaps the consumer's compute."""
+    import jax
+
+    if isinstance(batch, (list, tuple)):
+        return type(batch)(_default_place(b) for b in batch)
+    if isinstance(batch, NDArray):
+        return NDArray(jax.device_put(batch.data))
+    if isinstance(batch, _np.ndarray):
+        return NDArray(jax.device_put(batch))
+    return batch
+
+
+def _bounded_put(q, stop, item) -> bool:
+    """Bounded put that keeps observing the stop flag so an abandoned
+    consumer never leaves the worker blocked on a full queue."""
+    while not stop.is_set():
+        try:
+            q.put(item, timeout=0.05)
+            return True
+        except queue.Full:
+            continue
+    return False
+
+
+def _worker(loader, q, stop, place):
+    # module-level on purpose: the thread must hold NO reference to the
+    # PrefetchIterator, or an abandoned (GC'd) iterator could never fire
+    # __del__/close and the worker would leak
+    it = None
+    try:
+        it = iter(loader)
+        while not stop.is_set():
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            staged = place(batch)
+            if not _bounded_put(q, stop, (_OK, staged)):
+                return
+    except BaseException as e:  # noqa: BLE001 - forward to consumer
+        _bounded_put(q, stop, (_ERR, e))
+        return
+    finally:
+        if it is not None and hasattr(it, "close"):
+            try:
+                it.close()
+            except Exception:  # noqa: BLE001 - source teardown
+                pass
+    _bounded_put(q, stop, (_END, None))
+
+
+class PrefetchIterator:
+    """Single-use iterator over ``loader`` with device-side staging.
+
+    Prefer the ``prefetch_to_device`` factory; see the module docstring
+    for the contract. Also a context manager (``with`` closes it).
+    """
+
+    def __init__(self, loader, size, feed=None):
+        if size < 1:
+            raise ValueError(f"prefetch size must be >= 1, got {size}")
+        self._loader = loader
+        self._size = int(size)
+        if feed is not None and not hasattr(feed, "device_put_batch"):
+            raise TypeError(
+                f"feed {type(feed).__name__} has no device_put_batch(); "
+                "pass a TrainStep (or feed=None for default placement)"
+            )
+        if feed is not None:
+            def place(batch):
+                flat = tuple(batch) if isinstance(batch, (list, tuple)) \
+                    else (batch,)
+                return feed.device_put_batch(flat)
+        else:
+            place = _default_place
+        # maxsize bounds DEVICE-resident batches: `size` staged in the
+        # queue plus at most one held by the worker while it blocks in put
+        self._q: queue.Queue = queue.Queue(maxsize=self._size)
+        self._stop = threading.Event()
+        self._closed = False
+        self._thread = threading.Thread(
+            target=_worker, args=(loader, self._q, self._stop, place),
+            name="mxtpu-prefetch", daemon=True,
+        )
+        self._thread.start()
+
+    # ----------------------------------------------------------- consumer
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        if _tel._ENABLED:
+            with _tel.span("input.wait", {"queued": self._q.qsize()}):
+                kind, payload, wait_s = self._get()
+        else:
+            kind, payload, wait_s = self._get()
+        reg = _tel.registry()
+        reg.histogram("input/wait_ms").observe(wait_s * 1e3)
+        reg.gauge("input/queue_depth").set(self._q.qsize())
+        if kind == _OK:
+            reg.counter("input/batches").inc()
+            return payload
+        self.close()
+        if kind == _ERR:
+            raise payload
+        raise StopIteration
+
+    def _get(self):
+        t0 = _time.perf_counter()
+        while True:
+            try:
+                kind, payload = self._q.get(timeout=1.0)
+                return kind, payload, _time.perf_counter() - t0
+            except queue.Empty:
+                if not self._thread.is_alive():
+                    # the worker can only exit after queueing _OK/_ERR/_END,
+                    # so an empty queue here means those were drained by a
+                    # concurrent close — treat as end of data
+                    return _END, None, _time.perf_counter() - t0
+
+    def __len__(self):
+        return len(self._loader)
+
+    # ------------------------------------------------------------ teardown
+    def close(self):
+        """Stop the worker and drop staged batches; idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self._stop.set()
+        # drain so a worker blocked in put() can observe the stop flag
+        self._drain()
+        self._thread.join(timeout=5.0)
+        self._drain()  # anything queued between first drain and exit
+
+    def _drain(self):
+        while True:
+            try:
+                self._q.get_nowait()
+            except queue.Empty:
+                return
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter shutdown
+            pass
+
+
+def prefetch_to_device(loader, size=None, feed=None) -> PrefetchIterator:
+    """Wrap ``loader`` in a background device-staging pipeline.
+
+    Parameters
+    ----------
+    loader : any iterable of batches (``DataLoader``, generator, list)
+    size : bound on staged device-resident batches; ``None`` reads
+        ``MXTPU_PREFETCH_DEFAULT`` (default 2). 2 suffices to overlap
+        transfer with compute; raise it only for bursty per-batch cost.
+    feed : optional consumer placement contract — an object with
+        ``device_put_batch((input0, ..., label))`` (``TrainStep``). The
+        staged batches then take ``__call__``'s pre-placed fast path.
+        Without a feed, leaves go to the default device unsharded.
+    """
+    if size is None:
+        size = get_env("MXTPU_PREFETCH_DEFAULT", 2, int)
+    return PrefetchIterator(loader, int(size), feed)

@@ -58,6 +58,45 @@ def _fused_sgd_fn(n: int, momentum: float, clip: float):
     return jax.jit(apply)
 
 
+@functools.lru_cache(maxsize=64)
+def _fused_adam_fn(n: int, beta1: float, beta2: float, eps: float,
+                   clip: float, decoupled_wd: bool, bias_corr: bool):
+    import jax
+
+    # per-tensor math mirrors ops/optimizer_op.adam_update (coupled wd via
+    # _apply_wd_rescale ordering) and adamw_update (decoupled, wd outside
+    # the moments); bias correction folds into lr IN-GRAPH from the ts
+    # vector — the same f32 formulation TrainStep compiles, so the three
+    # Adam paths agree to f32 resolution
+    from ..ops.optimizer_op import _apply_wd_rescale
+
+    def apply(ws, gs, ms, vs, lrs, wds, ts, rescale):
+        new_w, new_m, new_v = [], [], []
+        for i in range(n):
+            if decoupled_wd:
+                g = gs[i] * rescale
+                if clip >= 0:
+                    g = jnp.clip(g, -clip, clip)
+            else:
+                g = _apply_wd_rescale(ws[i], gs[i], wds[i], rescale,
+                                      clip if clip >= 0 else None)
+            lr = lrs[i]
+            if bias_corr:
+                lr = lr * jnp.sqrt(1.0 - beta2 ** ts[i]) / \
+                    (1.0 - beta1 ** ts[i])
+            m = beta1 * ms[i] + (1.0 - beta1) * g
+            v = beta2 * vs[i] + (1.0 - beta2) * jnp.square(g)
+            upd = m / (jnp.sqrt(v) + eps)
+            if decoupled_wd:
+                upd = upd + wds[i] * ws[i]
+            new_w.append(ws[i] - lr * upd)
+            new_m.append(m)
+            new_v.append(v)
+        return tuple(new_w), tuple(new_m), tuple(new_v)
+
+    return jax.jit(apply)
+
+
 class Trainer:
     def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
                  compression_params=None, update_on_kvstore=None):
@@ -93,6 +132,7 @@ class Trainer:
         self._kvstore = None
         self._update_on_kvstore = None
         self._states_to_load = None
+        self._grad_keys_inited = False
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -195,10 +235,17 @@ class Trainer:
             # server-side optimizer; pre-reducing here would double-sum and
             # run the updater against the gradient buffers
             return
+        if not self._grad_keys_inited:
+            # register gradient keys ONCE — init is idempotent but still
+            # cost a span + dict probe per param per step when issued
+            # unconditionally from this hot loop
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    self._kvstore.init(f"g{i}", param.grad())
+            self._grad_keys_inited = True
         for i, param in enumerate(self._params):
             if param.grad_req != "null":
                 grad = param.grad()
-                self._kvstore.init(f"g{i}", grad)
                 self._kvstore.push(f"g{i}", grad)
                 self._kvstore.pull(f"g{i}", grad)
 
@@ -225,6 +272,8 @@ class Trainer:
                 self._kvstore.pull(i, param.data())
             return
         if self._fused_sgd_update(updater):
+            return
+        if self._fused_adam_update(updater):
             return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
@@ -296,6 +345,71 @@ class Trainer:
                 None, lrs, wds, rescale)
             for w, nw in zip(ws, new_w):
                 w._rebind(nw)
+        return True
+
+    def _fused_adam_update(self, updater) -> bool:
+        """Multi-tensor Adam/AdamW apply, ``_fused_sgd_update``'s shape for
+        the adaptive optimizers: ONE jitted call updates every dense f32
+        parameter and both moment states — a single dispatch per step
+        instead of one per param. lr/wd ride memoized device vectors; the
+        per-param step counts (``ts``, for bias correction) change every
+        step and arrive as one small f32 vector.
+
+        Engages only for the exact Adam/AdamW classes over dense f32
+        params with plain ``(mean, var)`` states; sparse grads,
+        multi-precision ``(state, master)`` layouts, or any other dtype
+        fall back to per-param updates."""
+        opt_ = self._optimizer
+        if type(opt_) not in (opt.Adam, opt.AdamW) or not _fused_jit_enabled():
+            return False
+        from ..ndarray.sparse import RowSparseNDArray
+
+        idxs, ws, gs, ms, vs = [], [], [], [], []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null":
+                continue
+            w, g = param.data(), param.grad()
+            if isinstance(g, RowSparseNDArray) or w.dtype != _np.float32:
+                return False
+            if i not in updater.states:
+                updater.states[i] = opt_.create_state_multi_precision(i, w)
+                updater.states_synced[i] = True
+            st = updater.states[i]
+            if not (isinstance(st, tuple) and len(st) == 2
+                    and all(isinstance(s, NDArray) for s in st)):
+                return False  # multi-precision (state, master): fallback
+            idxs.append(i)
+            ws.append(w)
+            gs.append(g)
+            ms.append(st[0])
+            vs.append(st[1])
+        if not idxs:
+            return False
+        for i in idxs:
+            opt_._update_count(i)
+        ts = tuple(float(opt_._index_update_count[i]) for i in idxs)
+        host = ([opt_._get_lr(i) for i in idxs],
+                [opt_._get_wd(i) for i in idxs], opt_.rescale_grad)
+        memo = getattr(self, "_adam_hyper_memo", None)
+        if memo is None or memo[0] != host:
+            self._adam_hyper_memo = memo = (
+                host, jnp.asarray(host[0], jnp.float32),
+                jnp.asarray(host[1], jnp.float32), jnp.float32(host[2]))
+        _, lrs, wds, rescale = memo
+        clip = opt_.clip_gradient if opt_.clip_gradient is not None else -1.0
+        decoupled = type(opt_) is opt.AdamW
+        bias_corr = bool(opt_.correct_bias) if decoupled else True
+        fn = _fused_adam_fn(len(idxs), float(opt_.beta1), float(opt_.beta2),
+                            float(opt_.epsilon), float(clip), decoupled,
+                            bias_corr)
+        new_w, new_m, new_v = fn(
+            tuple(w.data for w in ws), tuple(g.data for g in gs),
+            tuple(m.data for m in ms), tuple(v.data for v in vs),
+            lrs, wds, jnp.asarray(ts, jnp.float32), rescale)
+        for w, m, v, nw, nm, nv in zip(ws, ms, vs, new_w, new_m, new_v):
+            w._rebind(nw)
+            m._rebind(nm)
+            v._rebind(nv)
         return True
 
     # ---------------------------------------------------------------- state

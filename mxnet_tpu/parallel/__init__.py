@@ -151,6 +151,6 @@ def init_process_group(coordinator_address: str, num_processes: int,
     )
 
 
-from .step import TrainStep  # noqa: E402  (public API; needs defs above)
+from .step import TrainStep, DeviceBatch  # noqa: E402  (public API; needs defs above)
 
-__all__.append("TrainStep")
+__all__ += ["TrainStep", "DeviceBatch"]

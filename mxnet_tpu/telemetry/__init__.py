@@ -254,6 +254,7 @@ def report() -> dict:
     snap = _REGISTRY.snapshot()
     step_hist = snap["histograms"].get("trainer/step_time_s")
     compile_hist = snap["histograms"].get("jax/compile_time_s")
+    wait_hist = snap["histograms"].get("input/wait_ms")
     samples = snap["counters"].get("trainer/samples", 0)
     step_sum = step_hist["sum"] if step_hist else 0.0
     return {
@@ -266,6 +267,12 @@ def report() -> dict:
         "compile_time_s": compile_hist["sum"] if compile_hist else None,
         "hbm_peak_bytes": snap["gauges"].get("device/hbm_peak_bytes"),
         "watchdog_stalls": snap["counters"].get("watchdog/stalls", 0),
+        # async device feed (gluon.data.prefetch): per-pull consumer stall
+        # — after overlap, the residual input wait per step
+        "input_wait_ms": wait_hist,
+        "input_wait_ms_p50": wait_hist["p50"] if wait_hist else None,
+        "input_wait_ms_p95": wait_hist["p95"] if wait_hist else None,
+        "input_queue_depth": snap["gauges"].get("input/queue_depth"),
         "counters": snap["counters"],
         "gauges": snap["gauges"],
         "histograms": snap["histograms"],

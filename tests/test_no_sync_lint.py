@@ -1,0 +1,32 @@
+"""tools/check_no_sync_in_step.py as a tier-1 unit test: the TrainStep
+pre-placed fast path (__call__ + _dispatch) must stay free of blocking
+host syncs, or the async device-feed overlap silently degrades."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import check_no_sync_in_step  # noqa: E402
+
+
+def test_fast_path_is_sync_free():
+    violations = check_no_sync_in_step.find_violations()
+    assert not violations, "\n".join(
+        f"step.py:{ln}: {msg}" for ln, msg in violations)
+
+
+def test_lint_catches_a_violation(tmp_path):
+    """The lint itself must actually detect a blocking call (guards
+    against the checker rotting into a no-op when step.py is refactored)."""
+    bad = tmp_path / "step_bad.py"
+    bad.write_text(
+        "class TrainStep:\n"
+        "    def __call__(self, x):\n"
+        "        return float(self._dispatch(x))\n"
+        "    def _dispatch(self, x):\n"
+        "        return x.asnumpy()\n"
+    )
+    violations = check_no_sync_in_step.find_violations(str(bad))
+    assert len(violations) == 2
+    assert any("float" in m for _, m in violations)
+    assert any("asnumpy" in m for _, m in violations)

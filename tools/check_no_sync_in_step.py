@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Lint: TrainStep's dispatch fast path must never block on the device.
+
+The async device-feed pipeline (``gluon.data.prefetch``) only overlaps
+input with compute if ``TrainStep.__call__``'s pre-placed fast path —
+``__call__`` itself plus ``_dispatch`` — stays pure dispatch: any host
+synchronization there (``.asnumpy()``, ``float(loss)``, ``np.asarray`` on
+a device array, ``block_until_ready``) serializes the step against the
+transfer and silently un-does the tentpole. This check walks the AST of
+``mxnet_tpu/parallel/step.py`` and flags blocking calls in those bodies.
+
+Run standalone (nonzero exit on violations)::
+
+    python tools/check_no_sync_in_step.py
+
+or through the tier-1 suite (``tests/test_no_sync_lint.py`` imports
+``find_violations`` and asserts it returns nothing).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+STEP_PY = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir,
+    "mxnet_tpu", "parallel", "step.py"))
+
+# the fast-path bodies: __call__ (DeviceBatch detection + dispatch) and
+# _dispatch (the staged-operand hot dispatch). _stage is deliberately NOT
+# linted — it is the slow path the fast path exists to skip.
+FAST_PATH_FUNCS = ("__call__", "_dispatch")
+
+# method attributes that force a device->host readback / host sync
+BLOCKING_ATTRS = {
+    "asnumpy", "asscalar", "item", "tolist", "block_until_ready",
+    "copy_to_host_async",
+}
+# bare builtins that coerce a device scalar on the host
+BLOCKING_BUILTINS = {"float", "int", "bool", "complex", "print"}
+# module.attr calls that materialize device arrays on host (np.asarray on
+# a device array round-trips it) or stall the thread
+BLOCKING_QUALIFIED = {
+    ("np", "asarray"), ("_np", "asarray"), ("numpy", "asarray"),
+    ("np", "array"), ("_np", "array"), ("numpy", "array"),
+    ("jax", "device_get"), ("time", "sleep"), ("_time", "sleep"),
+}
+
+
+def find_violations(path: str = STEP_PY):
+    """Return [(lineno, message)] for blocking calls inside the fast-path
+    bodies of TrainStep."""
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+    classes = [n for n in tree.body
+               if isinstance(n, ast.ClassDef) and n.name == "TrainStep"]
+    if not classes:
+        return [(0, f"TrainStep class not found in {path}")]
+    funcs = [n for n in classes[0].body
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+             and n.name in FAST_PATH_FUNCS]
+    missing = set(FAST_PATH_FUNCS) - {f.name for f in funcs}
+    if missing:
+        out.append((classes[0].lineno,
+                    f"fast-path method(s) {sorted(missing)} not found — "
+                    "update FAST_PATH_FUNCS if the hot path was renamed"))
+    for fn in funcs:
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Name) and f.id in BLOCKING_BUILTINS:
+                out.append((node.lineno,
+                            f"{fn.name}: host coercion {f.id}(...) blocks "
+                            "on the device value"))
+            elif isinstance(f, ast.Attribute):
+                if f.attr in BLOCKING_ATTRS:
+                    out.append((node.lineno,
+                                f"{fn.name}: .{f.attr}() forces a "
+                                "device->host sync"))
+                elif isinstance(f.value, ast.Name) and \
+                        (f.value.id, f.attr) in BLOCKING_QUALIFIED:
+                    out.append((node.lineno,
+                                f"{fn.name}: {f.value.id}.{f.attr}(...) "
+                                "materializes/stalls on host"))
+    return out
+
+
+def main(argv=None):
+    path = (argv or sys.argv[1:] or [STEP_PY])[0]
+    violations = find_violations(path)
+    for lineno, msg in violations:
+        print(f"{path}:{lineno}: {msg}")
+    if violations:
+        print(f"{len(violations)} blocking call(s) in the TrainStep fast "
+              "path — move them off the dispatch path or stage them in "
+              "_stage/device_put_batch")
+        return 1
+    print("TrainStep fast path is sync-free")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
