@@ -1,12 +1,28 @@
 """BASELINE config 4: Transformer-base WMT En-De train step (the config
 that exercises graph fusion: encoder+decoder+tied-logits in one XLA
-program via TrainStep, bf16 + AdamW)."""
+program via TrainStep, bf16 + AdamW).
+
+``--variable-length`` instead runs the shape-stability ablation (CPU-
+sized by default): the same variable-length token stream fed (a)
+unbucketed — every batch padded to its own max length, one compiled
+program per distinct length — and (b) bucketed through
+``FixedBucketSampler`` + ``PadToBucket`` with ``TrainStep.warmup`` over
+the bucket signatures, which must hold compiles to <= n_buckets with
+ZERO steady-state recompiles (counter-verified via the step's
+``compile_guard``). With ``MXTPU_COMPILE_CACHE_DIR`` set, a second
+process run also reports persistent-cache hits.
+"""
 
 from __future__ import annotations
 
+import argparse
+import json
+import sys
+import time
+
 import numpy as np
 
-from .common import run_bench
+from .common import run_bench, run_varlen_mode
 
 BATCH, SRC_LEN, TGT_LEN = 64, 64, 64
 STEPS_PER_CALL = 40
@@ -16,7 +32,7 @@ VOCAB = 32768
 CEILING = 3.3e5
 
 
-def main():
+def fixed_main():
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, optimizer as opt
     from mxnet_tpu.gluon.model_zoo.transformer import transformer_base
@@ -55,5 +71,159 @@ def main():
     )
 
 
+# ------------------------------------------------------ variable-length mode
+def variable_length_main(args):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache, gluon, nd, optimizer as opt
+    from mxnet_tpu.gluon.data import (DataLoader, FixedBucketSampler,
+                                      PadToBucket)
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.parallel import TrainStep
+
+    V = args.vocab
+    rng = np.random.RandomState(args.seed)
+    lengths = rng.randint(args.min_len, args.max_len + 1,
+                          size=args.samples).tolist()
+    dataset = []
+    for length in lengths:
+        s = rng.randint(1, V, size=length).astype("int32")
+        t = rng.randint(1, V, size=length).astype("int32")
+        dataset.append((s, t, t))  # label = tgt; pad with -1 for the mask
+    tokens_per_epoch = int(sum(lengths))
+
+    class MaskedCE:
+        """Per-token CE averaged over VALID (label != -1) tokens only.
+        Reduced per row THEN across rows: appending pad columns only adds
+        exact zeros to each row's reduction, so padded and unpadded
+        batches of the same sentences are bit-identical (asserted in
+        tests/test_bucketing.py)."""
+
+        def __call__(self, logits, label):
+            x = logits.data.astype(jnp.float32)
+            y = label.data
+            mask = y >= 0
+            safe = jnp.where(mask, y, 0).astype(jnp.int32)
+            logp = jax.nn.log_softmax(x, axis=-1)
+            nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       axis=-1)[..., 0]
+            row = jnp.where(mask, nll, 0.0).sum(axis=-1)
+            return NDArray(row.sum() / mask.sum())
+
+    def make_step():
+        net = TransformerModel(
+            src_vocab=V, tgt_vocab=V, units=args.units,
+            hidden_size=args.units * 2, num_layers=args.layers, num_heads=2,
+            max_length=args.max_len + 8, dropout=0.0)
+        net.initialize(mx.initializer.Xavier())
+        net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                          nd.zeros((2, 8), dtype="int32"))
+        return TrainStep(net, MaskedCE(), opt.AdamW(learning_rate=1e-4))
+
+    # ---- unbucketed: shuffled fixed-size batches, each padded to its own
+    # max length — the classic one-compile-per-distinct-length feed
+    def pad_batch(idxs):
+        ml = max(lengths[i] for i in idxs)
+        s = np.zeros((len(idxs), ml), "int32")
+        t = np.zeros((len(idxs), ml), "int32")
+        lab = np.full((len(idxs), ml), -1, "int32")
+        for r, i in enumerate(idxs):
+            s[r, : lengths[i]] = dataset[i][0]
+            t[r, : lengths[i]] = dataset[i][1]
+            lab[r, : lengths[i]] = dataset[i][2]
+        return nd.array(s), nd.array(t), nd.array(lab)
+
+    def unbucketed_epochs(ep):
+        order = np.random.RandomState(args.seed + 1 + ep).permutation(
+            len(dataset))
+        for i in range(0, len(order) - args.batch_size + 1,
+                       args.batch_size):
+            yield pad_batch(order[i: i + args.batch_size].tolist())
+
+    step_u = make_step()
+    unbucketed = run_varlen_mode(step_u, unbucketed_epochs,
+                                 tokens_per_epoch, epochs=args.epochs)
+
+    # ---- bucketed: FixedBucketSampler + PadToBucket, every bucket
+    # signature compiled up front by TrainStep.warmup
+    sampler = FixedBucketSampler(
+        lengths, args.batch_size, num_buckets=args.buckets,
+        ratio=args.ratio, shuffle=True, last_batch="pad")
+    batchify = PadToBucket(sampler.bucket_keys, pad_val=0,
+                           label_pad_val=[0, -1], valid_length=False)
+    loader = DataLoader(dataset, batch_sampler=sampler,
+                        batchify_fn=batchify)
+    step_b = make_step()
+    warm_sigs = [
+        (((bs, key), "int32"), ((bs, key), "int32"), ((bs, key), "int32"))
+        for bs, key in sampler.signatures()
+    ]
+    t0 = time.perf_counter()
+    warm_compiles = step_b.warmup(warm_sigs)
+    warmup_s = time.perf_counter() - t0
+
+    def bucketed_epochs(ep):
+        np.random.seed(args.seed + 100 + ep)  # sampler shuffle per epoch
+        yield from iter(loader)
+
+    bucketed = run_varlen_mode(step_b, bucketed_epochs, tokens_per_epoch,
+                               epochs=args.epochs)
+    bucketed["warmup_compiles"] = warm_compiles
+    bucketed["warmup_s"] = round(warmup_s, 3)
+    bucketed["n_buckets"] = len(sampler.bucket_keys)
+
+    row = {
+        "metric": "transformer_varlen_bucketed_tokens_per_sec",
+        "value": bucketed["steady_tokens_per_sec"],
+        "unit": "tokens/sec",
+        "unbucketed": unbucketed,
+        "bucketed": bucketed,
+        "compile_cache": compile_cache.cache_stats(),
+    }
+    print(json.dumps(row))
+    print(f"unbucketed: {unbucketed['signatures_total']} compiled programs "
+          f"({unbucketed['signatures_per_epoch']} per epoch), "
+          f"{unbucketed['steady_tokens_per_sec']} tok/s steady")
+    print(f"bucketed:   {bucketed['signatures_total']} compiled programs "
+          f"(warmup {warm_compiles} <= {bucketed['n_buckets']} buckets), "
+          f"{bucketed['steady_state_recompiles']} steady-state recompiles, "
+          f"{bucketed['steady_tokens_per_sec']} tok/s steady")
+    cache = compile_cache.cache_stats()
+    if cache["enabled"]:
+        print(f"persistent cache: dir={cache['dir']} hits={cache['hits']} "
+              f"misses={cache['misses']}")
+    ok = (bucketed["steady_state_recompiles"] == 0
+          and bucketed["signatures_total"] <= len(warm_sigs))
+    if not ok:
+        print("FAIL: bucketed mode recompiled in steady state",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--variable-length", action="store_true",
+                    help="run the bucketed-vs-unbucketed compile ablation")
+    ap.add_argument("--buckets", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=192)
+    ap.add_argument("--min-len", type=int, default=8)
+    ap.add_argument("--max-len", type=int, default=48)
+    ap.add_argument("--vocab", type=int, default=1000)
+    ap.add_argument("--units", type=int, default=32)
+    ap.add_argument("--layers", type=int, default=1)
+    ap.add_argument("--ratio", type=float, default=0.5,
+                    help="FixedBucketSampler batch-scaling knob")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.variable_length:
+        return variable_length_main(args)
+    return fixed_main()
+
+
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -115,6 +115,38 @@ def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
         return 0.0
 
 
+def run_varlen_mode(step, epoch_batches, tokens_per_epoch, epochs=2):
+    """Drive a variable-length workload through a ``TrainStep`` and
+    account its compiles exactly.
+
+    ``epoch_batches(epoch)`` yields ``(input0, ..., label)`` batch tuples;
+    ``tokens_per_epoch`` is the valid-token count of one full pass. The
+    step's ``compile_guard`` counts one signature per compiled program, so
+    ``signatures_per_epoch`` is the compile count each epoch paid and the
+    LAST epoch's rate is the steady-state figure (first epochs absorb the
+    compiles unless the caller warmed up first)."""
+    guard = step.compile_guard
+    sig_marks = [guard.signatures]
+    tps = None
+    for ep in range(epochs):
+        t0 = time.perf_counter()
+        last = None
+        for batch in epoch_batches(ep):
+            last = step(*batch)
+        if last is not None:
+            float(last.asscalar())  # retire the epoch's async dispatches
+        elapsed = time.perf_counter() - t0
+        sig_marks.append(guard.signatures)
+        tps = tokens_per_epoch / elapsed
+    return {
+        "signatures_per_epoch": [
+            sig_marks[i + 1] - sig_marks[i] for i in range(epochs)],
+        "signatures_total": sig_marks[-1],
+        "steady_state_recompiles": guard.steady_state_recompiles,
+        "steady_tokens_per_sec": round(tps, 1),
+    }
+
+
 def device_us(fn, args, iters=6):
     """Per-call DEVICE op time (us) by summing the profiler's device-lane
     events — the round-4 verdict's fix for opperf: wall columns on the

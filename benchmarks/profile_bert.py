@@ -4,11 +4,21 @@ Times step variants to attribute the gap to the 45%-MFU ceiling:
 baseline / no-dropout / rbg-prng / no-vocab-head / dense-attention /
 batch-64. Run on the real chip: ``python -m benchmarks.profile_bert``.
 Writes a row per variant; use alongside ``jax.profiler`` traces.
+
+``--variable-length`` runs the shape-stability ablation instead: the
+same variable-length token stream fed unbucketed (pad to batch max, one
+compiled program per distinct length) vs bucketed
+(``FixedBucketSampler`` + pad-to-bucket + ``TrainStep.warmup``), with
+compile counts from the step's ``compile_guard`` and steady-state
+tokens/sec. Size the model down for CPU runs (``--units 64 --layers 2
+--vocab 1000``).
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import sys
 import time
 
 import numpy as np
@@ -87,11 +97,133 @@ VARIANTS = {
 }
 
 
-def main():
+# ------------------------------------------------------ variable-length mode
+def variable_length_main(args):
+    import jax
+    import jax.numpy as jnp
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import compile_cache, optimizer as opt
+    from mxnet_tpu.gluon.data import FixedBucketSampler
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.parallel import TrainStep
+
+    from .common import run_varlen_mode
+
+    V = args.vocab
+    rng = np.random.RandomState(args.seed)
+    lengths = rng.randint(args.min_len, args.max_len + 1,
+                          size=args.samples).tolist()
+    seqs = [rng.randint(1, V, size=n).astype("int32") for n in lengths]
+    tokens_per_epoch = int(sum(lengths))
+
+    def make_step():
+        net = BERTModel(
+            vocab_size=V, units=args.units, hidden_size=args.units * 4,
+            num_layers=args.layers, num_heads=max(1, args.units // 32),
+            max_length=args.max_len + 8, dropout=0.0)
+        net.initialize()
+        net._probe_shapes(mx.nd.zeros((2, 8), dtype="int32"))
+        word_w = net.word_embed.weight
+
+        def loss_fn(seq_out, pooled, label):
+            # masked MLM-style CE over valid (label != -1) tokens only,
+            # reduced per row then across rows (pad columns contribute
+            # exact zeros -> padded == unpadded bit-identically)
+            w = word_w.data().data
+            x = seq_out.data.astype(jnp.float32)
+            logits = x @ w.T.astype(jnp.float32)
+            y = label.data
+            mask = y >= 0
+            safe = jnp.where(mask, y, 0).astype(jnp.int32)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       axis=-1)[..., 0]
+            row = jnp.where(mask, nll, 0.0).sum(axis=-1)
+            return NDArray(row.sum() / mask.sum())
+
+        return TrainStep(net, loss_fn, opt.AdamW(learning_rate=1e-4))
+
+    def pad_batch(idxs, to_len):
+        ids = np.zeros((len(idxs), to_len), "int32")
+        lab = np.full((len(idxs), to_len), -1, "int32")
+        for r, i in enumerate(idxs):
+            ids[r, : lengths[i]] = seqs[i]
+            lab[r, : lengths[i]] = seqs[i]
+        return mx.nd.array(ids), mx.nd.array(lab)
+
+    def unbucketed_epochs(ep):
+        order = np.random.RandomState(args.seed + 1 + ep).permutation(
+            len(seqs))
+        for i in range(0, len(order) - args.batch_size + 1,
+                       args.batch_size):
+            idxs = order[i: i + args.batch_size].tolist()
+            yield pad_batch(idxs, max(lengths[i] for i in idxs))
+
+    step_u = make_step()
+    unbucketed = run_varlen_mode(step_u, unbucketed_epochs,
+                                 tokens_per_epoch, epochs=args.epochs)
+
+    sampler = FixedBucketSampler(
+        lengths, args.batch_size, num_buckets=args.buckets,
+        ratio=args.ratio, shuffle=True, last_batch="pad")
+
+    def bucketed_epochs(ep):
+        np.random.seed(args.seed + 100 + ep)
+        for idxs in sampler:
+            ml = max(lengths[i] for i in idxs)
+            key = next(k for k in sampler.bucket_keys if ml <= k)
+            yield pad_batch(idxs, key)
+
+    step_b = make_step()
+    warm_sigs = [(((bs, key), "int32"), ((bs, key), "int32"))
+                 for bs, key in sampler.signatures()]
+    warm_compiles = step_b.warmup(warm_sigs)
+    bucketed = run_varlen_mode(step_b, bucketed_epochs, tokens_per_epoch,
+                               epochs=args.epochs)
+    bucketed["warmup_compiles"] = warm_compiles
+    bucketed["n_buckets"] = len(sampler.bucket_keys)
+
+    row = {
+        "metric": "bert_varlen_bucketed_tokens_per_sec",
+        "value": bucketed["steady_tokens_per_sec"],
+        "unit": "tokens/sec",
+        "unbucketed": unbucketed,
+        "bucketed": bucketed,
+        "compile_cache": compile_cache.cache_stats(),
+    }
+    print(json.dumps(row))
+    print(f"unbucketed: {unbucketed['signatures_total']} programs "
+          f"({unbucketed['signatures_per_epoch']}/epoch), "
+          f"{unbucketed['steady_tokens_per_sec']} tok/s")
+    print(f"bucketed:   {bucketed['signatures_total']} programs "
+          f"(warmup {warm_compiles} <= {bucketed['n_buckets']} buckets), "
+          f"{bucketed['steady_state_recompiles']} steady recompiles, "
+          f"{bucketed['steady_tokens_per_sec']} tok/s")
+    return 0 if bucketed["steady_state_recompiles"] == 0 else 1
+
+
+def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--variants", nargs="*", default=list(VARIANTS))
     ap.add_argument("--rbg", action="store_true", help="use rbg PRNG impl")
-    args = ap.parse_args()
+    ap.add_argument("--variable-length", action="store_true",
+                    help="bucketed-vs-unbucketed compile ablation")
+    ap.add_argument("--buckets", type=int, default=4)
+    ap.add_argument("--batch-size", type=int, default=8)
+    ap.add_argument("--samples", type=int, default=128)
+    ap.add_argument("--min-len", type=int, default=16)
+    ap.add_argument("--max-len", type=int, default=128)
+    ap.add_argument("--vocab", type=int, default=30522)
+    ap.add_argument("--units", type=int, default=768)
+    ap.add_argument("--layers", type=int, default=12)
+    ap.add_argument("--ratio", type=float, default=0.5)
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+    if args.variable_length:
+        return variable_length_main(args)
     if args.rbg:
         import jax
 
@@ -100,7 +232,8 @@ def main():
         dt, tps = build_and_time(**VARIANTS[name])
         print(f"{name:18s} step={dt*1e3:7.2f} ms  tokens/s={tps:10.0f}",
               flush=True)
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

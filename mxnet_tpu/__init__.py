@@ -69,6 +69,7 @@ for _mod, _aliases in [
     ("io", ()),
     ("image", ()),
     ("telemetry", ()),
+    ("compile_cache", ()),
     ("profiler", ()),
     ("amp", ()),
     ("runtime", ()),
@@ -96,3 +97,7 @@ if "initializer" in globals():
     init = initializer.init  # mx.init alias namespace
 if "optimizer" in globals():
     lr_scheduler = optimizer.lr_scheduler
+if "compile_cache" in globals():
+    # persistent XLA compilation cache: default-on under the convention
+    # dir; MXTPU_COMPILE_CACHE_DIR pins/paranoid-persists/disables
+    compile_cache.setup()

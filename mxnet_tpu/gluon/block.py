@@ -33,8 +33,10 @@ import numpy as _np
 from ..base import MXNetError
 from ..context import current_context
 from ..ndarray.ndarray import NDArray
+from .. import compile_cache as _cc
 from .. import ndarray as nd_namespace
 from .. import random as _random
+from .. import telemetry as _tel
 from .parameter import (
     DeferredInitializationError,
     Parameter,
@@ -441,6 +443,12 @@ def _is_nd(x):
     return isinstance(x, NDArray)
 
 
+def _mode_summary(training, recording, flat_args):
+    return (f"{'train' if training else 'eval'} "
+            f"{'vjp' if recording else 'fwd'} "
+            f"{_cc.aval_summary(flat_args)}")
+
+
 class CachedOp:
     """Stages a Block's forward through ``jax.jit`` (reference:
     ``src/imperative/cached_op.cc``; ``static_alloc``/``static_shape`` map to
@@ -453,6 +461,11 @@ class CachedOp:
         self._flags = dict(flags)
         self._param_list = None  # ordered [(name, Parameter)]
         self._staged = {}  # (training, in_treedef) -> _StagedHolder
+        # each distinct (mode, structure, operand-aval) signature is one
+        # compiled program; the guard counts them exactly and alarms on
+        # post-warmup shape churn (compile_cache.RecompileGuard)
+        self._guard = _cc.RecompileGuard(
+            f"CachedOp({type(block).__name__})")
 
     def _collect(self):
         if self._param_list is None:
@@ -529,9 +542,16 @@ class CachedOp:
         holder.last_used = CachedOp._call_seq
 
         all_in_nds = param_nds + input_nds
-        if autograd.is_recording() and any(
+        recording = autograd.is_recording() and any(
             autograd._is_tracked(a) for a in all_in_nds
-        ):
+        )
+        # forward and recorded (vjp) dispatches compile distinct programs
+        # — track them as distinct signatures
+        self._guard.observe(
+            (training, recording, in_treedef,
+             tuple((a.shape, a.dtype.name) for a in flat_args)),
+            lambda: _mode_summary(training, recording, flat_args))
+        if recording:
             outs_flat, vjp_fn = jax.vjp(holder.fn, *flat_args)
             # untracked inputs (e.g. labels) and the PRNG key become None
             node_inputs = [
@@ -553,6 +573,75 @@ class CachedOp:
         for p_aux, val in zip(holder.aux_params, aux_vals):
             p_aux._data._rebind(val.data)
         return jax.tree.unflatten(holder.out_treedef, primary)
+
+    # --------------------------------------------------------------- warmup
+    def warmup(self, *example_sets, training=None, backward=False):
+        """AOT-compile the staged program for each input signature.
+
+        Each ``example_set`` is a sequence of per-input specs (positional
+        inputs only) — an array, ``jax.ShapeDtypeStruct``, or ``(shape,
+        dtype)`` pair::
+
+            op.warmup((( (bs, key), "int32"),), (((bs2, key2), "int32"),))
+
+        Runs the real jitted forward on zeros (parameters read, never
+        written — aux state like BN running stats is NOT rebound), so the
+        jit dispatch cache is hot and the first real call of each shape
+        pays nothing. ``backward=True`` additionally compiles the
+        recorded (vjp) program the autograd path uses. ``training``
+        selects the staged mode and defaults to ``backward`` — a
+        training loop records under train mode, so warm THAT program.
+        Afterwards the guard is steady: new shapes count as
+        ``compile/steady_state_recompiles`` (``MXTPU_RECOMPILE_LIMIT``).
+        Returns the number of freshly compiled programs."""
+        if training is None:
+            training = bool(backward)
+        compiled = 0
+        reg = _tel.registry()
+        for examples in example_sets:
+            specs = [_cc.normalize_spec(s) for s in examples]
+            inputs = tuple(NDArray(jnp.zeros(sh, dt)) for sh, dt in specs)
+            input_nds, in_treedef = jax.tree.flatten(
+                (inputs, {}), is_leaf=_is_nd)
+            cache_key = (training, in_treedef)
+            holder = self._staged.get(cache_key)
+            if holder is None:
+                holder = self._make_staged(training, in_treedef)
+                self._staged[cache_key] = holder
+            params = [p for _, p in self._collect()]
+            key = _random.next_key()
+            flat_args = [p.data().data for p in params] + \
+                [n.data for n in input_nds] + [key]
+            avals = tuple((a.shape, a.dtype.name) for a in flat_args)
+            holder.last_flat = [
+                jax.ShapeDtypeStruct(a.shape, a.dtype) for a in flat_args
+            ]
+            CachedOp._call_seq += 1
+            holder.last_used = CachedOp._call_seq
+            if self._guard.observe(
+                    (training, False, in_treedef, avals),
+                    lambda: _mode_summary(training, False, flat_args)):
+                compiled += 1
+                reg.counter("compile/warmup_compiles").inc()
+                jax.block_until_ready(holder.fn(*flat_args))
+            if backward and self._guard.observe(
+                    (training, True, in_treedef, avals),
+                    lambda: _mode_summary(training, True, flat_args)):
+                compiled += 1
+                reg.counter("compile/warmup_compiles").inc()
+                outs, vjp_fn = jax.vjp(holder.fn, *flat_args)
+                cts = tuple(jnp.zeros(o.shape, o.dtype) for o in outs)
+                jax.block_until_ready(vjp_fn(cts))
+        self._guard.mark_steady()
+        return compiled
+
+    def cache_info(self) -> dict:
+        """Staged-cache summary: signatures held (one compiled program
+        each), per-signature aval rendering, use counts, recency — plus
+        the count of staged (mode, structure) holders."""
+        info = self._guard.info()
+        info["staged_programs"] = len(self._staged)
+        return info
 
 
 # ---------------------------------------------------------------- HybridBlock

@@ -308,17 +308,29 @@ class Estimator:
     # ------------------------------------------------------------------ fit
     def fit(self, train_data, val_data=None, epochs=None,
             event_handlers=None, batches=None, batch_size=None,
-            prefetch=None):
+            prefetch=None, warmup=False):
         """Drive training epochs. ``prefetch=N`` (or ``True``) is the
         opt-in async device feed: each epoch's batches are pulled and
         device_put by a background thread holding up to N staged batches
         (``gluon.data.prefetch.prefetch_to_device``), so the next batch's
-        host->device transfer overlaps the current step."""
+        host->device transfer overlaps the current step.
+
+        ``warmup=True`` compiles every batch-shape signature BEFORE the
+        timed epochs: the loader is pre-scanned (bounded by
+        ``MXTPU_WARMUP_SCAN`` batches) and one forward/backward runs per
+        previously-unseen ``(data, label)`` shape, so a bucketed loader
+        (``gluon.data.bucketing``) enters epoch 0 with all of its
+        programs compiled and zero steady-state recompiles. Pass an
+        iterable of ``((data_shape, dtype), (label_shape, dtype))`` pairs
+        instead to warm explicit signatures on zero batches (note: aux
+        state such as BatchNorm running stats sees the warmup passes)."""
         if epochs is None and batches is None:
             raise MXNetError("fit needs epochs or batches")
         handlers = self._prepare_handlers(event_handlers, val_data, epochs,
                                           batches)
         self.stop_training = False
+        if warmup:
+            self._warmup(train_data, warmup)
 
         _dispatch(handlers, "train_begin", self)
         epoch = 0
@@ -368,6 +380,48 @@ class Estimator:
                 train_data.reset()
         _dispatch(handlers, "train_end", self)
         return self
+
+    # --------------------------------------------------------------- warmup
+    def _warmup(self, train_data, warmup):
+        """AOT-compile the train path for every batch signature (see
+        ``fit``). Parameters receive no optimizer step — only gradients
+        (overwritten by the first real backward) and aux state move."""
+        from ...base import get_env
+        from ... import nd
+
+        def _shape_sig(x):
+            return (tuple(x.shape), str(getattr(x, "dtype", "?")))
+
+        with (_tel.span("estimator.warmup") if _tel._ENABLED
+              else _tel.NULL_SPAN):
+            if warmup is True:
+                seen = set()
+                cap = get_env("MXTPU_WARMUP_SCAN", 64, int)
+                for i, batch in enumerate(train_data):
+                    if i >= cap:
+                        break
+                    data, label = _split_batch(batch)
+                    sig = (_shape_sig(data), _shape_sig(label))
+                    if sig in seen:
+                        continue
+                    seen.add(sig)
+                    self._warm_one(data, label)
+            else:
+                for data_spec, label_spec in warmup:
+                    (dshape, ddt), (lshape, ldt) = data_spec, label_spec
+                    self._warm_one(nd.zeros(dshape, dtype=ddt),
+                                   nd.zeros(lshape, dtype=ldt))
+        # hybridized nets: further new shapes are accidental recompiles
+        co = getattr(self.net, "_cached_op", None)
+        if co is not None:
+            co._guard.mark_steady()
+
+    def _warm_one(self, data, label):
+        _tel.registry().counter("compile/warmup_compiles").inc()
+        with autograd.record():
+            pred = self.net(data)
+            L = self.loss(pred, label)
+        L.backward()
 
     @staticmethod
     def _epoch_iter(train_data, prefetch):

@@ -4,9 +4,10 @@ from .dataset import *  # noqa: F401,F403
 from .sampler import *  # noqa: F401,F403
 from .dataloader import *  # noqa: F401,F403
 from .prefetch import *  # noqa: F401,F403
+from .bucketing import *  # noqa: F401,F403
 from . import vision  # noqa: F401
 
-from . import dataset, sampler, dataloader, prefetch
+from . import dataset, sampler, dataloader, prefetch, bucketing
 
 __all__ = (dataset.__all__ + sampler.__all__ + dataloader.__all__ +
-           prefetch.__all__ + ["vision"])
+           prefetch.__all__ + bucketing.__all__ + ["vision"])

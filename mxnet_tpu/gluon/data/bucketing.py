@@ -1,0 +1,263 @@
+"""Sequence bucketing for shape-stable batches (GluonNLP analogue).
+
+A variable-length NLP feed is shape poison on an XLA backend: every
+distinct ``(batch, seq_len)`` is a separate traced-and-compiled program.
+The GluonNLP stack solved the throughput half with ``FixedBucketSampler``
+(length-sorted buckets, bigger batches for shorter sequences) and the
+``Pad`` batchify; here the same pair ALSO solves the compile half, because
+padding to a fixed menu of bucket boundaries bounds the signature set the
+training step ever sees:
+
+    lengths = [len(s) for s in dataset]
+    sampler = FixedBucketSampler(lengths, batch_size=32, num_buckets=8,
+                                 ratio=0.5, shuffle=True, last_batch="pad")
+    loader = DataLoader(dataset, batch_sampler=sampler,
+                        batchify_fn=PadToBucket(sampler.bucket_keys),
+                        prefetch_to_device=2)
+    step.warmup(...)          # compile every bucket signature up front
+    for tokens, valid_length, label in loader:   # shape-stable batches
+        ...
+
+``PadToBucket`` pads each batch to the smallest bucket boundary that
+fits and emits a ``valid_length`` mask, so losses/attention can ignore
+the pad tail; prefetch then stages already-padded, shape-stable batches.
+"""
+
+from __future__ import annotations
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray import array as nd_array
+from ...ndarray.ndarray import NDArray
+from .sampler import Sampler
+
+__all__ = ["FixedBucketSampler", "PadToBucket"]
+
+
+def _even_bucket_keys(lengths, num_buckets):
+    """Constant-width bucket boundaries spanning [min_len, max_len],
+    deduplicated ascending, always ending exactly at max_len."""
+    lo, hi = int(min(lengths)), int(max(lengths))
+    if num_buckets <= 1 or lo == hi:
+        return [hi]
+    step = (hi - lo) / num_buckets
+    keys = sorted({int(round(lo + step * (i + 1)))
+                   for i in range(num_buckets)})
+    keys[-1] = hi
+    return sorted(set(keys))
+
+
+class FixedBucketSampler(Sampler):
+    """Batch sampler: assign each sample to the smallest bucket whose
+    boundary fits its length, batch within buckets.
+
+    Parameters
+    ----------
+    lengths : sequence of int — per-sample sequence lengths.
+    batch_size : int — batch size of the LONGEST bucket.
+    num_buckets : int — number of constant-width buckets (ignored when
+        ``bucket_keys`` is given).
+    bucket_keys : explicit ascending bucket boundaries (optional).
+    ratio : float in [0, 1] — the GluonNLP batch-scaling knob: bucket
+        ``i`` gets ``max(batch_size, batch_size * ratio * max_key /
+        key_i)`` samples, so shorter buckets run bigger batches and
+        tokens-per-batch stays roughly constant. 0 disables scaling.
+    shuffle : shuffle samples within buckets and the emitted batch order
+        (driven by numpy's global RNG — seed for determinism).
+    last_batch : what to do with a bucket's ragged final batch:
+        ``'keep'`` (emit smaller batch — a fresh shape signature),
+        ``'discard'`` (drop it), or ``'pad'`` (wrap the bucket's own
+        indices to fill — shape-stable, slightly oversamples).
+    """
+
+    def __init__(self, lengths, batch_size, num_buckets=10, bucket_keys=None,
+                 ratio=0.0, shuffle=False, last_batch="keep"):
+        self._lengths = [int(l) for l in lengths]
+        if not self._lengths:
+            raise MXNetError("FixedBucketSampler needs at least one length")
+        if last_batch not in ("keep", "discard", "pad"):
+            raise MXNetError(
+                f"last_batch must be keep/discard/pad, got {last_batch!r}")
+        if not 0.0 <= ratio <= 1.0:
+            raise MXNetError(f"ratio must be in [0, 1], got {ratio}")
+        if bucket_keys is None:
+            bucket_keys = _even_bucket_keys(self._lengths, num_buckets)
+        else:
+            bucket_keys = sorted(int(k) for k in bucket_keys)
+        self.bucket_keys = bucket_keys
+        max_key = bucket_keys[-1]
+        self.batch_sizes = [
+            max(int(batch_size),
+                int(batch_size * ratio * max_key / key)) if ratio > 0
+            else int(batch_size)
+            for key in bucket_keys
+        ]
+        self._shuffle = bool(shuffle)
+        self._last_batch = last_batch
+        # bucket membership (index lists), one per key, in key order
+        self._buckets = [[] for _ in bucket_keys]
+        for i, length in enumerate(self._lengths):
+            for b, key in enumerate(bucket_keys):
+                if length <= key:
+                    self._buckets[b].append(i)
+                    break
+            else:
+                raise MXNetError(
+                    f"sample {i} has length {length} > largest bucket key "
+                    f"{max_key}; extend bucket_keys")
+
+    def _bucket_batches(self, indices, size):
+        batches = [indices[i:i + size]
+                   for i in range(0, len(indices), size)]
+        if batches and len(batches[-1]) < size:
+            if self._last_batch == "discard":
+                batches.pop()
+            elif self._last_batch == "pad":
+                # wrap the bucket's own indices to fill: shape-stable at
+                # the cost of oversampling a few sequences
+                short = batches[-1]
+                need = size - len(short)
+                filler = (indices * ((need // len(indices)) + 1))[:need]
+                batches[-1] = short + filler
+        return batches
+
+    def __iter__(self):
+        all_batches = []
+        for bucket, size in zip(self._buckets, self.batch_sizes):
+            if not bucket:
+                continue
+            indices = list(bucket)
+            if self._shuffle:
+                _np.random.shuffle(indices)
+            all_batches.extend(self._bucket_batches(indices, size))
+        if self._shuffle:
+            _np.random.shuffle(all_batches)
+        return iter(all_batches)
+
+    def __len__(self):
+        n = 0
+        for bucket, size in zip(self._buckets, self.batch_sizes):
+            if not bucket:
+                continue
+            if self._last_batch == "discard":
+                n += len(bucket) // size
+            else:
+                n += (len(bucket) + size - 1) // size
+        return n
+
+    def signatures(self):
+        """The exact ``(batch_size, bucket_key)`` shape menu this sampler
+        emits — the warmup contract: compile one program per entry and the
+        steady-state loop never compiles again."""
+        sigs = []
+        for bucket, size, key in zip(self._buckets, self.batch_sizes,
+                                     self.bucket_keys):
+            if not bucket:
+                continue
+            full, rem = divmod(len(bucket), size)
+            if full and (size, key) not in sigs:
+                sigs.append((size, key))
+            if rem and self._last_batch == "keep" \
+                    and (rem, key) not in sigs:
+                sigs.append((rem, key))
+            if rem and self._last_batch == "pad" and not full \
+                    and (size, key) not in sigs:
+                sigs.append((size, key))
+        return sigs
+
+    def stats(self) -> str:
+        """Human-readable bucket occupancy (GluonNLP's ``__repr__``)."""
+        lines = [f"FixedBucketSampler: {len(self)} batches, "
+                 f"last_batch={self._last_batch}"]
+        for bucket, size, key in zip(self._buckets, self.batch_sizes,
+                                     self.bucket_keys):
+            lines.append(
+                f"  key<={key:<6d} batch_size={size:<5d} "
+                f"samples={len(bucket)}")
+        return "\n".join(lines)
+
+
+class PadToBucket:
+    """Batchify: pad each sequence to the smallest bucket boundary that
+    fits the batch, emit a ``valid_length`` vector.
+
+    Sample forms accepted:
+
+    - a bare sequence (1-D list/array) -> ``(data, valid_length)``
+    - a tuple ``(seq, *rest)`` -> ``(data, valid_length, *rest_batched)``
+      where each ``rest`` element is padded alongside ``seq`` when it is
+      per-token (same leading length), else plainly stacked (scalar or
+      fixed-shape labels).
+
+    ``pad_val`` fills the tail of ``seq``; ``label_pad_val`` fills
+    per-token rest fields (mask-friendly default -1, so a masked loss can
+    recover the pad mask from the label alone) — pass a sequence to give
+    each rest field its own pad value (e.g. ``[0, -1]`` for
+    ``(src, tgt, label)`` samples). ``valid_length=False`` drops the
+    mask vector so the batch structure matches a step's exact
+    ``(input0, ..., label)`` contract. Outputs are NDArrays by default;
+    pass ``numpy=True`` inside forked DataLoader workers (device arrays
+    are forbidden there — the parent converts).
+    """
+
+    def __init__(self, bucket_keys, pad_val=0, label_pad_val=-1,
+                 valid_length=True, numpy=False):
+        self.bucket_keys = sorted(int(k) for k in bucket_keys)
+        self.pad_val = pad_val
+        self.label_pad_val = label_pad_val
+        self._valid_length = bool(valid_length)
+        self._numpy = bool(numpy)
+
+    def _key_for(self, max_len):
+        for k in self.bucket_keys:
+            if max_len <= k:
+                return k
+        raise MXNetError(
+            f"batch has length {max_len} > largest bucket key "
+            f"{self.bucket_keys[-1]}; extend bucket_keys")
+
+    @staticmethod
+    def _pad_one(seq, key, pad_val):
+        a = _np.asarray(seq)
+        out_shape = (key,) + a.shape[1:]
+        out = _np.full(out_shape, pad_val, dtype=a.dtype)
+        out[: a.shape[0]] = a
+        return out
+
+    def _wrap(self, a):
+        return a if self._numpy else nd_array(a)
+
+    def __call__(self, samples):
+        if not samples:
+            raise MXNetError("PadToBucket got an empty batch")
+        tupled = isinstance(samples[0], (tuple, list)) and not isinstance(
+            samples[0], _np.ndarray)
+        seqs = [s[0] if tupled else s for s in samples]
+        seqs = [s.asnumpy() if isinstance(s, NDArray) else _np.asarray(s)
+                for s in seqs]
+        lengths = [s.shape[0] for s in seqs]
+        key = self._key_for(max(lengths))
+        data = _np.stack(
+            [self._pad_one(s, key, self.pad_val) for s in seqs])
+        out = [self._wrap(data)]
+        if self._valid_length:
+            out.append(self._wrap(_np.asarray(lengths, dtype=_np.int32)))
+        if tupled:
+            nfields = len(samples[0])
+            for f in range(1, nfields):
+                field = [s[f] for s in samples]
+                field = [x.asnumpy() if isinstance(x, NDArray)
+                         else _np.asarray(x) for x in field]
+                pv = self.label_pad_val
+                if isinstance(pv, (list, tuple)):
+                    pv = pv[f - 1]
+                per_token = all(
+                    x.ndim >= 1 and x.shape[0] == n
+                    for x, n in zip(field, lengths))
+                if per_token:
+                    out.append(self._wrap(_np.stack([
+                        self._pad_one(x, key, pv) for x in field])))
+                else:
+                    out.append(self._wrap(_np.stack(field)))
+        return out if tupled else tuple(out)

@@ -237,6 +237,27 @@ def _resolve_strict(d):
     return d
 
 
+import threading as _tls_threading
+
+_FLUSH_TLS = _tls_threading.local()
+
+
+def _flushing_queues() -> set:
+    """ids of the _BulkQueues THIS thread is currently flushing (the
+    re-entrance guard for mutual cross-queue dependencies)."""
+    s = getattr(_FLUSH_TLS, "s", None)
+    if s is None:
+        s = _FLUSH_TLS.s = set()
+    return s
+
+
+def _entry_done(e) -> bool:
+    """True when every output of the entry already carries a value or an
+    error (resolved entry-by-entry during a re-entrant flush)."""
+    return all(p.value is not None or p.error is not None
+               for p in e.pendings)
+
+
 def _lazy_data(a):
     """Operand capture WITHOUT forcing the queue: a live _Pending stays a
     slot reference; everything else is its concrete value."""
@@ -275,25 +296,95 @@ class _BulkQueue:
         return outs, multi
 
     def flush(self):
-        # resolve cross-thread dependencies BEFORE taking our own lock:
-        # flushing a foreign queue while holding ours could ABBA-deadlock
-        # two threads exchanging NDArrays. Our entries list is only ever
-        # appended by this thread, so scanning it lock-free is safe.
-        for e in self.entries:
-            for d in e.datas:
-                if type(d) is _Pending and d.value is None \
-                        and d.error is None and d.queue is not self:
-                    d.queue.flush()
-        with self._lock:
-            if _tel._ENABLED and self.entries:
-                with _tel.span("imperative.bulk_flush",
-                               {"ops": len(self.entries)}):
+        # re-entrance guard (ADVICE r5): two queues holding mutually
+        # dependent pendings (A reads B's, B reads A's) would otherwise
+        # recurse A.flush -> B.flush -> A.flush ... to RecursionError —
+        # the per-queue RLock is re-entrant, so nothing breaks the cycle.
+        # The guard is PER THREAD (a set of queues this thread is already
+        # flushing): a concurrent foreign-thread flush must still block
+        # on the lock, not skip.
+        flushing = _flushing_queues()
+        if id(self) in flushing:
+            return
+        flushing.add(id(self))
+        try:
+            # resolve cross-thread dependencies BEFORE taking our own
+            # lock: flushing a foreign queue while holding ours could
+            # ABBA-deadlock two threads exchanging NDArrays. Our entries
+            # list is only ever appended by this thread, so scanning it
+            # lock-free is safe.
+            for e in self.entries:
+                for d in e.datas:
+                    if type(d) is _Pending and d.value is None \
+                            and d.error is None and d.queue is not self:
+                        d.queue.flush()
+                        if d.value is None and d.error is None:
+                            # the producing queue's flush was re-entrant
+                            # (mutual cross-queue dependency): resolve
+                            # just the producing entry, following the
+                            # dataflow DAG entry-by-entry — data deps
+                            # cannot cycle, so this terminates
+                            d.queue._resolve_entry_of(d)
+            with self._lock:
+                if _tel._ENABLED and self.entries:
+                    with _tel.span("imperative.bulk_flush",
+                                   {"ops": len(self.entries)}):
+                        self._flush_locked()
+                else:
                     self._flush_locked()
-            else:
-                self._flush_locked()
+        finally:
+            flushing.discard(id(self))
+
+    def _resolve_entry_of(self, p):
+        """Execute ONLY the entry producing pending ``p`` (plus, by
+        recursion, its unresolved operands). Used when this queue's
+        whole-queue flush is already on the caller's stack; the executed
+        entries stay in ``entries`` and are skipped by ``_flush_locked``
+        once their pendings carry values."""
+        for e in self.entries:
+            if any(x is p for x in e.pendings):
+                if not _entry_done(e):
+                    self._run_entry(e)
+                return
+
+    def _run_entry(self, e):
+        """Eagerly execute one queued entry through the per-op jit cache
+        (the ``_flush_fallback`` recipe for a single entry)."""
+        args = []
+        for d in e.datas:
+            if type(d) is _Pending and d.value is None and d.error is None:
+                if d.queue is self:
+                    self._resolve_entry_of(d)
+                else:
+                    d.queue.flush()
+                    if d.value is None and d.error is None:
+                        d.queue._resolve_entry_of(d)
+            args.append(_resolve_strict(d))
+        try:
+            try:
+                outs = _fwd_jit(e.key, e.fn)(*args)
+            except Exception:
+                outs = e.fn(*args)
+                _EAGER_FWD_CACHE[e.key] = _FAILED
+        except Exception as exc:  # noqa: BLE001 - recorded per pending
+            for p in e.pendings:
+                p.error = exc
+            raise
+        outs_t = outs if isinstance(outs, (tuple, list)) else (outs,)
+        for chunk, p, v in zip(e.chunks, e.pendings, outs_t):
+            p.value = v
+            if chunk.data is p:
+                chunk.data = v
+                chunk.version += 1
+        if e.node is not None:
+            e.node.xs = tuple(args)
 
     def _flush_locked(self):
         entries, self.entries = self.entries, []
+        # entries already executed individually by _resolve_entry_of
+        # (re-entrant cross-queue resolution) have their values written
+        # back; only the rest form the fused segment
+        entries = [e for e in entries if not _entry_done(e)]
         if not entries:
             return
         slot_of = {}

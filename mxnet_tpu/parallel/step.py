@@ -26,7 +26,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from .. import compile_cache as _cc
 from .. import random as _random
+from .. import telemetry as _tel
 from .. import optimizer as _opt
 from ..ops import optimizer_op as _fused
 
@@ -311,6 +313,11 @@ class TrainStep:
         self._lr_host = None
         self._rescale_host = None
         self._last_avals = None
+        # every distinct (batch, label) aval signature is one compiled
+        # step program; the guard is the exact compile counter and the
+        # post-warmup shape-churn alarm (compile_cache.RecompileGuard)
+        self.compile_guard = _cc.RecompileGuard(
+            f"TrainStep({type(net).__name__})")
 
         self._step_fn = self._build(donate)
 
@@ -521,6 +528,79 @@ class TrainStep:
         batch, label = self._stage(tuple(batch_and_label))
         return DeviceBatch(batch, label, self)
 
+    # -------------------------------------------------------------- warmup
+    def warmup(self, signatures):
+        """AOT-compile one step program per batch signature, moving every
+        compile out of the steady-state loop.
+
+        ``signatures`` is an iterable; each entry describes ONE global
+        (unsplit, exactly as ``__call__`` receives it) batch as a
+        sequence of per-array specs for ``(input0, ..., label)`` — an
+        array, a ``jax.ShapeDtypeStruct``, or a ``(shape, dtype)`` pair::
+
+            step.warmup([(( (bs, key), "int32"), ((bs, key), "int32"))
+                         for bs, key in sampler.signatures()])
+
+        Each signature is driven through the REAL jitted step once —
+        ``jit(...).lower(...).compile()`` would compile the same program
+        but never populates the jit dispatch cache, so the first real
+        call would compile again. Donated operands get throwaway
+        zero-state copies (transient extra memory of one parameter+
+        optimizer state set); the training state, RNG schedule of the
+        real steps, and step counter are untouched.
+
+        Afterwards the guard is marked steady: any NEW shape in the
+        training loop counts as ``compile/steady_state_recompiles`` and
+        warns or raises per ``MXTPU_RECOMPILE_LIMIT``. Returns the
+        number of freshly compiled programs."""
+        import numpy as _host_np
+
+        reg = _tel.registry()
+        compiled = 0
+        for entry in signatures:
+            specs = [_cc.normalize_spec(s) for s in entry]
+            host = [_host_np.zeros(shape, dtype) for shape, dtype in specs]
+            batch, label = self._stage(tuple(host))
+            sig = tuple((a.shape, a.dtype.name) for a in batch) + (
+                (label.shape, label.dtype.name),)
+            if not self.compile_guard.observe(
+                    sig, lambda: _cc.aval_summary(tuple(batch) + (label,))):
+                continue  # already compiled (duplicate signature)
+            compiled += 1
+            reg.counter("compile/warmup_compiles").inc()
+            with (_tel.span("trainstep.warmup", {"signature": str(sig)})
+                  if _tel._ENABLED else _tel.NULL_SPAN):
+                out = self._step_fn(*self._dummy_args(batch, label))
+            jax.block_until_ready(out[0])  # compile + run fully retired
+        self.compile_guard.mark_steady()
+        return compiled
+
+    def _dummy_args(self, batch, label):
+        """Operands for a warmup dispatch: donated slots (train values,
+        optimizer state, key, t) get throwaway zero copies with the real
+        placement; non-donated slots reuse the live buffers."""
+        def _zeros_like(v):
+            z = jnp.zeros(v.shape, v.dtype)
+            sh = getattr(v, "sharding", None)
+            if self._mesh is not None and sh is not None:
+                z = jax.device_put(z, sh)
+            return z
+
+        dummy_train = {n: _zeros_like(v)
+                       for n, v in self._train_vals.items()}
+        dummy_opt = {n: tuple(_zeros_like(s) for s in st)
+                     for n, st in self._opt_state.items()}
+        return (dummy_train, self._frozen_vals, dummy_opt, batch, label,
+                _random.next_key(), jnp.float32(self._current_lr()),
+                jnp.int32(0),
+                jnp.float32(self._optimizer.rescale_grad))
+
+    def cache_info(self) -> dict:
+        """Signature cache summary: programs held, per-signature aval
+        rendering, use counts, recency (``compile_cache.RecompileGuard``
+        accounting)."""
+        return self.compile_guard.info()
+
     def _stage(self, batch_and_label):
         """Host-side staging (the slow preamble the fast path skips)."""
         *batch, label = batch_and_label
@@ -570,6 +650,10 @@ class TrainStep:
         rebuilds, and anything that blocks on the device —
         ``tools/check_no_sync_in_step.py`` lints it (and ``__call__``)."""
         nsteps = self._steps_per_call
+        sig = tuple((a.shape, a.dtype.name) for a in batch) + (
+            (label.shape, label.dtype.name),)
+        self.compile_guard.observe(
+            sig, lambda: _cc.aval_summary(tuple(batch) + (label,)))
         self._t += nsteps
         lr = self._current_lr()
         # key and t live on device, advanced inside the jitted step — the

@@ -267,6 +267,16 @@ def report() -> dict:
         "compile_time_s": compile_hist["sum"] if compile_hist else None,
         "hbm_peak_bytes": snap["gauges"].get("device/hbm_peak_bytes"),
         "watchdog_stalls": snap["counters"].get("watchdog/stalls", 0),
+        # shape stability (compile_cache): distinct compiled signatures,
+        # post-warmup recompiles (should stay 0), persistent-cache reuse
+        "compile_signatures": snap["counters"].get("compile/signatures", 0),
+        "compile_steady_state_recompiles": snap["counters"].get(
+            "compile/steady_state_recompiles", 0),
+        "compile_warmup_compiles": snap["counters"].get(
+            "compile/warmup_compiles", 0),
+        "compile_cache_hits": snap["counters"].get("compile/cache_hits", 0),
+        "compile_cache_misses": snap["counters"].get(
+            "compile/cache_misses", 0),
         # async device feed (gluon.data.prefetch): per-pull consumer stall
         # — after overlap, the residual input wait per step
         "input_wait_ms": wait_hist,

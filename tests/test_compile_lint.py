@@ -1,0 +1,76 @@
+"""Tier-1 compile-count lint: NO jitted step path may recompile on a
+repeated identical-shape call.
+
+Counts real XLA ``backend_compile`` events (``jax.monitoring``) around a
+second call with bit-identical avals — any nonzero count is a trace-cache
+regression (object identity leaking into a cache key, a fresh callable
+per call, env flags read mid-trace, ...), the exact class of bug that
+turns into a TPU compile storm at scale.
+"""
+
+import numpy as np
+
+import jax
+
+import mxnet_tpu as mx
+
+_COMPILES = []
+jax.monitoring.register_event_duration_secs_listener(
+    lambda e, d, **kw: _COMPILES.append(e) if "backend_compile" in e
+    else None)
+
+
+def _compiles_during(fn):
+    n0 = len(_COMPILES)
+    fn()
+    return len(_COMPILES) - n0
+
+
+def test_trainstep_repeated_identical_shape_never_recompiles():
+    from mxnet_tpu import gluon, nd, optimizer as opt
+    from mxnet_tpu.parallel import TrainStep
+
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net(nd.zeros((2, 8)))
+    step = TrainStep(net, gluon.loss.L2Loss(), opt.SGD(learning_rate=0.1))
+    x = mx.nd.array(np.ones((4, 8), "float32"))
+    y = mx.nd.array(np.ones((4, 4), "float32"))
+    float(step(x, y).asscalar())  # first call compiles
+    assert _compiles_during(lambda: float(step(x, y).asscalar())) == 0
+    assert step.compile_guard.signatures == 1
+
+
+def test_cachedop_repeated_identical_shape_never_recompiles():
+    from mxnet_tpu import autograd, gluon, nd
+
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.ones((4, 8), "float32"))
+    net(x)  # first call compiles
+
+    def fwd():
+        net(x).asnumpy()
+
+    assert _compiles_during(fwd) == 0
+
+    def fwd_bwd():
+        xg = nd.array(np.ones((4, 8), "float32"))
+        xg.attach_grad()
+        with autograd.record():
+            y = net(xg).sum()
+        y.backward()
+        xg.grad.asnumpy()
+
+    fwd_bwd()  # first recorded call compiles the vjp program
+    assert _compiles_during(fwd_bwd) == 0
+    assert net._cached_op._guard.steady_state_recompiles == 0
+
+
+def test_eager_op_repeated_identical_shape_never_recompiles():
+    a = mx.nd.array(np.ones((8, 8), "float32"))
+    b = mx.nd.array(np.ones((8, 8), "float32"))
+    (a * b + 1).sum().asnumpy()  # first call compiles (bulk segment)
+    assert _compiles_during(
+        lambda: (a * b + 1).sum().asnumpy()) == 0

@@ -105,6 +105,39 @@ def _print_json_file(path, title):
     print(json.dumps(data, indent=2, default=str)[:4000])
 
 
+def _print_compile_family(report_path):
+    """Surface the ``compile/`` metric family (shape-stability spine:
+    signatures compiled, post-warmup recompiles, persistent-cache reuse)
+    from a ``report.json`` registry snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith("compile/")}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k.startswith("compile/")}
+    jax_compile = report.get("histograms", {}).get("jax/compile_time_s")
+    if not counters and not gauges and not jax_compile:
+        return
+    print("\n== Compile (shape stability) ==")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    if jax_compile:
+        print(f"  {'jax/compile_time_s total':<38} "
+              f"{jax_compile.get('sum', 0.0):.3f}s over "
+              f"{jax_compile.get('count', 0)} events")
+    recompiles = counters.get("compile/steady_state_recompiles", 0)
+    if recompiles:
+        print(f"  WARNING: {recompiles} steady-state recompile(s) — "
+              "shape churn after warmup (bucket/pad inputs)")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -143,6 +176,7 @@ def main(argv=None):
         _print_json_file(os.path.join(directory, "heartbeat.json"),
                          "Heartbeat")
         _print_json_file(os.path.join(directory, "report.json"), "Report")
+        _print_compile_family(os.path.join(directory, "report.json"))
     return 0
 
 
