@@ -89,6 +89,52 @@ def _telemetry_fields(step_times=None, compile_time_s=None):
                 "compile_time_s": compile_time_s, "hbm_peak_bytes": None}
 
 
+def _preflight(timeout_s=None):
+    """Fast device/tunnel probe: run a tiny matmul + host readback on a
+    watchdog thread budget. An UNREACHABLE rig (the BENCH_r05 failure:
+    even an 8x8 matmul hangs in the tunnel's C RPC forever) fails here in
+    seconds with a DISTINCT error row instead of burning the full 540 s
+    watchdog window. The probe runs on a daemon thread because a hung
+    tunnel call cannot be interrupted from within."""
+    import os
+    import threading
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("MXTPU_PREFLIGHT_TIMEOUT_S", "45"))
+    if timeout_s <= 0:
+        return  # explicit opt-out
+    result = {}
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            x = jnp.ones((8, 8), jnp.float32)
+            result["value"] = float((x @ x).sum())  # forces a round trip
+        except Exception as e:  # noqa: BLE001 - reported below
+            result["error"] = f"{type(e).__name__}: {e}"[:200]
+
+    th = threading.Thread(target=probe, daemon=True)
+    th.start()
+    th.join(timeout_s)
+    if not th.is_alive() and "error" not in result:
+        return  # healthy rig
+    reason = (f"preflight: device unreachable (no tiny-op result within "
+              f"{timeout_s:.0f}s)" if th.is_alive()
+              else f"preflight: tiny op failed: {result['error']}")
+    row = {
+        "metric": "bert_base_pretrain_tokens_per_sec_per_chip",
+        "value": 0.0,
+        "unit": "tokens/sec",
+        "vs_baseline": 0.0,
+        "error": reason,
+    }
+    row.update(_telemetry_fields())
+    print(json.dumps(row), flush=True)
+    os._exit(1)  # status must agree with the error row (ADVICE round 5)
+
+
 def main():
     # import ONCE up front: a structural failure (bad module, registry bug)
     # must surface as itself, not as a re-import artifact from a retry
@@ -105,6 +151,7 @@ def main():
         row.update(_telemetry_fields())
         print(json.dumps(row))
         return
+    _preflight()
     first_err = None
     for attempt_batch in (64, 32, 16):
         try:

@@ -32,7 +32,7 @@ VOCAB = 32768
 CEILING = 3.3e5
 
 
-def fixed_main():
+def fixed_main(amp=None, remat=None):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, optimizer as opt
     from mxnet_tpu.gluon.model_zoo.transformer import transformer_base
@@ -52,10 +52,14 @@ def fixed_main():
     # steps_per_call: STEPS_PER_CALL full optimizer steps on as many
     # DISTINCT microbatches per dispatch (device-side scan,
     # parallel/step.py) — amortizes tunnel dispatch latency like a real
-    # input pipeline
+    # input pipeline. Default precision is the legacy cast-everything
+    # bf16; --amp switches to the lists-driven AMP pass, --remat arms
+    # whole-graph rematerialization.
+    precision = ({"amp": amp} if amp else
+                 {"compute_dtype": "bfloat16", "state_dtype": "bfloat16"})
     step_fn = TrainStep(net, _Loss(), opt.AdamW(learning_rate=1e-4),
-                        compute_dtype="bfloat16", state_dtype="bfloat16",
-                        steps_per_call=STEPS_PER_CALL)
+                        steps_per_call=STEPS_PER_CALL, remat=remat,
+                        **precision)
     rng = np.random.RandomState(0)
     n = BATCH * STEPS_PER_CALL
     src = nd.array(rng.randint(0, VOCAB, (n, SRC_LEN)), dtype="int32")
@@ -203,10 +207,117 @@ def variable_length_main(args):
     return 0 if ok else 1
 
 
+# ------------------------------------------------------- amp/auto-batch mode
+def amp_auto_batch_main(args):
+    """HBM-aware compute ablation: fp32 no-remat vs amp(+remat), each at
+    the LARGEST batch its compiled step fits under one shared HBM budget
+    (``plan_batch`` over ``memory_analysis`` — nothing materialized
+    during planning). The amp+remat step must fit a strictly larger
+    batch and hold ZERO steady-state recompiles after warmup; steady
+    tokens/sec at the planned batches is the headline. Budget: device
+    HBM (or MXTPU_HBM_BYTES) under MXTPU_HBM_HEADROOM; rigs with no
+    limit at all fall back to the fp32 step's peak at 4x --batch-size so
+    the ablation stays runnable on the CPU rig."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, optimizer as opt
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+    from mxnet_tpu.ndarray.ndarray import NDArray
+    from mxnet_tpu.parallel import TrainStep, hbm_budget_bytes, plan_batch
+    import jax
+    import jax.numpy as jnp
+
+    V, key = args.vocab, args.max_len
+    amp_dtype = args.amp or "bfloat16"
+    remat = args.remat or "dots_saveable"
+
+    class MaskedCE:
+        def __call__(self, logits, label):
+            x = logits.data.astype(jnp.float32)
+            y = label.data
+            mask = y >= 0
+            safe = jnp.where(mask, y, 0).astype(jnp.int32)
+            logp = jax.nn.log_softmax(x, axis=-1)
+            nll = -jnp.take_along_axis(logp, safe[..., None],
+                                       axis=-1)[..., 0]
+            row = jnp.where(mask, nll, 0.0).sum(axis=-1)
+            return NDArray(row.sum() / mask.sum())
+
+    def make_step(**kw):
+        net = TransformerModel(
+            src_vocab=V, tgt_vocab=V, units=args.units,
+            hidden_size=args.units * 2, num_layers=args.layers,
+            num_heads=2, max_length=args.max_len + 8, dropout=0.0)
+        net.initialize(mx.initializer.Xavier())
+        net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                          nd.zeros((2, 8), dtype="int32"))
+        return TrainStep(net, MaskedCE(), opt.AdamW(learning_rate=1e-4),
+                         **kw)
+
+    def sig(bs):
+        return (((bs, key), "int32"), ((bs, key), "int32"),
+                ((bs, key), "int32"))
+
+    step32 = make_step()
+    budget = hbm_budget_bytes()
+    if budget is None:
+        budget = step32.memory_analysis(
+            sig(4 * args.batch_size))["peak_bytes_estimate"]
+    b32, peak32 = plan_batch(step32, sig, budget, start=1,
+                             max_batch=args.max_batch)
+    step_ar = make_step(amp=amp_dtype, remat=remat)
+    bar, peakar = plan_batch(step_ar, sig, budget, start=1,
+                             max_batch=args.max_batch)
+
+    def measure(step, bs, tag):
+        if bs <= 0:
+            return {"batch": 0, "steady_tokens_per_sec": 0.0}
+        rng = np.random.RandomState(args.seed)
+        batches = [tuple(nd.array(rng.randint(1, V, (bs, key)), dtype="int32")
+                         for _ in range(3)) for _ in range(4)]
+        step.warmup([sig(bs)])
+        out = run_varlen_mode(step, lambda ep: iter(batches),
+                              tokens_per_epoch=len(batches) * bs * key,
+                              epochs=args.epochs)
+        out["batch"] = bs
+        out["hbm"] = step.memory_analysis(sig(bs))
+        return out
+
+    base = measure(step32, b32, "fp32")
+    tuned = measure(step_ar, bar, "amp")
+    row = {
+        "metric": "transformer_amp_auto_batch_tokens_per_sec",
+        "value": tuned["steady_tokens_per_sec"],
+        "unit": "tokens/sec",
+        "amp": amp_dtype, "remat": remat,
+        "budget_bytes": int(budget),
+        "fp32": base, "amp_remat": tuned,
+    }
+    print(json.dumps(row))
+    print(f"budget {budget/1e6:.0f} MB @ seq {key}: fp32 fits batch "
+          f"{b32} ({base['steady_tokens_per_sec']} tok/s steady), "
+          f"{amp_dtype}+{remat} fits batch {bar} "
+          f"({tuned['steady_tokens_per_sec']} tok/s steady), "
+          f"{tuned.get('steady_state_recompiles', 0)} steady recompiles")
+    ok = (bar > b32 and tuned.get("steady_state_recompiles", 1) == 0)
+    if not ok:
+        print("FAIL: amp+remat must fit a strictly larger batch with "
+              "zero steady-state recompiles", file=sys.stderr)
+    return 0 if ok else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--variable-length", action="store_true",
                     help="run the bucketed-vs-unbucketed compile ablation")
+    ap.add_argument("--amp", nargs="?", const="bfloat16", default=None,
+                    help="mixed precision dtype (bfloat16/float16)")
+    ap.add_argument("--remat", nargs="?", const="dots_saveable",
+                    default=None,
+                    help="remat policy (mxnet_tpu.remat.POLICIES)")
+    ap.add_argument("--auto-batch", action="store_true",
+                    help="memory-guided batch planning ablation: fp32 "
+                         "vs amp+remat at their largest fitting batches")
+    ap.add_argument("--max-batch", type=int, default=1024)
     ap.add_argument("--buckets", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--samples", type=int, default=192)
@@ -220,9 +331,11 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.auto_batch:
+        return amp_auto_batch_main(args)
     if args.variable_length:
         return variable_length_main(args)
-    return fixed_main()
+    return fixed_main(amp=args.amp, remat=args.remat)
 
 
 if __name__ == "__main__":

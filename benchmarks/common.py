@@ -35,6 +35,9 @@ def telemetry_fields(step_times=None, compile_time_s=None):
         "step_time_p95": None,
         "compile_time_s": compile_time_s,
         "hbm_peak_bytes": None,
+        "hbm_headroom_bytes": None,
+        "amp_dtype": None,
+        "remat_policy": None,
     }
     report = None
     try:
@@ -42,6 +45,10 @@ def telemetry_fields(step_times=None, compile_time_s=None):
 
         report = _tel.report()
         fields["hbm_peak_bytes"] = _tel.hbm_peak_bytes()
+        fields["hbm_headroom_bytes"] = _tel.hbm_headroom_bytes()
+        info = _tel.run_info()
+        fields["amp_dtype"] = info.get("amp_dtype")
+        fields["remat_policy"] = info.get("remat_policy")
     except Exception:  # noqa: BLE001 - telemetry must never kill a bench
         _tel = None
     if step_times:

@@ -36,7 +36,7 @@ def _time_step(step, ids, labels, warmup=3, iters=10):
 
 
 def build_and_time(batch=32, seq=128, dropout=0.1, vocab_head=True,
-                   dense_attn=False, iters=10):
+                   dense_attn=False, iters=10, amp=None, remat=None):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, optimizer as opt
     from mxnet_tpu.gluon.model_zoo.bert import BERTModel
@@ -75,8 +75,13 @@ def build_and_time(batch=32, seq=128, dropout=0.1, vocab_head=True,
                 return ce(logits, label.reshape(-1))
             return (seq_out * seq_out).mean()
 
+        # legacy cast-everything bf16 by default; --amp selects the
+        # lists-driven AMP pass, --remat arms whole-graph remat
+        precision = ({"amp": amp} if amp else
+                     {"compute_dtype": "bfloat16",
+                      "state_dtype": "bfloat16"})
         step = TrainStep(net, loss_fn, opt.AdamW(learning_rate=1e-4),
-                         compute_dtype="bfloat16", state_dtype="bfloat16")
+                         remat=remat, **precision)
         rng = np.random.RandomState(0)
         ids = mx.nd.array(rng.randint(0, 30522, (batch, seq)), dtype="int32")
         labels = mx.nd.array(rng.randint(0, 30522, (batch, seq)), dtype="int32")
@@ -143,7 +148,8 @@ def variable_length_main(args):
             row = jnp.where(mask, nll, 0.0).sum(axis=-1)
             return NDArray(row.sum() / mask.sum())
 
-        return TrainStep(net, loss_fn, opt.AdamW(learning_rate=1e-4))
+        return TrainStep(net, loss_fn, opt.AdamW(learning_rate=1e-4),
+                         amp=args.amp, remat=args.remat)
 
     def pad_batch(idxs, to_len):
         ids = np.zeros((len(idxs), to_len), "int32")
@@ -208,6 +214,12 @@ def main(argv=None):
     ap = argparse.ArgumentParser()
     ap.add_argument("--variants", nargs="*", default=list(VARIANTS))
     ap.add_argument("--rbg", action="store_true", help="use rbg PRNG impl")
+    ap.add_argument("--amp", nargs="?", const="bfloat16", default=None,
+                    help="lists-driven mixed precision (bfloat16/float16) "
+                         "instead of the legacy cast-everything bf16")
+    ap.add_argument("--remat", nargs="?", const="dots_saveable",
+                    default=None,
+                    help="remat policy (mxnet_tpu.remat.POLICIES)")
     ap.add_argument("--variable-length", action="store_true",
                     help="bucketed-vs-unbucketed compile ablation")
     ap.add_argument("--buckets", type=int, default=4)
@@ -229,7 +241,8 @@ def main(argv=None):
 
         jax.config.update("jax_default_prng_impl", "rbg")
     for name in args.variants:
-        dt, tps = build_and_time(**VARIANTS[name])
+        dt, tps = build_and_time(amp=args.amp, remat=args.remat,
+                                 **VARIANTS[name])
         print(f"{name:18s} step={dt*1e3:7.2f} ms  tokens/s={tps:10.0f}",
               flush=True)
     return 0

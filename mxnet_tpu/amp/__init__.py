@@ -12,6 +12,8 @@ the cast-insertion pass in ``convert_model``.
 
 from __future__ import annotations
 
+import os as _os
+
 import numpy as _np
 
 import jax.numpy as jnp
@@ -29,6 +31,10 @@ __all__ = [
     "convert_hybrid_block",
     "LossScaler",
     "lists",
+    "current_dtype",
+    "default_amp",
+    "fp32_param_names",
+    "reset",
 ]
 
 _STATE = {"initialized": False, "target_dtype": None}
@@ -36,27 +42,112 @@ _STATE = {"initialized": False, "target_dtype": None}
 
 def init(target_dtype="bfloat16", target_precision_ops=None,
          conditional_fp32_ops=None, fp32_ops=None):
-    """Enable mixed precision globally (reference: ``amp.init``)."""
+    """Enable mixed precision globally (reference: ``amp.init``).
+
+    ``TrainStep`` built afterwards without an explicit ``amp=`` argument
+    adopts this dtype as its compute policy (norm params pinned fp32 per
+    ``lists.FP32_PARAM_BLOCKS``; float16 adds the in-graph dynamic loss
+    scaler)."""
     if str(target_dtype) not in ("bfloat16", "float16"):
         raise MXNetError("target_dtype must be bfloat16 or float16")
     _STATE["initialized"] = True
     _STATE["target_dtype"] = str(target_dtype)
 
 
+def reset():
+    """Drop the global AMP default (tests / explicit opt-out)."""
+    _STATE["initialized"] = False
+    _STATE["target_dtype"] = None
+
+
 def current_dtype():
     return _STATE["target_dtype"] if _STATE["initialized"] else None
 
 
+def default_amp():
+    """The AMP dtype a ``TrainStep(amp=None)`` adopts: ``amp.init()``'s
+    global target if set, else ``MXTPU_AMP`` from the environment
+    (``bfloat16``/``float16``; ``0``/``off`` or unset -> None)."""
+    if _STATE["initialized"]:
+        return _STATE["target_dtype"]
+    v = _os.environ.get("MXTPU_AMP", "").strip().lower()
+    if v in ("", "0", "off", "false", "none"):
+        return None
+    if v in ("bfloat16", "bf16"):
+        return "bfloat16"
+    if v in ("float16", "fp16", "half"):
+        return "float16"
+    raise MXNetError(
+        f"MXTPU_AMP={v!r}: expected bfloat16, float16, or 0/off")
+
+
+def fp32_param_names(net) -> frozenset:
+    """Names of ``net``'s parameters pinned to fp32 under AMP — the
+    allow/deny cast-insertion pass collapsed to parameter granularity:
+    every parameter owned by a norm-family block
+    (``lists.FP32_PARAM_BLOCKS``) keeps its fp32 master as the compute
+    value; everything else is cast to the compute dtype inside the
+    jitted step."""
+    names = set()
+
+    def visit(block):
+        if type(block).__name__ in lists.FP32_PARAM_BLOCKS:
+            for p in block._reg_params.values():
+                names.add(p.name)
+        for child in getattr(block, "_children", {}).values():
+            visit(child)
+
+    visit(net)
+    return frozenset(names)
+
+
 class LossScaler:
-    """Dynamic loss scaling (reference: ``amp/loss_scaler.py``): double every
-    ``scale_window`` clean steps, halve on overflow, skip the step."""
+    """Dynamic loss scaling (reference: ``amp/loss_scaler.py``).
+
+    Every overflow step is SKIPPED (no optimizer update). The scale:
+
+    - doubles (``scale_factor``) after ``scale_window`` consecutive
+      clean steps;
+    - halves when overflows are too frequent: more than ``tolerance``
+      of the steps since the last scale change overflowed (a lone spike
+      long after the last rescale skips without shrinking the scale —
+      the documented skip accounting; ``tolerance=0`` restores
+      halve-on-every-overflow). Floor 1.0.
+
+    This host-side class drives the eager ``Trainer`` path
+    (``amp.init_trainer``). ``TrainStep(amp='float16')`` runs the same
+    grow/halve/skip schedule *inside* the jitted step (device-carried
+    scale, ``lax.cond``-skipped update) using this class purely as the
+    hyperparameter carrier — overflow steps cost no host sync there.
+    """
 
     def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
                  scale_window=2000, tolerance=0.05):
-        self.loss_scale = init_scale
-        self._scale_factor = scale_factor
-        self._scale_window = scale_window
+        self.loss_scale = float(init_scale)
+        self._scale_factor = float(scale_factor)
+        self._scale_window = int(scale_window)
+        self._tolerance = float(tolerance)
         self._unskipped = 0
+        self._iter = 0
+        self._last_rescale_iter = -1
+        self._overflows_since_rescale = 0
+        self._total_skipped = 0
+
+    @property
+    def scale_window(self):
+        return self._scale_window
+
+    @property
+    def scale_factor(self):
+        return self._scale_factor
+
+    @property
+    def tolerance(self):
+        return self._tolerance
+
+    @property
+    def total_skipped(self):
+        return self._total_skipped
 
     def has_overflow(self, params) -> bool:
         for p in params:
@@ -68,14 +159,32 @@ class LossScaler:
         return False
 
     def update_scale(self, overflow: bool):
+        self._iter += 1
         if overflow:
-            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._total_skipped += 1
             self._unskipped = 0
+            self._overflows_since_rescale += 1
+            since = self._iter - self._last_rescale_iter
+            if self._overflows_since_rescale / float(since) > self._tolerance:
+                self.loss_scale = max(
+                    self.loss_scale / self._scale_factor, 1.0)
+                self._last_rescale_iter = self._iter
+                self._overflows_since_rescale = 0
         else:
             self._unskipped += 1
             if self._unskipped >= self._scale_window:
                 self.loss_scale *= self._scale_factor
                 self._unskipped = 0
+                self._last_rescale_iter = self._iter
+                self._overflows_since_rescale = 0
+
+    def stats(self) -> dict:
+        return {
+            "loss_scale": self.loss_scale,
+            "steps": self._iter,
+            "skipped": self._total_skipped,
+            "unskipped_streak": self._unskipped,
+        }
 
 
 def init_trainer(trainer):

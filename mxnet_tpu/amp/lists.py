@@ -29,3 +29,13 @@ CONDITIONAL_FP32_OPS = [
 # everything else: dtype of the widest input
 WIDEST_TYPE_CASTS = ["broadcast_add", "broadcast_sub", "broadcast_mul",
                      "broadcast_div", "concat", "where", "stack"]
+
+# Block classes whose PARAMETERS stay fp32 under TrainStep AMP (the
+# cast-insertion pass at parameter granularity: the reference inserted
+# casts around these ops; here their weights/stats simply never leave
+# fp32 masters, and the layers are dtype-preserving — f32 statistics,
+# output cast back to the activation dtype). Derived from FP32_OPS.
+FP32_PARAM_BLOCKS = frozenset({
+    "BatchNorm", "LayerNorm", "InstanceNorm", "GroupNorm",
+    "L2Normalization", "LRN",
+})

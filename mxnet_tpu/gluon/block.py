@@ -653,11 +653,21 @@ class HybridBlock(Block):
     parameters resolved to NDArrays (reference API preserved; F is always
     the ``nd`` namespace here since there is no symbolic mode)."""
 
+    # Subclasses that are natural checkpoint boundaries (transformer /
+    # BERT encoder+decoder layers in the model zoo) set this True:
+    # ``hybridize(remat=policy)`` then wraps EACH such block's traced
+    # application in its own ``jax.checkpoint`` region — per-layer
+    # rematerialization, the memonger segmentation with layer boundaries
+    # as the checkpoints (see ``mxnet_tpu.remat``).
+    _remat_unit = False
+
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._active = False
         self._flags = []
         self._cached_op = None
+        self._remat_policy = None
+        self._remat_active = False
 
     def __setattr__(self, name, value):
         super().__setattr__(name, value)
@@ -668,16 +678,33 @@ class HybridBlock(Block):
         self._cached_op = None
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  inline_limit=2, forward_bulk_size=None, backward_bulk_size=None):
+                  inline_limit=2, forward_bulk_size=None,
+                  backward_bulk_size=None, remat=None):
         self._active = active
         self._flags = [("static_alloc", static_alloc), ("static_shape", static_shape)]
         self._clear_cached_op()
+        # per-layer rematerialization: the policy propagates to every
+        # child but only arms blocks that declare themselves remat units
+        # (``_remat_unit``) — each such block's traced application becomes
+        # one jax.checkpoint region. remat=None leaves existing policies
+        # untouched (hybridize(False) alone must not disarm a configured
+        # net); remat=False/'off' explicitly disarms.
+        if remat is not None:
+            if remat in (False, "off", "0", "none"):
+                self._remat_policy = None
+            else:
+                from .. import remat as _remat_mod
+
+                _remat_mod.resolve_policy(remat)  # validate eagerly
+                self._remat_policy = remat if type(self)._remat_unit \
+                    else None
         # children run inside the parent's trace; still record their flags
         super().hybridize(
             active,
             static_alloc=static_alloc,
             static_shape=static_shape,
             inline_limit=inline_limit,
+            remat=remat,
         )
 
     def cast(self, dtype):
@@ -725,6 +752,13 @@ class HybridBlock(Block):
             if self._cached_op is None:
                 self._cached_op = CachedOp(self, self._flags)
             return self._cached_op(x, *args, **kwargs)
+        if getattr(self, "_remat_policy", None) is not None \
+                and not self._remat_active and _in_trace() \
+                and not _in_probe():
+            # armed remat unit inside a trace (TrainStep forward_loss or a
+            # CachedOp staging): this block's application becomes one
+            # jax.checkpoint region
+            return self._call_with_remat(x, *args, **kwargs)
         # eager path (also the body that gets traced by CachedOp)
         try:
             params = {name: p.data() for name, p in self._reg_params.items()}
@@ -748,6 +782,51 @@ class HybridBlock(Block):
                     name: p.data() for name, p in self._reg_params.items()
                 }
         return self.hybrid_forward(nd_namespace, x, *args, **kwargs, **params)
+
+    def _call_with_remat(self, *args, **kwargs):
+        """Apply this block as ONE ``jax.checkpoint`` region inside the
+        enclosing trace (policy from ``hybridize(remat=...)``).
+
+        The PRNG key is drawn from the ambient supply OUTSIDE the region
+        and passed as an explicit operand: splitting inside the
+        checkpointed trace would leak a tracer out of the region, and the
+        backward's recompute must replay IDENTICAL dropout masks (same
+        recipe as the hand-rolled BERTEncoder remat this generalizes).
+        Parameters resolve inside via the ambient ``param_override`` and
+        enter the region as closed-over tracers (new-style ``jax.remat``
+        supports that). Aux-sink writers (BatchNorm) must not be remat
+        units — their stat updates would escape the region."""
+        from .. import random as _random
+        from .. import remat as _remat_mod
+
+        policy = _remat_mod.resolve_policy(self._remat_policy)
+        supply = _random.current_key_supply()
+        # outside a supply scope (pure-eval traces) a constant key is
+        # fine: nothing stochastic can be live there
+        key = supply.next() if supply is not None else jax.random.PRNGKey(0)
+        flat, treedef = jax.tree.flatten((args, dict(kwargs)), is_leaf=_is_nd)
+        datas = tuple(a.data if isinstance(a, NDArray) else jnp.asarray(a)
+                      for a in flat)
+        out_tree = []
+
+        def fn(k, *ds):
+            wrapped_args, wrapped_kwargs = jax.tree.unflatten(
+                treedef, [NDArray(d) for d in ds])
+            self._remat_active = True
+            try:
+                with _random.key_supply(k):
+                    out = self.forward(*wrapped_args, **wrapped_kwargs)
+            finally:
+                self._remat_active = False
+            leaves, tree = jax.tree.flatten(out, is_leaf=_is_nd)
+            out_tree.append(tree)
+            return tuple(
+                o.data if isinstance(o, NDArray) else jnp.asarray(o)
+                for o in leaves)
+
+        outs = jax.checkpoint(fn, policy=policy)(key, *datas)
+        return jax.tree.unflatten(out_tree[-1],
+                                  [NDArray(o) for o in outs])
 
     def hybrid_forward(self, F, x, *args, **kwargs):  # pragma: no cover
         raise NotImplementedError

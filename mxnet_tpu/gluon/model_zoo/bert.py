@@ -39,6 +39,8 @@ class PositionwiseFFN(HybridBlock):
 class BERTEncoderLayer(HybridBlock):
     """Post-LN transformer encoder layer (original BERT arrangement)."""
 
+    _remat_unit = True  # hybridize(remat=...): one checkpoint region/layer
+
     def __init__(self, units, hidden_size, num_heads, dropout=0.1, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
@@ -53,9 +55,12 @@ class BERTEncoderLayer(HybridBlock):
             self.drop = Dropout(dropout)
 
     def hybrid_forward(self, F, x, valid_length=None):
-        attn = self.drop(self.attention(x, valid_length=valid_length))
+        # tags feed the names-based remat policy (remat='names:attn_out,
+        # ffn_out'); identity otherwise
+        attn = self.drop(F.checkpoint_name(
+            self.attention(x, valid_length=valid_length), name="attn_out"))
         x = self.ln_attn(x + attn)
-        ffn = self.ffn(x)
+        ffn = F.checkpoint_name(self.ffn(x), name="ffn_out")
         return self.ln_ffn(x + ffn)
 
 
@@ -86,6 +91,11 @@ class BERTEncoder(HybridBlock):
         if self._remat and isinstance(x.data, _jax.core.Tracer):
             from ...ndarray.ndarray import NDArray as _ND
             from ... import random as _random
+            from ... import remat as _remat_mod
+
+            # remat accepts True (recompute everything) or any policy
+            # from mxnet_tpu.remat ('dots_saveable', 'names:...', ...)
+            policy = _remat_mod.resolve_policy(self._remat)
 
             # each layer gets its PRNG key as an explicit operand: the key
             # supply must not be split inside the checkpointed trace (tracer
@@ -102,14 +112,14 @@ class BERTEncoder(HybridBlock):
                         with _random.key_supply(k):
                             return _l(_ND(a)).data
 
-                    x = _ND(_jax.checkpoint(f)(x.data, key))
+                    x = _ND(_jax.checkpoint(f, policy=policy)(x.data, key))
                 else:
                     def f(a, k, vl, _l=layer):
                         with _random.key_supply(k):
                             return _l(_ND(a), _ND(vl)).data
 
-                    x = _ND(_jax.checkpoint(f)(x.data, key,
-                                               valid_length.data))
+                    x = _ND(_jax.checkpoint(f, policy=policy)(
+                        x.data, key, valid_length.data))
             return x
         for layer in self.layers:
             x = layer(x, valid_length)

@@ -31,6 +31,8 @@ class _FFN(HybridBlock):
 
 
 class TransformerEncoderLayer(HybridBlock):
+    _remat_unit = True  # hybridize(remat=...): one checkpoint region/layer
+
     def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
@@ -41,11 +43,16 @@ class TransformerEncoderLayer(HybridBlock):
             self.drop = Dropout(dropout)
 
     def hybrid_forward(self, F, x):
-        x = x + self.drop(self.attn(self.ln1(x)))
-        return x + self.ffn(self.ln2(x))
+        # tags feed the names-based remat policy (remat='names:attn_out,
+        # ffn_out' keeps exactly these resident); identity otherwise
+        x = x + self.drop(F.checkpoint_name(self.attn(self.ln1(x)),
+                                            name="attn_out"))
+        return x + F.checkpoint_name(self.ffn(self.ln2(x)), name="ffn_out")
 
 
 class TransformerDecoderLayer(HybridBlock):
+    _remat_unit = True
+
     def __init__(self, units, hidden_size, num_heads, dropout, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
@@ -62,9 +69,11 @@ class TransformerDecoderLayer(HybridBlock):
             self.drop = Dropout(dropout)
 
     def hybrid_forward(self, F, x, memory):
-        x = x + self.drop(self.self_attn(self.ln1(x)))
-        x = x + self.drop(self.cross_attn(self.ln2(x), memory, memory))
-        return x + self.ffn(self.ln3(x))
+        x = x + self.drop(F.checkpoint_name(self.self_attn(self.ln1(x)),
+                                            name="attn_out"))
+        x = x + self.drop(F.checkpoint_name(
+            self.cross_attn(self.ln2(x), memory, memory), name="attn_out"))
+        return x + F.checkpoint_name(self.ffn(self.ln3(x)), name="ffn_out")
 
 
 class TransformerEncoder(HybridBlock):

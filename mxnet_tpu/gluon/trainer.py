@@ -60,25 +60,34 @@ def _fused_sgd_fn(n: int, momentum: float, clip: float):
 
 @functools.lru_cache(maxsize=64)
 def _fused_adam_fn(n: int, beta1: float, beta2: float, eps: float,
-                   clip: float, decoupled_wd: bool, bias_corr: bool):
+                   clip: float, decoupled_wd: bool, bias_corr: bool,
+                   low_dtypes: tuple = ()):
     import jax
 
     # per-tensor math mirrors ops/optimizer_op.adam_update (coupled wd via
     # _apply_wd_rescale ordering) and adamw_update (decoupled, wd outside
     # the moments); bias correction folds into lr IN-GRAPH from the ts
     # vector — the same f32 formulation TrainStep compiles, so the three
-    # Adam paths agree to f32 resolution
+    # Adam paths agree to f32 resolution.
+    # low_dtypes: per-tensor low-precision weight dtype name ('' = plain
+    # f32 weight). A named entry is the MULTI-PRECISION case: ws[i] is the
+    # f32 MASTER, gs[i] arrives in the low dtype (upcast in-graph), and a
+    # fresh low-precision weight is returned alongside the master — the
+    # reference's mp_*_update contract, one fused launch for all params.
     from ..ops.optimizer_op import _apply_wd_rescale
 
+    low_dtypes = low_dtypes or ("",) * n
+
     def apply(ws, gs, ms, vs, lrs, wds, ts, rescale):
-        new_w, new_m, new_v = [], [], []
+        new_w, new_m, new_v, new_low = [], [], [], []
         for i in range(n):
+            g32 = gs[i].astype(jnp.float32)
             if decoupled_wd:
-                g = gs[i] * rescale
+                g = g32 * rescale
                 if clip >= 0:
                     g = jnp.clip(g, -clip, clip)
             else:
-                g = _apply_wd_rescale(ws[i], gs[i], wds[i], rescale,
+                g = _apply_wd_rescale(ws[i], g32, wds[i], rescale,
                                       clip if clip >= 0 else None)
             lr = lrs[i]
             if bias_corr:
@@ -89,10 +98,13 @@ def _fused_adam_fn(n: int, beta1: float, beta2: float, eps: float,
             upd = m / (jnp.sqrt(v) + eps)
             if decoupled_wd:
                 upd = upd + wds[i] * ws[i]
-            new_w.append(ws[i] - lr * upd)
+            w1 = ws[i] - lr * upd
+            new_w.append(w1)
             new_m.append(m)
             new_v.append(v)
-        return tuple(new_w), tuple(new_m), tuple(new_v)
+            new_low.append(w1.astype(jnp.dtype(low_dtypes[i]))
+                           if low_dtypes[i] else None)
+        return tuple(new_w), tuple(new_m), tuple(new_v), tuple(new_low)
 
     return jax.jit(apply)
 
@@ -355,34 +367,58 @@ class Trainer:
         per-param step counts (``ts``, for bias correction) change every
         step and arrive as one small f32 vector.
 
-        Engages only for the exact Adam/AdamW classes over dense f32
-        params with plain ``(mean, var)`` states; sparse grads,
-        multi-precision ``(state, master)`` layouts, or any other dtype
-        fall back to per-param updates."""
+        Engages for the exact Adam/AdamW classes over dense params with
+        plain f32 ``(mean, var)`` states AND the multi-precision layout
+        (``((mean, var), fp32 master)`` over a low-precision weight,
+        from ``multi_precision=True``): the update runs on the f32
+        master with the gradient upcast in-graph and the low-precision
+        weight refreshed from the new master inside the SAME fused
+        launch — the reference's ``mp_adamw_update`` contract. Sparse
+        grads or any other state layout fall back to per-param
+        updates."""
         opt_ = self._optimizer
         if type(opt_) not in (opt.Adam, opt.AdamW) or not _fused_jit_enabled():
             return False
         from ..ndarray.sparse import RowSparseNDArray
 
         idxs, ws, gs, ms, vs = [], [], [], [], []
+        low_ws, low_dts = [], []
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
             w, g = param.data(), param.grad()
-            if isinstance(g, RowSparseNDArray) or w.dtype != _np.float32:
+            if isinstance(g, RowSparseNDArray):
                 return False
             if i not in updater.states:
                 updater.states[i] = opt_.create_state_multi_precision(i, w)
                 updater.states_synced[i] = True
             st = updater.states[i]
-            if not (isinstance(st, tuple) and len(st) == 2
+            if (isinstance(st, tuple) and len(st) == 2
+                    and isinstance(st[0], tuple)
+                    and isinstance(st[1], NDArray)):
+                # multi-precision: ((mean, var) on the master, master)
+                inner, master = st
+                if not (len(inner) == 2
+                        and all(isinstance(s, NDArray) for s in inner)):
+                    return False
+                ws.append(master)
+                low_ws.append(w)
+                low_dts.append(w.data.dtype.name)
+                ms.append(inner[0])
+                vs.append(inner[1])
+            elif (isinstance(st, tuple) and len(st) == 2
                     and all(isinstance(s, NDArray) for s in st)):
-                return False  # multi-precision (state, master): fallback
+                if w.dtype != _np.float32:
+                    return False  # low-precision w/o master: per-param path
+                ws.append(w)
+                low_ws.append(None)
+                low_dts.append("")
+                ms.append(st[0])
+                vs.append(st[1])
+            else:
+                return False
             idxs.append(i)
-            ws.append(w)
             gs.append(g)
-            ms.append(st[0])
-            vs.append(st[1])
         if not idxs:
             return False
         for i in idxs:
@@ -401,15 +437,17 @@ class Trainer:
         bias_corr = bool(opt_.correct_bias) if decoupled else True
         fn = _fused_adam_fn(len(idxs), float(opt_.beta1), float(opt_.beta2),
                             float(opt_.epsilon), float(clip), decoupled,
-                            bias_corr)
-        new_w, new_m, new_v = fn(
+                            bias_corr, tuple(low_dts))
+        new_w, new_m, new_v, new_low = fn(
             tuple(w.data for w in ws), tuple(g.data for g in gs),
             tuple(m.data for m in ms), tuple(v.data for v in vs),
             lrs, wds, jnp.asarray(ts, jnp.float32), rescale)
-        for w, m, v, nw, nm, nv in zip(ws, ms, vs, new_w, new_m, new_v):
-            w._rebind(nw)
-            m._rebind(nm)
-            v._rebind(nv)
+        for k, (w, m, v) in enumerate(zip(ws, ms, vs)):
+            w._rebind(new_w[k])
+            m._rebind(new_m[k])
+            v._rebind(new_v[k])
+            if low_ws[k] is not None:
+                low_ws[k]._rebind(new_low[k])
         return True
 
     # ---------------------------------------------------------------- state

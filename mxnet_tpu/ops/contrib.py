@@ -461,10 +461,15 @@ def _masked_softmax_probs(s, valid_length, causal):
 
 
 def _dense_attention(q, k, v, valid_length, causal, sm_scale):
-    """Exact softmax attention over (B, H, S, D); f32 scores, grad via
-    XLA autodiff."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * sm_scale
+    """Exact softmax attention over (B, H, S, D); f32 mask/softmax, grad
+    via XLA autodiff. The score dot runs in the OPERAND dtype and
+    upcasts after (identical for f32 inputs; the MXU accumulates bf16
+    dots in f32 internally anyway): routing the upcast through astype
+    makes the backward cast ds down BEFORE the dq/dk matmuls, so under
+    AMP every dot stays low-precision — a `preferred_element_type=f32`
+    score dot would leak an f32 cotangent into bf16 matmuls
+    (tools/check_amp_purity.py flags exactly that)."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
     p = _masked_softmax_probs(s, valid_length, causal)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
@@ -476,8 +481,9 @@ def _dense_attention_bshd(q, k, v, valid_length, causal, sm_scale):
     in the BERT trace are XLA's backward-residual layout choice, not
     the transposes — see traces/README round-4 copy audit); kept as the
     default for the simpler graphs."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                   preferred_element_type=jnp.float32) * sm_scale
+    # score dot in operand dtype, f32 after (see _dense_attention: keeps
+    # the backward's dq/dk matmuls low-precision under AMP)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
     p = _masked_softmax_probs(s, valid_length, causal)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 

@@ -484,43 +484,60 @@ def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **kw
     everything else uses the jnp composition XLA fuses itself."""
     from .pallas import layer_norm as _pln
 
-    if not output_mean_var and _pln.supports(data, axis):
+    if not output_mean_var and _pln.supports(data, axis) \
+            and gamma.dtype == data.dtype:
         C = data.shape[-1]
         out2d = _pln.layer_norm_fused(
             data.reshape(-1, C), gamma, beta, float(eps)
         )
         return out2d.reshape(data.shape)
-    mean = jnp.mean(data, axis=axis, keepdims=True)
-    var = jnp.var(data, axis=axis, keepdims=True)
+    # statistics in f32, output in the ACTIVATION dtype: under AMP the
+    # layer's params stay fp32 masters (amp.lists) while activations run
+    # bf16/f16 — a dtype-preserving norm keeps the low-precision stream
+    # low-precision instead of promoting everything downstream to f32
+    # (f32 in -> f32 out is bit-identical to the old path)
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=axis, keepdims=True)
+    var = jnp.var(xf, axis=axis, keepdims=True)
     inv = jax.lax.rsqrt(var + eps)
     shape = [1] * data.ndim
     shape[axis] = data.shape[axis]
-    out = (data - mean) * inv * gamma.reshape(shape) + beta.reshape(shape)
+    out = (xf - mean) * inv * gamma.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    out = out.astype(data.dtype)
     if output_mean_var:
-        return out, jnp.squeeze(mean, axis), jnp.squeeze(var, axis)
+        return (out, jnp.squeeze(mean, axis).astype(data.dtype),
+                jnp.squeeze(var, axis).astype(data.dtype))
     return out
 
 
 @register("GroupNorm")
 def group_norm(data, gamma, beta, num_groups=1, eps=1e-5, **kw):
     n, c = data.shape[:2]
-    x = data.reshape((n, num_groups, c // num_groups) + data.shape[2:])
+    x = data.astype(jnp.float32).reshape(
+        (n, num_groups, c // num_groups) + data.shape[2:])
     red = tuple(range(2, x.ndim))
     mean = jnp.mean(x, axis=red, keepdims=True)
     var = jnp.var(x, axis=red, keepdims=True)
     x = (x - mean) * jax.lax.rsqrt(var + eps)
     x = x.reshape(data.shape)
     shape = (1, c) + (1,) * (data.ndim - 2)
-    return x * gamma.reshape(shape) + beta.reshape(shape)
+    out = x * gamma.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)  # dtype-preserving (see layer_norm)
 
 
 @register("InstanceNorm")
 def instance_norm(data, gamma, beta, eps=1e-3, **kw):
+    xf = data.astype(jnp.float32)
     red = tuple(range(2, data.ndim))
-    mean = jnp.mean(data, axis=red, keepdims=True)
-    var = jnp.var(data, axis=red, keepdims=True)
+    mean = jnp.mean(xf, axis=red, keepdims=True)
+    var = jnp.var(xf, axis=red, keepdims=True)
     shape = (1, data.shape[1]) + (1,) * (data.ndim - 2)
-    return (data - mean) * jax.lax.rsqrt(var + eps) * gamma.reshape(shape) + beta.reshape(shape)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps) \
+        * gamma.astype(jnp.float32).reshape(shape) \
+        + beta.astype(jnp.float32).reshape(shape)
+    return out.astype(data.dtype)  # dtype-preserving (see layer_norm)
 
 
 @register("LRN")

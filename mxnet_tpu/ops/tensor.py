@@ -82,6 +82,16 @@ register("identity", aliases=["_copy", "stop_gradient_identity"])(
 register("BlockGrad", aliases=["stop_gradient"], differentiable=False)(
     lambda data, **kw: jax.lax.stop_gradient(data)
 )
+
+
+@register("checkpoint_name")
+def checkpoint_name(data, name="saveable", **kw):
+    """Tag a value for names-based remat policies
+    (``remat='names:attn_out,...'`` keeps only tagged values resident;
+    see ``mxnet_tpu.remat``). Identity outside a checkpointed trace."""
+    from jax.ad_checkpoint import checkpoint_name as _ck
+
+    return _ck(data, str(name))
 register("cast", aliases=["Cast"])(
     lambda data, dtype="float32", **kw: data.astype(jnp.dtype(dtype))
 )

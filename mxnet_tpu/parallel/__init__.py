@@ -151,6 +151,8 @@ def init_process_group(coordinator_address: str, num_processes: int,
     )
 
 
-from .step import TrainStep, DeviceBatch  # noqa: E402  (public API; needs defs above)
+from .step import (  # noqa: E402  (public API; needs defs above)
+    TrainStep, DeviceBatch, plan_batch, hbm_budget_bytes,
+)
 
-__all__ += ["TrainStep", "DeviceBatch"]
+__all__ += ["TrainStep", "DeviceBatch", "plan_batch", "hbm_budget_bytes"]

@@ -32,7 +32,64 @@ from .. import telemetry as _tel
 from .. import optimizer as _opt
 from ..ops import optimizer_op as _fused
 
-__all__ = ["TrainStep", "DeviceBatch"]
+__all__ = ["TrainStep", "DeviceBatch", "plan_batch", "hbm_budget_bytes"]
+
+
+def hbm_budget_bytes(limit_bytes=None) -> Optional[int]:
+    """The HBM planning budget: the device limit shaved by
+    ``MXTPU_HBM_HEADROOM`` — a value <= 1 is the usable FRACTION of HBM
+    (default 0.9), a value > 1 is an absolute byte count reserved.
+    ``limit_bytes`` overrides the detected limit
+    (``telemetry.hbm_limit_bytes``: device ``bytes_limit``, else
+    ``MXTPU_HBM_BYTES``). None when no limit is known."""
+    import os
+
+    if limit_bytes is None:
+        limit_bytes = _tel.hbm_limit_bytes()
+    if limit_bytes is None:
+        return None
+    head = float(os.environ.get("MXTPU_HBM_HEADROOM", "0.9"))
+    if head <= 1.0:
+        return int(limit_bytes * head)
+    return int(limit_bytes - head)
+
+
+def plan_batch(step, signature_fn, budget_bytes, start=1, max_batch=65536):
+    """Largest global batch whose compiled step fits ``budget_bytes``.
+
+    ``signature_fn(batch_size)`` returns the warmup-style signature
+    (per-array ``(shape, dtype)`` specs for ``(input0, ..., label)``)
+    describing one global batch of that size. Cost model is
+    ``step.memory_analysis(sig)['peak_bytes_estimate']`` — abstract
+    lowering only, nothing is materialized. Geometric probe up from
+    ``start`` then bisection, so ~2*log2(answer) compiles (persistent
+    compilation cache hits on re-runs). Returns ``(batch, peak_bytes)``;
+    ``(0, None)`` when even ``start`` does not fit."""
+    memo = {}
+
+    def peak(bs):
+        if bs not in memo:
+            memo[bs] = step.memory_analysis(
+                signature_fn(bs))["peak_bytes_estimate"]
+        return memo[bs]
+
+    if peak(start) > budget_bytes:
+        return 0, None
+    lo, hi, b = start, None, start
+    while hi is None and b < max_batch:
+        b = min(b * 2, max_batch)
+        if peak(b) <= budget_bytes:
+            lo = b
+        else:
+            hi = b
+    if hi is not None:
+        while hi - lo > 1:
+            mid = (lo + hi) // 2
+            if peak(mid) <= budget_bytes:
+                lo = mid
+            else:
+                hi = mid
+    return lo, peak(lo)
 
 
 class DeviceBatch:
@@ -173,7 +230,11 @@ class TrainStep:
                  param_rules: Sequence[Tuple[str, PartitionSpec]] = (),
                  donate: bool = True, grad_accum: int = 1,
                  compute_dtype=None, state_dtype=None, steps_per_call: int = 1,
-                 remat: Optional[str] = None):
+                 remat: Optional[str] = None, amp: Optional[str] = None,
+                 loss_scaler=None):
+        from .. import amp as _amp_mod
+        from .. import remat as _remat_mod
+
         self._net = net
         self._loss = loss_fn
         self._optimizer = optimizer
@@ -185,16 +246,45 @@ class TrainStep:
         # per-step host control (lr schedule moves only between calls) for
         # dispatch latency — the standard JAX input-dispatch amortization.
         self._steps_per_call = int(steps_per_call)
-        # AMP: cast float params/inputs to this dtype INSIDE the jitted step.
-        # The step differentiates W.R.T. THE CAST COPIES, so gradients carry
-        # the compute dtype — the reference's multi-precision scheme exactly
-        # (fp16 weights+grads, f32 masters inside the optimizer,
-        # ``mp_sgd_update`` family in ``src/operator/optimizer_op.cc``
-        # [unverified]) — and the optimizer casts back up. On
-        # bandwidth-bound chips halving gradient bytes is a first-order win.
-        self._compute_dtype = (
-            jnp.dtype(compute_dtype) if compute_dtype is not None else None
-        )
+        # AMP: cast float params/inputs to the compute dtype INSIDE the
+        # jitted step. The step differentiates W.R.T. THE CAST COPIES, so
+        # gradients carry the compute dtype — the reference's
+        # multi-precision scheme exactly (low-precision weights+grads, f32
+        # masters inside the optimizer, ``mp_sgd_update`` family in
+        # ``src/operator/optimizer_op.cc`` [unverified]) — and the
+        # optimizer casts back up. On bandwidth-bound chips halving
+        # gradient bytes is a first-order win. Two spellings:
+        #   compute_dtype=...  (legacy) casts EVERY float param;
+        #   amp='bfloat16'|'float16' consults amp.lists — norm-family
+        #   params stay fp32 (the cast-insertion pass at parameter
+        #   granularity), losses/reductions stay fp32, and float16 runs
+        #   the dynamic LossScaler inside the graph (scaled loss,
+        #   all-finite grad check, lax.cond-skipped update, in-graph
+        #   scale schedule — overflow steps cost no host sync).
+        if amp is None and compute_dtype is None:
+            amp = _amp_mod.default_amp()  # amp.init() global / MXTPU_AMP
+        if amp is not None:
+            if compute_dtype is not None:
+                raise MXNetError(
+                    "pass either amp= or compute_dtype=, not both")
+            amp = str(amp)
+            if amp not in ("bfloat16", "float16"):
+                raise MXNetError("amp must be 'bfloat16' or 'float16'")
+            self._amp = amp
+            self._compute_dtype = jnp.dtype(amp)
+            self._amp_fp32 = _amp_mod.fp32_param_names(net)
+            if loss_scaler is None and amp == "float16":
+                loss_scaler = _amp_mod.LossScaler()
+        else:
+            self._amp = None
+            self._compute_dtype = (
+                jnp.dtype(compute_dtype) if compute_dtype is not None
+                else None
+            )
+            self._amp_fp32 = frozenset()
+            loss_scaler = None  # scaling is the amp='float16' contract
+        self._scaler = loss_scaler
+        self._scaler_dev = None  # (scale f32, clean-streak i32, skips i32)
         # optionally store optimizer moments (m, v) in a narrow dtype; the
         # update computes in f32 and casts state back down (bf16 shares
         # f32's exponent range, so EMA magnitudes survive; mantissa noise
@@ -204,11 +294,11 @@ class TrainStep:
         )
         # rematerialization (jax.checkpoint over the traced forward):
         # trades recompute FLOPs for residual HBM traffic — the standard
-        # lever when the step is memory-bound. 'dots' keeps matmul
-        # outputs resident (the usual transformer policy); 'full'
-        # recomputes everything.
-        if remat not in (None, "full", "dots"):
-            raise MXNetError("remat must be None, 'full', or 'dots'")
+        # lever when the step is memory-bound. Policy menu + per-layer
+        # grain (hybridize(remat=...)): mxnet_tpu.remat.
+        if remat is None:
+            remat = _remat_mod.default_policy()  # MXTPU_REMAT
+        _remat_mod.resolve_policy(remat)  # validate eagerly
         self._remat = remat
         self._params = list(net.collect_params().items())
         for name, p in self._params:
@@ -319,6 +409,13 @@ class TrainStep:
         self.compile_guard = _cc.RecompileGuard(
             f"TrainStep({type(net).__name__})")
 
+        # surface the memory/precision config in telemetry reports and
+        # bench rows (amp_dtype / remat_policy columns)
+        _tel.set_info(
+            amp_dtype=(self._amp or (self._compute_dtype.name
+                                     if self._compute_dtype else None)),
+            remat_policy=self._remat)
+
         self._step_fn = self._build(donate)
 
     # device values stay pre-partitioned (train vs frozen) so the hot
@@ -355,11 +452,19 @@ class TrainStep:
 
         name2param_inv = {id(p): n for n, p in params}
         cdt = self._compute_dtype
+        fp32_pinned = self._amp_fp32
 
         def _cast(v):
             if cdt is not None and jnp.issubdtype(v.dtype, jnp.floating):
                 return v.astype(cdt)
             return v
+
+        def _cast_param(n, v):
+            # amp.lists pass at parameter granularity: norm-family params
+            # keep their fp32 masters as the compute value
+            if n in fp32_pinned:
+                return v
+            return _cast(v)
 
         mesh = self._mesh
         from . import mesh_scope as _mesh_scope
@@ -370,7 +475,8 @@ class TrainStep:
             # differentiated leaves, so gradients carry that dtype too
             mapping = {}
             for n, p in params:
-                v = cast_vals[n] if n in cast_vals else _cast(frozen_vals[n])
+                v = cast_vals[n] if n in cast_vals \
+                    else _cast_param(n, frozen_vals[n])
                 mapping[p] = NDArray(v)
             sink = {}
             # activate the mesh during tracing so mesh-aware layers (ring
@@ -387,50 +493,20 @@ class TrainStep:
             return Lm, aux
 
         if self._remat is not None:
-            policy = None if self._remat == "full" else \
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+            from .. import remat as _remat_mod
+
             forward_loss = jax.checkpoint(
-                forward_loss, policy=policy, static_argnums=())
+                forward_loss,
+                policy=_remat_mod.resolve_policy(self._remat),
+                static_argnums=())
 
-        # rescale_grad is a dynamic operand: AMP dynamic loss scaling and
-        # batch-size changes fold into it per step and must not retrace.
-        # key and t are DEVICE-carried state (returned updated, donated):
-        # advancing them on host would cost a host->device transfer plus an
-        # eager dispatch per step — measurable over the tunneled backend.
-        def step(train_vals, frozen_vals, opt_state, batch, label, key,
-                 lr, t, rescale):
-            key, sub = jax.random.split(key)
-            t = t + 1
-            # batch: tuple of arrays; with accum > 1 each has a leading
-            # microbatch dim of size `accum` scanned by lax.scan
-            cast_vals = {n: _cast(v) for n, v in train_vals.items()}
-            if accum == 1:
-                (L, aux), grads = jax.value_and_grad(
-                    forward_loss, has_aux=True
-                )(cast_vals, frozen_vals, batch, label, sub)
-            else:
-                def micro(carry, inp):
-                    g_acc, k = carry
-                    k, sk = jax.random.split(k)
-                    mb, ml = inp
-                    (Lm, aux_m), g = jax.value_and_grad(
-                        forward_loss, has_aux=True
-                    )(cast_vals, frozen_vals, mb, ml, sk)
-                    # accumulate in f32 regardless of grad dtype
-                    g_acc = jax.tree.map(
-                        lambda a, b: a + b.astype(a.dtype), g_acc, g
-                    )
-                    return (g_acc, k), (Lm, aux_m)
+        scaler = self._scaler
+        scaled = scaler is not None
+        if scaled:
+            window = jnp.int32(scaler.scale_window)
+            factor = jnp.float32(scaler.scale_factor)
 
-                g0 = jax.tree.map(
-                    lambda v: jnp.zeros(v.shape, jnp.float32), train_vals
-                )
-                (grads, _), (Ls, auxs) = jax.lax.scan(
-                    micro, (g0, sub), (batch, label)
-                )
-                grads = jax.tree.map(lambda g: g / accum, grads)
-                L = Ls.mean()
-                aux = jax.tree.map(lambda a: a[-1], auxs)
+        def apply_updates(train_vals, opt_state, grads, lr, t, rescale):
             new_vals = {}
             new_opt = {}
             for n in sorted(train_vals):
@@ -449,7 +525,101 @@ class TrainStep:
                     s_new.astype(s_old.dtype)
                     for s_new, s_old in zip(ns, st)
                 )
-            return L, new_vals, new_opt, key, t, aux
+            return new_vals, new_opt
+
+        # rescale_grad is a dynamic operand: AMP dynamic loss scaling and
+        # batch-size changes fold into it per step and must not retrace.
+        # key and t are DEVICE-carried state (returned updated, donated):
+        # advancing them on host would cost a host->device transfer plus an
+        # eager dispatch per step — measurable over the tunneled backend.
+        # scaler_state (float16 AMP only) rides the same way: (loss scale,
+        # clean-step streak, skipped-step count), adjusted in-graph.
+        def step_core(train_vals, frozen_vals, opt_state, batch, label, key,
+                      lr, t, rescale, scaler_state):
+            key, sub = jax.random.split(key)
+            # batch: tuple of arrays; with accum > 1 each has a leading
+            # microbatch dim of size `accum` scanned by lax.scan
+            cast_vals = {n: _cast_param(n, v) for n, v in train_vals.items()}
+            scale = scaler_state[0] if scaled else None
+
+            def fwd(cv, fv, b, l, k):
+                L, aux = forward_loss(cv, fv, b, l, k)
+                # scaled loss => scaled (finite-checkable) gradients; the
+                # unscale folds into rescale_grad below, never a host trip
+                return (L * scale, aux) if scaled else (L, aux)
+
+            if accum == 1:
+                (L, aux), grads = jax.value_and_grad(
+                    fwd, has_aux=True
+                )(cast_vals, frozen_vals, batch, label, sub)
+            else:
+                def micro(carry, inp):
+                    g_acc, k = carry
+                    k, sk = jax.random.split(k)
+                    mb, ml = inp
+                    (Lm, aux_m), g = jax.value_and_grad(
+                        fwd, has_aux=True
+                    )(cast_vals, frozen_vals, mb, ml, sk)
+                    # accumulate in f32 regardless of grad dtype
+                    g_acc = jax.tree.map(
+                        lambda a, b: a + b.astype(a.dtype), g_acc, g
+                    )
+                    return (g_acc, k), (Lm, aux_m)
+
+                g0 = jax.tree.map(
+                    lambda v: jnp.zeros(v.shape, jnp.float32), train_vals
+                )
+                (grads, _), (Ls, auxs) = jax.lax.scan(
+                    micro, (g0, sub), (batch, label)
+                )
+                grads = jax.tree.map(lambda g: g / accum, grads)
+                L = Ls.mean()
+                aux = jax.tree.map(lambda a: a[-1], auxs)
+
+            if not scaled:
+                t1 = t + 1
+                new_vals, new_opt = apply_updates(
+                    train_vals, opt_state, grads, lr, t1, rescale)
+                return L, new_vals, new_opt, key, t1, aux, None
+
+            # in-graph overflow handling: the all-finite check gates a
+            # lax.cond'd update — a skipped step leaves params, moments,
+            # aux states and the bias-correction clock t untouched — and
+            # the grow/halve schedule advances on device. No host sync
+            # anywhere on this path (tools/check_amp_purity.py lints it).
+            L = L / scale
+            finite = jnp.bool_(True)
+            for g in jax.tree.leaves(grads):
+                finite = jnp.logical_and(finite, jnp.isfinite(g).all())
+            t1 = t + finite.astype(t.dtype)
+
+            def _apply(_):
+                return apply_updates(train_vals, opt_state, grads, lr, t1,
+                                     rescale / scale)
+
+            def _skip(_):
+                return (dict(train_vals),
+                        {n: tuple(st) for n, st in opt_state.items()})
+
+            new_vals, new_opt = jax.lax.cond(finite, _apply, _skip, None)
+            aux = {
+                n: jnp.where(finite, v,
+                             train_vals[n] if n in train_vals
+                             else frozen_vals[n])
+                for n, v in aux.items()
+            }
+            # the LossScaler schedule, in-graph: halve (floor 1.0) on
+            # overflow, double after scale_window consecutive clean steps
+            good = jnp.where(finite, scaler_state[1] + 1, jnp.int32(0))
+            new_scale = jnp.where(
+                finite, scale, jnp.maximum(scale / factor, jnp.float32(1.0)))
+            grow = good >= window
+            new_scale = jnp.where(grow, new_scale * factor, new_scale)
+            good = jnp.where(grow, jnp.int32(0), good)
+            skips = scaler_state[2] + \
+                jnp.logical_not(finite).astype(jnp.int32)
+            return L, new_vals, new_opt, key, t1, aux, \
+                (new_scale, good, skips)
 
         nsteps = self._steps_per_call
         if nsteps > 1:
@@ -458,13 +628,36 @@ class TrainStep:
             # executable — one dispatch amortizes host/tunnel latency over
             # nsteps steps; the scan body is the single-step program, so
             # compile time and numerics are unchanged
+            if scaled:
+                def multi(train_vals, frozen_vals, opt_state, batch, label,
+                          key, lr, t, rescale, scaler_state):
+                    def one(carry, inp):
+                        tv, os_, k, tt, ss = carry
+                        mb, ml = inp
+                        L, nv, no, nk, nt, aux, nss = step_core(
+                            tv, frozen_vals, os_, mb, ml, k, lr, tt,
+                            rescale, ss
+                        )
+                        return (nv, no, nk, nt, nss), (L, aux)
+
+                    (tv, os_, k, tt, ss), (Ls, auxs) = jax.lax.scan(
+                        one, (train_vals, opt_state, key, t, scaler_state),
+                        (batch, label)
+                    )
+                    aux = jax.tree.map(lambda a: a[-1], auxs)
+                    return Ls.mean(), tv, os_, k, tt, aux, ss
+
+                donate_args = (0, 2, 5, 7, 9) if donate else ()
+                return jax.jit(multi, donate_argnums=donate_args)
+
             def multi(train_vals, frozen_vals, opt_state, batch, label, key,
                       lr, t, rescale):
                 def one(carry, inp):
                     tv, os_, k, tt = carry
                     mb, ml = inp
-                    L, nv, no, nk, nt, aux = step(
-                        tv, frozen_vals, os_, mb, ml, k, lr, tt, rescale
+                    L, nv, no, nk, nt, aux, _ = step_core(
+                        tv, frozen_vals, os_, mb, ml, k, lr, tt, rescale,
+                        None
                     )
                     return (nv, no, nk, nt), (L, aux)
 
@@ -476,6 +669,22 @@ class TrainStep:
 
             donate_args = (0, 2, 5, 7) if donate else ()
             return jax.jit(multi, donate_argnums=donate_args)
+
+        if scaled:
+            def step(train_vals, frozen_vals, opt_state, batch, label, key,
+                     lr, t, rescale, scaler_state):
+                return step_core(train_vals, frozen_vals, opt_state, batch,
+                                 label, key, lr, t, rescale, scaler_state)
+
+            donate_args = (0, 2, 5, 7, 9) if donate else ()
+            return jax.jit(step, donate_argnums=donate_args)
+
+        def step(train_vals, frozen_vals, opt_state, batch, label, key,
+                 lr, t, rescale):
+            L, nv, no, k, t1, aux, _ = step_core(
+                train_vals, frozen_vals, opt_state, batch, label, key, lr,
+                t, rescale, None)
+            return L, nv, no, k, t1, aux
 
         donate_args = (0, 2, 5, 7) if donate else ()
         return jax.jit(step, donate_argnums=donate_args)
@@ -590,10 +799,24 @@ class TrainStep:
                        for n, v in self._train_vals.items()}
         dummy_opt = {n: tuple(_zeros_like(s) for s in st)
                      for n, st in self._opt_state.items()}
-        return (dummy_train, self._frozen_vals, dummy_opt, batch, label,
+        args = (dummy_train, self._frozen_vals, dummy_opt, batch, label,
                 _random.next_key(), jnp.float32(self._current_lr()),
                 jnp.int32(0),
                 jnp.float32(self._optimizer.rescale_grad))
+        if self._scaler is not None:
+            # throwaway scaler state: warmup must not advance the real one
+            args = args + (self._scaler_fresh(),)
+        return args
+
+    def _scaler_fresh(self):
+        """Fresh device-resident (scale, clean-streak, skip-count) state
+        seeded from the host LossScaler config."""
+        s = (jnp.float32(self._scaler.loss_scale), jnp.int32(0),
+             jnp.int32(0))
+        if self._mesh is not None:
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            s = tuple(jax.device_put(x, repl) for x in s)
+        return s
 
     def cache_info(self) -> dict:
         """Signature cache summary: programs held, per-signature aval
@@ -672,6 +895,10 @@ class TrainStep:
         args = (self._train_vals, self._frozen_vals, self._opt_state, batch,
                 label, self._key_dev, self._lr_dev, self._t_dev,
                 self._rescale_dev)
+        if self._scaler is not None:
+            if self._scaler_dev is None:
+                self._scaler_dev = self._scaler_fresh()
+            args = args + (self._scaler_dev,)
         if self._last_avals is None:
             # stash operand avals ONCE so cost_analysis() can re-lower the
             # exact program later (donated buffers are consumed, so keep
@@ -679,8 +906,12 @@ class TrainStep:
             # _step_fn anyway)
             self._last_avals = jax.tree.map(
                 lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), args)
-        L, new_vals, self._opt_state, self._key_dev, self._t_dev, aux = \
-            self._step_fn(*args)
+        if self._scaler is not None:
+            (L, new_vals, self._opt_state, self._key_dev, self._t_dev, aux,
+             self._scaler_dev) = self._step_fn(*args)
+        else:
+            L, new_vals, self._opt_state, self._key_dev, self._t_dev, aux = \
+                self._step_fn(*args)
         self._train_vals = new_vals
         for n, v in aux.items():
             if n in self._train_set:
@@ -718,7 +949,111 @@ class TrainStep:
 
     @property
     def loss_scale(self):
-        return 1.0
+        """Current dynamic loss scale (1.0 without float16 AMP). Reads
+        device state — cold path only, never call per step."""
+        if self._scaler is None:
+            return 1.0
+        if self._scaler_dev is None:
+            return float(self._scaler.loss_scale)
+        return float(self._scaler_dev[0])
+
+    def scaler_stats(self) -> dict:
+        """Device-carried scaler accounting (host sync; cold path):
+        current scale, consecutive clean steps, total skipped steps."""
+        if self._scaler is None:
+            return {"loss_scale": 1.0, "clean_streak": 0,
+                    "skipped_steps": 0}
+        if self._scaler_dev is None:
+            return {"loss_scale": float(self._scaler.loss_scale),
+                    "clean_streak": 0, "skipped_steps": 0}
+        s, good, skips = self._scaler_dev
+        return {"loss_scale": float(s), "clean_streak": int(good),
+                "skipped_steps": int(skips)}
+
+    # ------------------------------------------------------- memory planning
+    def memory_analysis(self, signature=None) -> dict:
+        """XLA ``memory_analysis`` of the exact compiled step executable —
+        the HBM planning numbers: argument/output/temp/alias bytes plus a
+        peak estimate (``argument + output + temp - alias``; donated
+        buffers appear in ``alias_bytes`` and are not double-counted).
+
+        With no argument, analyzes the signature of the last dispatch.
+        Pass one warmup-style signature (per-array specs for ``(input0,
+        ..., label)``, global unsplit shapes — see ``warmup``) to cost a
+        HYPOTHETICAL batch without running or materializing it;
+        ``plan_batch``/``tools/hbm_plan.py`` walk bucket menus this way.
+        Re-lowering an already-built program is a compilation-cache hit.
+        """
+        if signature is None:
+            avals = getattr(self, "_last_avals", None)
+            if avals is None:
+                raise MXNetError(
+                    "call the step once (or pass a signature) before "
+                    "memory_analysis()")
+        else:
+            avals = self._signature_avals(signature)
+        compiled = self._step_fn.lower(*avals).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            raise MXNetError(
+                "this backend exposes no compiled memory analysis")
+        out = {
+            "argument_bytes": int(ma.argument_size_in_bytes),
+            "output_bytes": int(ma.output_size_in_bytes),
+            "temp_bytes": int(ma.temp_size_in_bytes),
+            "alias_bytes": int(ma.alias_size_in_bytes),
+            "generated_code_bytes": int(ma.generated_code_size_in_bytes),
+        }
+        out["peak_bytes_estimate"] = (
+            out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
+            - out["alias_bytes"])
+        limit = _tel.hbm_limit_bytes()
+        out["hbm_limit_bytes"] = limit
+        out["hbm_headroom_bytes"] = (
+            limit - out["peak_bytes_estimate"] if limit is not None else None)
+        return out
+
+    def _signature_avals(self, signature):
+        """Abstract operand avals for ONE global batch signature: the
+        batch/label specs get the leading step/accum split axes exactly
+        as ``_stage`` would apply them; every other operand's aval comes
+        from the live state."""
+        specs = [_cc.normalize_spec(s) for s in signature]
+        n, lead = self._split_n, self._lead
+
+        def _split_aval(shape, dtype):
+            if n > 1:
+                if shape[0] % n:
+                    raise MXNetError(
+                        f"signature batch dim {shape[0]} must divide the "
+                        f"leading split factor {n} "
+                        "(steps_per_call * grad_accum)")
+                shape = lead + (shape[0] // n,) + tuple(shape[1:])
+            return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+        arrs = [_split_aval(sh, dt) for sh, dt in specs]
+        batch, label = tuple(arrs[:-1]), arrs[-1]
+
+        def aval(a):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+        if getattr(self, "_key_dev", None) is not None:
+            key_aval = aval(self._key_dev)
+        else:
+            # shape/dtype of the key the first dispatch will draw, without
+            # advancing any RNG state (impl set by MXNET_TPU_PRNG)
+            key_aval = jax.eval_shape(lambda: jax.random.PRNGKey(0))
+        scalar_f = jax.ShapeDtypeStruct((), jnp.float32)
+        scalar_i = jax.ShapeDtypeStruct((), jnp.int32)
+        args = (
+            jax.tree.map(aval, self._train_vals),
+            jax.tree.map(aval, self._frozen_vals),
+            jax.tree.map(aval, self._opt_state),
+            batch, label, key_aval, scalar_f, scalar_i, scalar_f,
+        )
+        if self._scaler is not None:
+            args = args + ((scalar_f, scalar_i, scalar_i),)
+        return args
 
     # ------------------------------------------------------------ state dict
     def _struct_names(self):
@@ -761,6 +1096,8 @@ class TrainStep:
         if getattr(self, "_key_dev", None) is not None:
             sd["key"] = cp(self._key_dev)
             sd["t_dev"] = cp(self._t_dev)
+        if getattr(self, "_scaler_dev", None) is not None:
+            sd["scaler"] = tuple(cp(x) for x in self._scaler_dev)
         return sd
 
     def load_state_dict(self, sd: dict):
@@ -799,6 +1136,12 @@ class TrainStep:
         else:
             self._key_dev = None
             self._t_dev = None
+        if self._scaler is not None and "scaler" in sd:
+            repl2 = (NamedSharding(self._mesh, PartitionSpec())
+                     if self._mesh is not None else None)
+            self._scaler_dev = tuple(
+                jax.device_put(jnp.asarray(x), repl2) if repl2 is not None
+                else jnp.asarray(x) for x in sd["scaler"])
         # derived scalar memos are stale now
         self._lr_host = None
         self._rescale_host = None
@@ -809,6 +1152,9 @@ class TrainStep:
         flat = {"meta/t_dev": getattr(self, "_t_dev", None),
                 "meta/key": getattr(self, "_key_dev", None)}
         flat = {k: v for k, v in flat.items() if v is not None}
+        if getattr(self, "_scaler_dev", None) is not None:
+            for i, x in enumerate(self._scaler_dev):
+                flat[f"meta/scaler{i}"] = x
         for n, v in self._values.items():
             flat[f"values/{s[n]}"] = v
         for n, st in self._opt_state.items():
@@ -864,6 +1210,7 @@ class TrainStep:
         sd = {"values": {}, "opt_state": {},
               "t_host": meta["extra"]["t_host"]}
         nstates = {}
+        scaler_parts = {}
         for k, v in flat.items():
             if k.startswith("values/"):
                 sd["values"][k[7:]] = v
@@ -874,6 +1221,11 @@ class TrainStep:
                 sd["key"] = v
             elif k == "meta/t_dev":
                 sd["t_dev"] = v
+            elif k.startswith("meta/scaler"):
+                scaler_parts[int(k[len("meta/scaler"):])] = v
+        if scaler_parts:
+            sd["scaler"] = tuple(scaler_parts[i]
+                                 for i in sorted(scaler_parts))
         sd["opt_state"] = {
             n: tuple(st[i] for i in sorted(st))
             for n, st in nstates.items()

@@ -1,0 +1,109 @@
+"""Activation rematerialization policies (reference: the NNVM sublinear
+memory planner / memonger, after Chen et al., *Training Deep Nets with
+Sublinear Memory Cost*).
+
+Under XLA the plan collapses to ``jax.checkpoint`` over the traced
+forward: the backward recomputes activations instead of loading saved
+residuals — recompute FLOPs traded for HBM traffic, the standard lever
+when the step is memory-bound. Two grains are wired through the stack:
+
+- **whole-graph** — ``TrainStep(remat=policy)`` wraps the entire
+  ``forward_loss`` (one checkpoint region; maximum memory saving,
+  maximum recompute);
+- **per-layer** — ``HybridBlock.hybridize(remat=policy)`` wraps each
+  block that declares itself a remat unit (``_remat_unit = True``; the
+  model-zoo transformer/BERT encoder+decoder layers do) in its own
+  checkpoint region — the memonger segmentation, with the layer
+  boundaries as the O(sqrt(N)) checkpoints.
+
+Policies name what the forward may KEEP resident (everything else is
+recomputed in backward):
+
+- ``nothing_saveable`` (alias ``full``) — recompute everything.
+- ``dots_saveable`` — keep matmul outputs (MXU results are the
+  expensive recompute; the usual transformer policy).
+- ``dots_with_no_batch_dims_saveable`` (alias ``dots``) — like
+  ``dots_saveable`` but batched matmuls (attention scores) are also
+  recomputed; keeps only weight-by-activation products.
+- ``names:a,b,...`` — keep only values tagged
+  ``mx.nd.checkpoint_name(x, 'a')`` (``jax.ad_checkpoint``'s
+  names-based policy).
+
+``MXTPU_REMAT`` sets the process default consumed by
+``TrainStep(remat=None)``.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .base import MXNetError
+
+__all__ = ["POLICIES", "resolve_policy", "default_policy", "checkpoint"]
+
+_OFF = ("", "0", "off", "false", "none")
+
+
+def _named_policies():
+    import jax
+
+    p = jax.checkpoint_policies
+    return {
+        "full": None,
+        "nothing_saveable": None,
+        "dots": p.dots_with_no_batch_dims_saveable,
+        "dots_with_no_batch_dims_saveable":
+            p.dots_with_no_batch_dims_saveable,
+        "dots_saveable": p.dots_saveable,
+        "checkpoint_dots": p.dots_saveable,
+    }
+
+
+# public, for docs/tools; resolved lazily so importing this module never
+# forces jax initialization
+POLICIES = (
+    "full", "nothing_saveable", "dots", "dots_with_no_batch_dims_saveable",
+    "dots_saveable", "checkpoint_dots", "names:<n1,n2,...>",
+)
+
+
+def resolve_policy(name):
+    """Policy spec -> ``jax.checkpoint`` policy callable (or None =
+    ``nothing_saveable``). Accepts a policy name from ``POLICIES``, a
+    ``names:a,b`` spec, an already-callable policy, or True (= 'full')."""
+    if name is None:
+        return None
+    if callable(name):
+        return name
+    if name is True:
+        return None  # legacy BERTEncoder(remat=True): recompute everything
+    name = str(name).strip()
+    named = _named_policies()
+    if name in named:
+        return named[name]
+    if name.startswith("names:"):
+        import jax
+
+        tags = [t.strip() for t in name[len("names:"):].split(",") if t.strip()]
+        if not tags:
+            raise MXNetError("names-based remat policy needs at least one "
+                             "tag: remat='names:attn_out,ffn_out'")
+        return jax.checkpoint_policies.save_only_these_names(*tags)
+    raise MXNetError(
+        f"unknown remat policy {name!r}; choose one of {POLICIES}")
+
+
+def default_policy():
+    """Process-wide default (``MXTPU_REMAT``); None when unset/off."""
+    v = os.environ.get("MXTPU_REMAT", "").strip().lower()
+    if v in _OFF:
+        return None
+    resolve_policy(v)  # validate early: a typo'd env var fails loudly
+    return v
+
+
+def checkpoint(fn, policy=None):
+    """``jax.checkpoint`` with a policy spec (name or callable)."""
+    import jax
+
+    return jax.checkpoint(fn, policy=resolve_policy(policy))

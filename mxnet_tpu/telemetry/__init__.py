@@ -42,7 +42,8 @@ from .watchdog import Watchdog
 __all__ = [
     "enable", "disable", "enabled", "span", "instant", "registry",
     "report", "dump", "record_step", "start_watchdog", "stop_watchdog",
-    "hbm_peak_bytes", "device_memory_stats", "Registry", "Counter",
+    "hbm_peak_bytes", "hbm_limit_bytes", "hbm_headroom_bytes",
+    "device_memory_stats", "set_info", "run_info", "Registry", "Counter",
     "Gauge", "Histogram", "Watchdog", "EventLog", "NULL_SPAN",
 ]
 
@@ -54,6 +55,23 @@ _REGISTRY = Registry()
 _WATCHDOG: Optional[Watchdog] = None
 _LOCK = threading.RLock()
 _JAX_LISTENER_INSTALLED = False
+# non-numeric run configuration surfaced in report() (amp dtype, remat
+# policy, ...) — set by the components that own the knob, e.g. TrainStep
+_RUN_INFO: dict = {}
+
+
+def set_info(**kwargs):
+    """Attach run-configuration facts (strings allowed — the registry is
+    numeric-only) to ``report()``; None values clear the key."""
+    for k, v in kwargs.items():
+        if v is None:
+            _RUN_INFO.pop(k, None)
+        else:
+            _RUN_INFO[k] = v
+
+
+def run_info() -> dict:
+    return dict(_RUN_INFO)
 
 
 def enabled() -> bool:
@@ -110,6 +128,7 @@ def reset():
             _LOG.close()
             _LOG = None
         _REGISTRY.clear()
+        _RUN_INFO.clear()
 
 
 # ------------------------------------------------------------------- spans
@@ -221,6 +240,34 @@ def hbm_peak_bytes() -> Optional[int]:
     return max(peaks) if peaks else None
 
 
+def hbm_limit_bytes() -> Optional[int]:
+    """Per-device HBM capacity: min ``bytes_limit`` over local devices,
+    falling back to ``MXTPU_HBM_BYTES`` (planning on rigs without memory
+    stats, e.g. the CPU test backend). None when neither is known."""
+    stats = device_memory_stats()
+    limits = [s.get("bytes_limit") for s in stats
+              if s.get("bytes_limit") is not None]
+    if limits:
+        return min(limits)
+    env = os.environ.get("MXTPU_HBM_BYTES")
+    if env:
+        try:
+            return int(float(env))
+        except ValueError:
+            pass
+    return None
+
+
+def hbm_headroom_bytes() -> Optional[int]:
+    """HBM limit minus the high-water mark — how much larger the working
+    set could grow. None when either side is unknown (CPU)."""
+    limit = hbm_limit_bytes()
+    peak = hbm_peak_bytes()
+    if limit is None or peak is None:
+        return None
+    return limit - peak
+
+
 # ------------------------------------------------------------ jax compile
 def _install_jax_compile_listener():
     """Route ``jax.monitoring`` duration events (jit tracing/compilation)
@@ -266,6 +313,12 @@ def report() -> dict:
         "samples_per_sec": (samples / step_sum) if step_sum > 0 else None,
         "compile_time_s": compile_hist["sum"] if compile_hist else None,
         "hbm_peak_bytes": snap["gauges"].get("device/hbm_peak_bytes"),
+        # memory/precision config + headroom (HBM-aware compute): the
+        # dtype/remat knobs the run was built with and how much HBM is
+        # left above the high-water mark (None on CPU)
+        "amp_dtype": _RUN_INFO.get("amp_dtype"),
+        "remat_policy": _RUN_INFO.get("remat_policy"),
+        "hbm_headroom_bytes": hbm_headroom_bytes(),
         "watchdog_stalls": snap["counters"].get("watchdog/stalls", 0),
         # shape stability (compile_cache): distinct compiled signatures,
         # post-warmup recompiles (should stay 0), persistent-cache reuse

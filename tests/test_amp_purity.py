@@ -1,0 +1,66 @@
+"""tools/check_amp_purity.py as a tier-1 unit test: under AMP no fp32
+master weight may feed a low-precision dot directly (jaxpr walk over the
+real compiled step), and the in-graph overflow-skip path must stay free
+of host syncs (AST walk over TrainStep._build's traced closures)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+import check_amp_purity  # noqa: E402
+
+
+@pytest.fixture(scope="module")
+def amp_step():
+    return check_amp_purity.build_tiny_amp_step()
+
+
+def test_amp_step_has_no_mixed_dots(amp_step):
+    violations = check_amp_purity.check_step_purity(amp_step)
+    assert not violations, "\n".join(violations)
+
+
+def test_overflow_skip_path_is_sync_free():
+    violations = check_amp_purity.find_overflow_sync_violations()
+    assert not violations, "\n".join(
+        f"step.py:{ln}: {msg}" for ln, msg in violations)
+
+
+def test_lint_detects_a_mixed_dot():
+    """Negative control: the jaxpr walk must actually flag an f32 operand
+    feeding a bf16 dot (guards the checker against rotting into a
+    no-op)."""
+    import jax
+    import jax.numpy as jnp
+
+    def bad(w32, x16):
+        return (w32 @ x16.astype(jnp.float32)).sum() + \
+            jnp.dot(w32.astype(jnp.bfloat16), x16).sum()
+
+    # mixed dot written deliberately: f32 × bf16
+    def worse(w32, x16):
+        return jax.lax.dot_general(
+            w32, x16, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32).sum()
+
+    jaxpr = jax.make_jaxpr(worse)(
+        jax.ShapeDtypeStruct((4, 8), jnp.float32),
+        jax.ShapeDtypeStruct((8, 4), jnp.bfloat16))
+    assert check_amp_purity.find_mixed_dots(jaxpr)
+
+
+def test_lint_detects_a_sync_in_traced_closure(tmp_path):
+    bad = tmp_path / "step_bad.py"
+    bad.write_text(
+        "class TrainStep:\n"
+        "    def _build(self, donate):\n"
+        "        n = float(self._optimizer.wd)  # host-side: legal\n"
+        "        def step(vals):\n"
+        "            return float(vals)  # traced closure: violation\n"
+        "        return step\n"
+    )
+    violations = check_amp_purity.find_overflow_sync_violations(str(bad))
+    assert len(violations) == 1
+    assert "float" in violations[0][1]

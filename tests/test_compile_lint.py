@@ -68,6 +68,38 @@ def test_cachedop_repeated_identical_shape_never_recompiles():
     assert net._cached_op._guard.steady_state_recompiles == 0
 
 
+def test_amp_remat_trainstep_adds_zero_steady_state_recompiles():
+    """amp + remat must not change the shape-stability contract: after
+    warmup over one signature, repeated identical-shape steps emit ZERO
+    backend_compile events and zero steady-state recompiles — the
+    dynamic loss-scale state rides as an operand, never a retrace."""
+    from mxnet_tpu import amp, gluon, nd, optimizer as opt
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.parallel import TrainStep
+
+    def build(**kw):
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, flatten=False),
+                nn.LayerNorm(in_channels=16),
+                nn.Dense(4, flatten=False))
+        net.initialize()
+        net(nd.zeros((2, 8)))
+        return TrainStep(net, gluon.loss.L2Loss(),
+                         opt.AdamW(learning_rate=1e-3), **kw)
+
+    x = mx.nd.array(np.ones((4, 8), "float32"))
+    y = mx.nd.array(np.ones((4, 4), "float32"))
+    for kw in ({"amp": "bfloat16", "remat": "dots_saveable"},
+               {"amp": "float16",
+                "loss_scaler": amp.LossScaler(scale_window=2)}):
+        step = build(**kw)
+        step.warmup([(((4, 8), "float32"), ((4, 4), "float32"))])
+        float(step(x, y).asscalar())  # first real call: warmed, no compile
+        assert _compiles_during(lambda: float(step(x, y).asscalar())) == 0
+        assert step.compile_guard.steady_state_recompiles == 0
+        assert step.compile_guard.signatures == 1
+
+
 def test_eager_op_repeated_identical_shape_never_recompiles():
     a = mx.nd.array(np.ones((8, 8), "float32"))
     b = mx.nd.array(np.ones((8, 8), "float32"))
