@@ -63,6 +63,41 @@ def telemetry_fields(step_times=None, compile_time_s=None):
     return fields
 
 
+def infer_fields():
+    """Decode-bench row columns from the ``infer/`` metric family
+    (null-safe: all None/0 when the registry is empty). The recompile
+    figure is the serving acceptance gate — it must be 0 after
+    ``InferStep.warmup`` across the prompt-bucket menu."""
+    fields = {
+        "prefill_ms_p50": None,
+        "decode_ms_per_token_p50": None,
+        "infer_tokens_per_sec": None,
+        "batch_occupancy": None,
+        "queue_wait_ms_p50": None,
+        "steady_state_recompiles": None,
+    }
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        snap = _tel.registry().snapshot()
+        h = snap["histograms"]
+        g = snap["gauges"]
+        if "infer/prefill_ms" in h:
+            fields["prefill_ms_p50"] = h["infer/prefill_ms"]["p50"]
+        if "infer/decode_ms_per_token" in h:
+            fields["decode_ms_per_token_p50"] = \
+                h["infer/decode_ms_per_token"]["p50"]
+        if "infer/queue_wait_ms" in h:
+            fields["queue_wait_ms_p50"] = h["infer/queue_wait_ms"]["p50"]
+        fields["infer_tokens_per_sec"] = g.get("infer/tokens_per_sec")
+        fields["batch_occupancy"] = g.get("infer/batch_occupancy")
+        fields["steady_state_recompiles"] = snap["counters"].get(
+            "compile/steady_state_recompiles", 0)
+    except Exception:  # noqa: BLE001 - telemetry must never kill a bench
+        pass
+    return fields
+
+
 def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
               warmup=3, steps=20, windows=4):
     """Time ``step_fn`` and print the driver JSON line.

@@ -102,6 +102,109 @@ VARIANTS = {
 }
 
 
+# ------------------------------------------------------------- decode mode
+def decode_main(args):
+    """--decode: the inference-engine ablation for the encoder workload
+    (BERT has no autoregressive head — its serving role is the PREFILL /
+    scoring half, incl. BERT-as-encoder generation memory). Naive = the
+    hybridized net fed batches padded to their own max length (one jitted
+    predict program per distinct length, compiling forever); engine =
+    ``InferStep`` fed bucket-padded batches with ``valid_length``, warmed
+    over the ``FixedBucketSampler.signatures()`` menu — must hold ZERO
+    steady-state recompiles. Steady tokens/sec for both, plus program
+    counts, in the row."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.data import FixedBucketSampler
+    from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+    from mxnet_tpu.parallel import InferStep
+    from .common import infer_fields
+
+    V = args.vocab
+    rng = np.random.RandomState(args.seed)
+    lengths = rng.randint(args.min_len, args.max_len + 1,
+                          size=args.samples).tolist()
+    seqs = [rng.randint(1, V, size=n).astype("int32") for n in lengths]
+    tokens_per_epoch = int(sum(lengths))
+    net = BERTModel(
+        vocab_size=V, units=args.units, hidden_size=args.units * 4,
+        num_layers=args.layers, num_heads=max(1, args.units // 32),
+        max_length=args.max_len + 8, dropout=0.0)
+    net.initialize()
+    net._probe_shapes(mx.nd.zeros((2, 8), dtype="int32"))
+
+    def pad_batch(idxs, to_len):
+        ids = np.zeros((len(idxs), to_len), "int32")
+        vl = np.zeros((len(idxs),), "int32")
+        for r, i in enumerate(idxs):
+            ids[r, : lengths[i]] = seqs[i]
+            vl[r] = lengths[i]
+        return ids, np.zeros_like(ids), vl
+
+    def epoch_order(ep):
+        order = np.random.RandomState(args.seed + 1 + ep).permutation(
+            len(seqs))
+        return [order[i: i + args.batch_size].tolist()
+                for i in range(0, len(order) - args.batch_size + 1,
+                               args.batch_size)]
+
+    # ---- naive: per-batch max-length padding through the hybridized net
+    net.hybridize()
+    naive_sigs = set()
+    naive_tps = None
+    for ep in range(args.epochs):
+        t0 = time.perf_counter()
+        for idxs in epoch_order(ep):
+            ml = max(lengths[i] for i in idxs)
+            ids, types, vl = pad_batch(idxs, ml)
+            naive_sigs.add((len(idxs), ml))
+            out = net(mx.nd.array(ids), mx.nd.array(types),
+                      mx.nd.array(vl, dtype="int32"))
+        float(out[1].asnumpy()[0, 0])  # retire the epoch
+        naive_tps = tokens_per_epoch / (time.perf_counter() - t0)
+    net.hybridize(False)
+
+    # ---- engine: bucket-padded InferStep with warmed signature menu
+    sampler = FixedBucketSampler(lengths, args.batch_size,
+                                 num_buckets=args.buckets,
+                                 last_batch="discard")
+    eng = InferStep(net, amp=args.amp)
+    warm_sigs = [
+        (((bs, key), "int32"), ((bs, key), "int32"), ((bs,), "int32"))
+        for bs, key in sampler.signatures()
+    ]
+    warm = eng.warmup(warm_sigs)
+    eng_tps = None
+    for ep in range(args.epochs):
+        t0 = time.perf_counter()
+        for idxs in epoch_order(ep):
+            ml = max(lengths[i] for i in idxs)
+            key = next(k for k in sampler.bucket_keys if ml <= k)
+            ids, types, vl = pad_batch(idxs, key)
+            out = eng(ids[: args.batch_size], types[: args.batch_size],
+                      vl[: args.batch_size])
+        float(out[1].asnumpy()[0, 0])
+        eng_tps = tokens_per_epoch / (time.perf_counter() - t0)
+
+    recompiles = eng.compile_guard.steady_state_recompiles
+    row = {
+        "metric": "bert_infer_bucketed_tokens_per_sec",
+        "value": round(eng_tps, 1),
+        "unit": "tokens/sec",
+        "naive_tokens_per_sec": round(naive_tps, 1),
+        "naive_programs": len(naive_sigs),
+        "warmup_compiles": warm,
+        "steady_state_recompiles": recompiles,
+        "n_buckets": len(sampler.bucket_keys),
+    }
+    row.update(infer_fields())
+    row["steady_state_recompiles"] = recompiles
+    print(json.dumps(row))
+    print(f"naive: {len(naive_sigs)} predict programs, {naive_tps:.0f} "
+          f"tok/s; engine: {warm} warmed programs, {recompiles} steady "
+          f"recompiles, {eng_tps:.0f} tok/s")
+    return 0 if recompiles == 0 else 1
+
+
 # ------------------------------------------------------ variable-length mode
 def variable_length_main(args):
     import jax
@@ -222,6 +325,10 @@ def main(argv=None):
                     help="remat policy (mxnet_tpu.remat.POLICIES)")
     ap.add_argument("--variable-length", action="store_true",
                     help="bucketed-vs-unbucketed compile ablation")
+    ap.add_argument("--decode", action="store_true",
+                    help="inference-engine (InferStep prefill) ablation: "
+                         "naive per-length predict programs vs warmed "
+                         "bucketed engine")
     ap.add_argument("--buckets", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=8)
     ap.add_argument("--samples", type=int, default=128)
@@ -234,6 +341,8 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.decode:
+        return decode_main(args)
     if args.variable_length:
         return variable_length_main(args)
     if args.rbg:

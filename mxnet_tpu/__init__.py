@@ -65,6 +65,7 @@ for _mod, _aliases in [
     ("module", ()),
     ("kvstore", ("kv",)),
     ("parallel", ()),
+    ("serving", ()),
     ("recordio", ()),
     ("io", ()),
     ("image", ()),

@@ -291,6 +291,32 @@ class Estimator:
         self.context = context
         self.stop_training = False
 
+    # -------------------------------------------------------------- predict
+    def predict(self, data, batch_fn=None, engine=None):
+        """Inference pass: run the net in predict mode over ``data`` and
+        return the list of per-batch outputs.
+
+        ``data`` yields batches — bare arrays (fed as the single input)
+        or tuples (fed positionally; pass ``batch_fn(batch) -> inputs
+        tuple`` to strip labels from a training loader). ``engine``: an
+        optional ``parallel.infer.InferStep`` over the same net — batches
+        then run through its jitted, shape-guarded forward (warm it with
+        the loader's signature menu for a compile-free pass) instead of
+        the eager/hybridized path."""
+        runner = engine if engine is not None else self.net
+        outs = []
+        for batch in data:
+            if batch_fn is not None:
+                inputs = batch_fn(batch)
+            elif isinstance(batch, (list, tuple)):
+                inputs = batch
+            else:
+                inputs = (batch,)
+            with (_tel.span("estimator.predict_batch") if _tel._ENABLED
+                  else _tel.NULL_SPAN):
+                outs.append(runner(*inputs))
+        return outs
+
     # ------------------------------------------------------------- evaluate
     def evaluate(self, val_data):
         for m in self.val_metrics:

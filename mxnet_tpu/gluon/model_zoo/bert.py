@@ -19,7 +19,8 @@ from ..nn import (
 
 __all__ = [
     "BERTEncoderLayer", "BERTEncoder", "BERTModel",
-    "BERTForPretraining", "bert_base", "bert_large", "get_bert",
+    "BERTForPretraining", "BERTEncoderForGeneration",
+    "bert_base", "bert_large", "get_bert",
 ]
 
 
@@ -189,6 +190,26 @@ class BERTForPretraining(HybridBlock):
         mlm_logits = F.dot(h, embed_w.T)
         nsp_logits = self.nsp(pooled)
         return mlm_logits, nsp_logits
+
+
+class BERTEncoderForGeneration(HybridBlock):
+    """BERT as the memory encoder of a seq2seq generator.
+
+    Adapts ``BERTModel``'s ``(token_ids, token_types, valid_length)``
+    call signature to the ``TransformerModel(encoder=...)`` contract
+    ``(src_ids, valid_length) -> (B, S, units)`` — the "BERT-as-encoder"
+    prefill configuration: bucket-padded prompts run through the (deep,
+    bidirectional) BERT stack once at prefill, and the decoder's
+    KV-cached incremental steps attend to the resulting memory."""
+
+    def __init__(self, bert: BERTModel, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.bert = bert
+
+    def hybrid_forward(self, F, src_ids, valid_length=None):
+        seq, _ = self.bert(src_ids, None, valid_length)
+        return seq
 
 
 _BERT_SPECS = {

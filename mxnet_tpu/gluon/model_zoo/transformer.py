@@ -4,10 +4,23 @@ Transformer WMT En-De over contrib interleaved encdec attention ops
 
 Pre-LN arrangement (more stable; graph fusion identical), flash attention
 everywhere: causal self-attention in the decoder, cross-attention over
-encoder memory."""
+encoder memory.
+
+Inference: every decoder level also speaks the INCREMENTAL protocol
+(``prefill``/``decode_step`` with a preallocated ``(max_len, B, H, D)``
+KV cache per layer, written via ``lax.dynamic_update_slice``), so one
+jitted step emits a token at O(1) cost instead of the O(T²) full
+re-forward. ``parallel.infer.InferStep`` drives it; ``model.generate``
+is the convenience wrapper. A custom ``encoder=`` block (e.g.
+``bert.BERTEncoderForGeneration``) swaps the memory encoder — the
+"BERT-as-encoder" prefill configuration."""
 
 from __future__ import annotations
 
+import jax
+import jax.numpy as jnp
+
+from ...ndarray.ndarray import NDArray
 from ..block import HybridBlock
 from ..nn import (
     Dense, Dropout, Embedding, HybridSequential, LayerNorm,
@@ -42,11 +55,12 @@ class TransformerEncoderLayer(HybridBlock):
             self.ffn = _FFN(units, hidden_size, dropout)
             self.drop = Dropout(dropout)
 
-    def hybrid_forward(self, F, x):
+    def hybrid_forward(self, F, x, valid_length=None):
         # tags feed the names-based remat policy (remat='names:attn_out,
         # ffn_out' keeps exactly these resident); identity otherwise
-        x = x + self.drop(F.checkpoint_name(self.attn(self.ln1(x)),
-                                            name="attn_out"))
+        x = x + self.drop(F.checkpoint_name(
+            self.attn(self.ln1(x), valid_length=valid_length),
+            name="attn_out"))
         return x + F.checkpoint_name(self.ffn(self.ln2(x)), name="ffn_out")
 
 
@@ -68,12 +82,43 @@ class TransformerDecoderLayer(HybridBlock):
             self.ffn = _FFN(units, hidden_size, dropout)
             self.drop = Dropout(dropout)
 
-    def hybrid_forward(self, F, x, memory):
+    def hybrid_forward(self, F, x, memory, mem_valid_length=None):
         x = x + self.drop(F.checkpoint_name(self.self_attn(self.ln1(x)),
                                             name="attn_out"))
         x = x + self.drop(F.checkpoint_name(
-            self.cross_attn(self.ln2(x), memory, memory), name="attn_out"))
+            self.cross_attn(self.ln2(x), memory, memory,
+                            valid_length=mem_valid_length),
+            name="attn_out"))
         return x + F.checkpoint_name(self.ffn(self.ln3(x)), name="ffn_out")
+
+    # ----------------------------------------------------- incremental mode
+    def prefill(self, x, memory, mem_valid_length=None):
+        """Full-prefix forward that seeds the decode state: returns
+        ``(y, (k_self, v_self), (k_mem, v_mem))`` — the layer output
+        (bit-matching ``__call__``), the causal prefix K/V ``(B, Lp, H,
+        D)``, and the memory projections reused by every decode step."""
+        a, k_s, v_s = self.self_attn.prefill(self.ln1(x))
+        x = x + self.drop(a)
+        k_m, v_m = self.cross_attn.project_kv(memory)
+        c = self.cross_attn.attend(self.ln2(x), k_m, v_m,
+                                   valid_length=mem_valid_length)
+        x = x + self.drop(c)
+        y = x + self.ffn(self.ln3(x))
+        return y, (k_s, v_s), (k_m, v_m)
+
+    def step(self, x, self_kv, pos, cross_kv, mem_valid_length=None):
+        """One incremental token: ``x`` (B, 1, units), ``self_kv`` the
+        raw ``(max_len, B, H, D)`` cache pair (updated in place via
+        dynamic_update_slice and returned), ``pos`` the traced cache
+        offset, ``cross_kv`` the static memory projections."""
+        a, k_c, v_c = self.self_attn.step(
+            self.ln1(x), self_kv[0], self_kv[1], pos)
+        x = x + self.drop(a)
+        c = self.cross_attn.attend(self.ln2(x), cross_kv[0], cross_kv[1],
+                                   valid_length=mem_valid_length)
+        x = x + self.drop(c)
+        y = x + self.ffn(self.ln3(x))
+        return y, (k_c, v_c)
 
 
 class TransformerEncoder(HybridBlock):
@@ -89,8 +134,10 @@ class TransformerEncoder(HybridBlock):
                 )
             self.ln = LayerNorm(in_channels=units)
 
-    def hybrid_forward(self, F, x):
-        return self.ln(self.layers(x))
+    def hybrid_forward(self, F, x, valid_length=None):
+        for layer in self.layers:
+            x = layer(x, valid_length=valid_length)
+        return self.ln(x)
 
 
 class TransformerDecoder(HybridBlock):
@@ -105,29 +152,44 @@ class TransformerDecoder(HybridBlock):
                                                 dropout))
             self.ln = LayerNorm(in_channels=units)
 
-    def hybrid_forward(self, F, x, memory):
+    def hybrid_forward(self, F, x, memory, mem_valid_length=None):
         for i in range(self._n):
-            x = getattr(self, f"layer{i}")(x, memory)
+            x = getattr(self, f"layer{i}")(x, memory,
+                                           mem_valid_length=mem_valid_length)
         return self.ln(x)
 
 
 class TransformerModel(HybridBlock):
-    """forward(src_ids, tgt_ids) -> logits (B, T_tgt, vocab)."""
+    """forward(src_ids, tgt_ids[, src_valid_length]) -> logits
+    (B, T_tgt, vocab). ``src_valid_length`` (B,) masks source padding out
+    of encoder self-attention AND decoder cross-attention — the bucketed
+    (pad-to-menu) prefill contract.
+
+    ``encoder``: optional custom memory encoder block with call signature
+    ``encoder(src_ids, valid_length) -> (B, S, units)`` replacing the
+    built-in embedding + TransformerEncoder stack (its output width must
+    equal ``units``) — e.g. ``bert.BERTEncoderForGeneration``."""
 
     def __init__(self, src_vocab=32768, tgt_vocab=32768, units=512,
                  hidden_size=2048, num_layers=6, num_heads=8, max_length=1024,
-                 dropout=0.1, tie_weights=True, **kwargs):
+                 dropout=0.1, tie_weights=True, encoder=None, **kwargs):
         super().__init__(**kwargs)
         self._units = units
+        self._custom_encoder = encoder is not None
         with self.name_scope():
-            self.src_embed = Embedding(src_vocab, units, prefix="src_embed_")
+            if not self._custom_encoder:
+                self.src_embed = Embedding(src_vocab, units,
+                                           prefix="src_embed_")
             self.tgt_embed = Embedding(tgt_vocab, units, prefix="tgt_embed_")
             self.pos_embed = Embedding(max_length, units, prefix="pos_embed_")
             self.drop = Dropout(dropout)
-            self.encoder = TransformerEncoder(
-                num_layers, units, hidden_size, num_heads, dropout,
-                prefix="enc_",
-            )
+            if self._custom_encoder:
+                self.encoder = encoder
+            else:
+                self.encoder = TransformerEncoder(
+                    num_layers, units, hidden_size, num_heads, dropout,
+                    prefix="enc_",
+                )
             self.decoder = TransformerDecoder(
                 num_layers, units, hidden_size, num_heads, dropout,
                 prefix="dec_",
@@ -142,13 +204,125 @@ class TransformerModel(HybridBlock):
         return self.drop(embed(ids) * (self._units ** 0.5)
                          + self.pos_embed(pos))
 
-    def hybrid_forward(self, F, src_ids, tgt_ids):
-        memory = self.encoder(self._embed(F, self.src_embed, src_ids))
-        out = self.decoder(self._embed(F, self.tgt_embed, tgt_ids), memory)
+    def _logits(self, F, out):
         if self._tied:
             w = self.tgt_embed.weight.data()
             return F.dot(out, w.T)
         return self.proj(out)
+
+    def encode(self, src_ids, valid_length=None):
+        """Source ids -> (B, S, units) memory (the prefill encoder half;
+        padding past ``valid_length`` is masked out of attention)."""
+        from ... import ndarray as F
+
+        if self._custom_encoder:
+            out = self.encoder(src_ids, valid_length)
+            return out[0] if isinstance(out, tuple) else out
+        return self.encoder(self._embed(F, self.src_embed, src_ids),
+                            valid_length=valid_length)
+
+    def hybrid_forward(self, F, src_ids, tgt_ids, src_valid_length=None):
+        memory = self.encode(src_ids, src_valid_length)
+        out = self.decoder(self._embed(F, self.tgt_embed, tgt_ids), memory,
+                           mem_valid_length=src_valid_length)
+        return self._logits(F, out)
+
+    # ----------------------------------------------------- incremental mode
+    def prefill(self, src_ids, tgt_prefix, src_valid_length=None,
+                max_len=64, cache_dtype=None):
+        """Encode the source and run the target prefix ONCE, seeding the
+        per-layer KV caches.
+
+        Returns ``(last_logits, state)``: ``last_logits`` (B, vocab) are
+        the logits predicting the token AFTER the prefix (bit-matching
+        column ``Lp-1`` of the full forward), ``state`` is the decode
+        pytree — per-layer ``(max_len, B, H, D)`` self-attention cache
+        pairs (prefix written at rows ``[0, Lp)``), static cross-attention
+        memory projections, and the source mask."""
+        from ... import ndarray as F
+
+        memory = self.encode(src_ids, src_valid_length)
+        x = self._embed(F, self.tgt_embed, tgt_prefix)
+        B = x.shape[0]
+        vl_raw = None if src_valid_length is None else (
+            src_valid_length.data if isinstance(src_valid_length, NDArray)
+            else jnp.asarray(src_valid_length))
+        self_kv, cross_kv = [], []
+        for i in range(self.decoder._n):
+            layer = getattr(self.decoder, f"layer{i}")
+            x, (k_s, v_s), (k_m, v_m) = layer.prefill(
+                x, memory, mem_valid_length=src_valid_length)
+            kc, vc = layer.self_attn.init_cache(
+                B, max_len, cache_dtype or k_s.dtype)
+            zero = (0, 0, 0, 0)
+            kc = jax.lax.dynamic_update_slice(kc, jnp.swapaxes(k_s, 0, 1),
+                                              zero)
+            vc = jax.lax.dynamic_update_slice(vc, jnp.swapaxes(v_s, 0, 1),
+                                              zero)
+            self_kv.append((kc, vc))
+            cross_kv.append((k_m, v_m))
+        out = self.decoder.ln(x)
+        logits = self._logits(F, out[:, -1:, :])[:, 0]
+        state = {"self_kv": tuple(self_kv), "cross_kv": tuple(cross_kv),
+                 "mem_vl": vl_raw}
+        return logits, state
+
+    def decode_step(self, tokens, pos, state):
+        """One O(1) incremental decode step: place ``tokens`` (B,) int32
+        at absolute target position ``pos`` (a traced scalar; the number
+        of tokens already cached) and return ``(logits, new_state)`` —
+        ``logits`` (B, vocab) predict position ``pos + 1``'s token and
+        bit-match column ``pos`` of a full re-forward."""
+        from ... import ndarray as F
+
+        x = self._embed_step(tokens, pos)
+        mem_vl = state["mem_vl"]
+        mem_vl_nd = None if mem_vl is None else NDArray(mem_vl)
+        new_self = []
+        for i in range(self.decoder._n):
+            layer = getattr(self.decoder, f"layer{i}")
+            x, kv = layer.step(x, state["self_kv"][i], pos,
+                               state["cross_kv"][i],
+                               mem_valid_length=mem_vl_nd)
+            new_self.append(kv)
+        out = self.decoder.ln(x)
+        logits = self._logits(F, out)[:, 0]
+        return logits, {"self_kv": tuple(new_self),
+                        "cross_kv": state["cross_kv"], "mem_vl": mem_vl}
+
+    def _embed_step(self, tokens, pos):
+        """Single-position target embedding (token + absolute position)."""
+        tok = tokens.data if isinstance(tokens, NDArray) else \
+            jnp.asarray(tokens)
+        B = tok.shape[0]
+        ids = NDArray(tok.reshape(B, 1).astype(jnp.int32))
+        pos_ids = NDArray(jnp.broadcast_to(
+            jnp.asarray(pos, jnp.int32).reshape(1, 1), (B, 1)))
+        return self.drop(self.tgt_embed(ids) * (self._units ** 0.5)
+                         + self.pos_embed(pos_ids))
+
+    def generate(self, src_ids, src_valid_length=None, max_new_tokens=32,
+                 **kwargs):
+        """KV-cached generation through a lazily-built (and cached)
+        ``parallel.infer.InferStep``. Engine kwargs (``amp``, ``max_len``,
+        ``bos_id``/``eos_id``/``pad_id``) configure the cached engine;
+        the rest (``method``, ``top_k``, ``temperature``, ``seed``) pass
+        through to ``InferStep.generate``. Returns ``(tokens, lengths)``
+        NDArrays."""
+        from ...parallel.infer import InferStep
+
+        eng_keys = ("amp", "max_len", "bos_id", "eos_id", "pad_id")
+        eng_kw = {k: kwargs.pop(k) for k in eng_keys if k in kwargs}
+        cache_key = tuple(sorted(eng_kw.items()))
+        steps = getattr(self, "_infer_steps", None)
+        if steps is None:
+            steps = {}
+            object.__setattr__(self, "_infer_steps", steps)
+        if cache_key not in steps:
+            steps[cache_key] = InferStep(self, **eng_kw)
+        return steps[cache_key].generate(
+            src_ids, src_valid_length, max_new_tokens=max_new_tokens,
+            **kwargs)
 
 
 def transformer_base(**kwargs):

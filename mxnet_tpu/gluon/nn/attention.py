@@ -11,6 +11,8 @@ from __future__ import annotations
 
 import math
 
+import jax.numpy as jnp
+
 from ...base import MXNetError
 from ..block import HybridBlock
 from .basic_layers import Dense, Dropout
@@ -81,10 +83,13 @@ class MultiHeadAttention(HybridBlock):
         return x.transpose(0, 2, 1, 3).reshape(B, S, H * D)
 
     def hybrid_forward(self, F, query, key=None, value=None,
-                       valid_length=None):
+                       valid_length=None, q_offset=None):
         """``valid_length`` (B,) int: number of non-padding KEY positions per
         batch row (reference softmax ``use_length`` semantics); keys past it
-        are masked out of the attention."""
+        are masked out of the attention. ``q_offset`` (scalar or (B,)):
+        absolute position of query row 0 for the causal mask — the
+        incremental-decode contract where a short (typically length-1)
+        query attends over a longer cached key prefix."""
         use_bshd = self._use_bshd()
         if self._self_attention:
             qkv = self.qkv_proj(query)  # (B, S, 3*units)
@@ -121,6 +126,10 @@ class MultiHeadAttention(HybridBlock):
                 k = self._split(self.k_proj(key))
                 v = self._split(self.v_proj(value))
         use_ring = self._ring_axis is not None
+        if use_ring and q_offset is not None:
+            raise MXNetError(
+                "q_offset (incremental decode) is not supported under "
+                "sequence-parallel attention; decode with ring_axis=None")
         if use_ring:
             from ..block import _in_probe
             from ...parallel import current_mesh
@@ -157,6 +166,7 @@ class MultiHeadAttention(HybridBlock):
                 q, k, v, valid_length, causal=self._causal,
                 sm_scale=1.0 / math.sqrt(self._head_dim),
                 layout="BSHD" if use_bshd else "BHSD",
+                q_offset=q_offset,
             )
         if use_bshd:
             out = out.reshape(out.shape[0], out.shape[1], self._units)
@@ -184,3 +194,125 @@ class MultiHeadAttention(HybridBlock):
         d = self._head_dim
         part = qkv[:, :, :, which * d : (which + 1) * d]
         return part.transpose(0, 2, 1, 3)
+
+    # ----------------------------------------------------- incremental mode
+    # KV-cached decode (Pope et al. 2022). The incremental API always uses
+    # the transpose-free (B, S, H, D) head layout — caches are raw jax
+    # arrays (pytree leaves of the decode state the engine threads through
+    # lax.while_loop), activations stay NDArrays. Self-attention caches are
+    # (max_len, B, H, D) slots written with lax.dynamic_update_slice;
+    # cross-attention "caches" are the memory projections, computed once at
+    # prefill and static afterwards.
+
+    def _heads_bshd(self, x):
+        # (B, L, units) -> (B, L, H, D)
+        return x.reshape(x.shape[0], x.shape[1], self._num_heads,
+                         self._head_dim)
+
+    def _sm_scale(self):
+        return 1.0 / math.sqrt(self._head_dim)
+
+    def _finish(self, F, out):
+        out = out.reshape(out.shape[0], out.shape[1], self._units)
+        out = self.out_proj(out)
+        if self.drop is not None:
+            out = self.drop(out)
+        return out
+
+    def prefill(self, query, valid_length=None):
+        """Full-prefix forward that ALSO returns the projected K/V.
+
+        Self-attention only. Returns ``(out, k, v)`` with ``out`` matching
+        ``__call__`` bit-for-bit (same projections, same dense/flash
+        dispatch) and ``k``/``v`` raw ``(B, S, H, D)`` arrays ready to be
+        seeded into a decode cache."""
+        from ... import ndarray as F
+
+        if not self._self_attention:
+            raise MXNetError("prefill() is the self-attention cache seed; "
+                             "cross-attention uses project_kv()")
+        qkv = self.qkv_proj(query)
+        B, S = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(B, S, self._num_heads, 3 * self._head_dim)
+        d = self._head_dim
+        q = qkv[:, :, :, 0 * d:1 * d]
+        k = qkv[:, :, :, 1 * d:2 * d]
+        v = qkv[:, :, :, 2 * d:3 * d]
+        out = F.flash_attention(
+            q, k, v, valid_length, causal=self._causal,
+            sm_scale=self._sm_scale(), layout="BSHD")
+        return self._finish(F, out), k.data, v.data
+
+    def project_kv(self, key, value=None):
+        """Cross-attention prefill: project the (static) memory once into
+        raw ``(B, S, H, D)`` K/V reused by every decode step."""
+        if self._self_attention:
+            raise MXNetError("project_kv() needs self_attention=False")
+        if value is None:
+            value = key
+        k = self._heads_bshd(self.k_proj(key))
+        v = self._heads_bshd(self.v_proj(value))
+        return k.data, v.data
+
+    def attend(self, query, k, v, valid_length=None, q_offset=None):
+        """Attention of a projected query over precomputed raw
+        ``(B, S, H, D)`` K/V (from ``project_kv``) — the cross-attention
+        half of both prefill and decode."""
+        from ... import ndarray as F
+        from ...ndarray.ndarray import NDArray
+
+        if self._self_attention:
+            raise MXNetError("attend() runs over external K/V; "
+                             "self-attention caches use step()")
+        q = self._heads_bshd(self.q_proj(query))
+        out = F.flash_attention(
+            q, NDArray(k), NDArray(v), valid_length, causal=self._causal,
+            sm_scale=self._sm_scale(), layout="BSHD", q_offset=q_offset)
+        return self._finish(F, out)
+
+    def step(self, query, k_cache, v_cache, pos, valid_length=None):
+        """One incremental self-attention step: O(1) work per token.
+
+        ``query`` (B, 1, units) is the current token's hidden state;
+        ``k_cache``/``v_cache`` are raw ``(max_len, B, H, D)`` slots
+        holding ``pos`` earlier entries; ``pos`` is a (traced) scalar
+        int32 cache offset. The new token's K/V land at row ``pos`` via
+        ``lax.dynamic_update_slice`` and the query attends causally over
+        the cache with ``q_offset=pos`` (the non-square mask fix).
+        Returns ``(out, k_cache, v_cache)`` with the updated caches."""
+        import jax
+        from ... import ndarray as F
+        from ...ndarray.ndarray import NDArray
+
+        if not self._self_attention:
+            raise MXNetError("step() updates a self-attention cache; "
+                             "cross-attention uses attend()")
+        qkv = self.qkv_proj(query)
+        B = qkv.shape[0]
+        qkv = qkv.reshape(B, 1, self._num_heads, 3 * self._head_dim)
+        d = self._head_dim
+        q = qkv[:, :, :, 0 * d:1 * d]
+        k_t = qkv[:, :, :, 1 * d:2 * d].data
+        v_t = qkv[:, :, :, 2 * d:3 * d].data
+        idx = (pos.data if hasattr(pos, "asnumpy") else pos, 0, 0, 0)
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, jnp.swapaxes(k_t, 0, 1), idx)
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, jnp.swapaxes(v_t, 0, 1), idx)
+        out = F.flash_attention(
+            q, NDArray(jnp.swapaxes(k_cache, 0, 1)),
+            NDArray(jnp.swapaxes(v_cache, 0, 1)),
+            valid_length, causal=self._causal, sm_scale=self._sm_scale(),
+            layout="BSHD", q_offset=idx[0])
+        return self._finish(F, out), k_cache, v_cache
+
+    def init_cache(self, batch_size, max_len, dtype=None):
+        """Zeroed raw ``(max_len, B, H, D)`` K/V cache pair for ``step``.
+        ``dtype`` defaults to the layer's parameter dtype (so AMP-cast
+        engines allocate compute-dtype caches)."""
+        if dtype is None:
+            dtype = self.out_proj.weight.dtype
+        shape = (int(max_len), int(batch_size), self._num_heads,
+                 self._head_dim)
+        z = jnp.zeros(shape, jnp.dtype(dtype))
+        return z, z

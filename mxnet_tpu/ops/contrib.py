@@ -441,18 +441,29 @@ def _dense_max_seq() -> int:
     return int(_os.environ.get("MXTPU_ATTN_DENSE_MAX", "256"))
 
 
-def _masked_softmax_probs(s, valid_length, causal):
+def _masked_softmax_probs(s, valid_length, causal, q_offset=None):
     """Shared mask+softmax semantics for both dense layouts: scores s
     are ALWAYS (B, H, Sq, Sk); keys past valid_length and acausal
     positions drop out; fully-masked rows (valid_length == 0) zero
-    instead of NaN, like the flash kernel."""
+    instead of NaN, like the flash kernel.
+
+    ``q_offset`` shifts the query positions for the causal mask: query
+    row i sits at absolute position ``q_offset + i``, so a single-token
+    query attending over a KV cache of ``q_offset`` earlier entries gets
+    the correct non-square mask (the incremental-decode contract) instead
+    of the historical ``(L, L)`` square assumption. Scalar or per-row
+    (B,), traced values welcome."""
     if valid_length is not None:
         mask = jnp.arange(s.shape[3])[None, None, None, :] < \
             valid_length.astype(jnp.int32)[:, None, None, None]
         s = jnp.where(mask, s, -jnp.inf)
     if causal:
-        qi = jnp.arange(s.shape[2])[:, None]
-        ki = jnp.arange(s.shape[3])[None, :]
+        qi = jnp.arange(s.shape[2])[None, None, :, None]
+        ki = jnp.arange(s.shape[3])[None, None, None, :]
+        if q_offset is not None:
+            off = jnp.asarray(q_offset, jnp.int32)
+            # scalar offset broadcasts whole-batch; (B,) is per-row
+            qi = qi + off.reshape((-1, 1, 1, 1))
         s = jnp.where(qi >= ki, s, -jnp.inf)
     p = jax.nn.softmax(s, axis=-1)
     if valid_length is not None:
@@ -460,7 +471,8 @@ def _masked_softmax_probs(s, valid_length, causal):
     return p
 
 
-def _dense_attention(q, k, v, valid_length, causal, sm_scale):
+def _dense_attention(q, k, v, valid_length, causal, sm_scale,
+                     q_offset=None):
     """Exact softmax attention over (B, H, S, D); f32 mask/softmax, grad
     via XLA autodiff. The score dot runs in the OPERAND dtype and
     upcasts after (identical for f32 inputs; the MXU accumulates bf16
@@ -470,11 +482,12 @@ def _dense_attention(q, k, v, valid_length, causal, sm_scale):
     score dot would leak an f32 cotangent into bf16 matmuls
     (tools/check_amp_purity.py flags exactly that)."""
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * sm_scale
-    p = _masked_softmax_probs(s, valid_length, causal)
+    p = _masked_softmax_probs(s, valid_length, causal, q_offset)
     return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
 
 
-def _dense_attention_bshd(q, k, v, valid_length, causal, sm_scale):
+def _dense_attention_bshd(q, k, v, valid_length, causal, sm_scale,
+                          q_offset=None):
     """Exact softmax attention over (B, S, H, D) operands: the einsums
     carry the head batch dim in place, so the model never writes a head
     transpose. Measured perf-NEUTRAL on v5e (the per-layer QKV copies
@@ -484,14 +497,14 @@ def _dense_attention_bshd(q, k, v, valid_length, causal, sm_scale):
     # score dot in operand dtype, f32 after (see _dense_attention: keeps
     # the backward's dq/dk matmuls low-precision under AMP)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * sm_scale
-    p = _masked_softmax_probs(s, valid_length, causal)
+    p = _masked_softmax_probs(s, valid_length, causal, q_offset)
     return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), v)
 
 
 @register("_contrib_flash_attention", aliases=["flash_attention"])
 def _flash_attention_op(query, key, value, valid_length=None, causal=False,
                         sm_scale=None, block_q=128, block_k=128,
-                        layout="BHSD", **kw):
+                        layout="BHSD", q_offset=None, **kw):
     """Fused O(S)-memory attention (beyond-reference: replaces the O(L^2)
     interleaved ops of src/operator/contrib/transformer.cc [unverified] as
     the long-context path). ``layout``: "BHSD" (default) takes
@@ -506,29 +519,42 @@ def _flash_attention_op(query, key, value, valid_length=None, causal=False,
     sequences take the O(S)-memory Pallas flash kernel. Both are
     numerically exact softmax attention. NOTE the dense path materializes
     the O(Sq*Sk) score tensor: callers choosing this op specifically for
-    O(S) memory at short S should set MXTPU_ATTN_DENSE_MAX=0."""
+    O(S) memory at short S should set MXTPU_ATTN_DENSE_MAX=0.
+
+    ``q_offset`` (scalar or (B,), traced ok) shifts causal query
+    positions: query row i is at absolute position ``q_offset + i``.
+    This is the incremental-decode mask — a ``query_len=1`` query over a
+    KV cache of ``q_offset`` earlier entries. Offset and single-token
+    queries always run the dense path: a (B, H, 1, Sk) score row IS
+    O(Sk) memory, so the flash kernel's block machinery (which bakes in
+    square (L, L) position math) buys nothing there."""
     from .pallas import flash_attention as _fa
 
     # keyword args bypass invoke()'s NDArray unwrapping — accept both
     # styles; NOT getattr(..., "data"): numpy arrays expose a memoryview
     if hasattr(valid_length, "asnumpy"):
         valid_length = valid_length.data
+    if hasattr(q_offset, "asnumpy"):
+        q_offset = q_offset.data
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(query.shape[-1])
     if layout == "BSHD":
         # transpose-free short-seq path; the Pallas kernel wants BHSD,
         # so long sequences pay the transpose only when they must
-        if max(query.shape[1], key.shape[1]) <= _dense_max_seq():
+        if q_offset is not None or query.shape[1] == 1 or \
+                max(query.shape[1], key.shape[1]) <= _dense_max_seq():
             return _dense_attention_bshd(query, key, value, valid_length,
-                                         bool(causal), float(sm_scale))
+                                         bool(causal), float(sm_scale),
+                                         q_offset)
         tq, tk, tv = (x.transpose(0, 2, 1, 3)
                       for x in (query, key, value))
         out = _fa(tq, tk, tv, valid_length, bool(causal), sm_scale,
                   int(block_q), int(block_k))
         return out.transpose(0, 2, 1, 3)
-    if max(query.shape[2], key.shape[2]) <= _dense_max_seq():
+    if q_offset is not None or query.shape[2] == 1 or \
+            max(query.shape[2], key.shape[2]) <= _dense_max_seq():
         return _dense_attention(query, key, value, valid_length,
-                                bool(causal), float(sm_scale))
+                                bool(causal), float(sm_scale), q_offset)
     return _fa(query, key, value, valid_length, bool(causal), sm_scale,
                int(block_q), int(block_k))
 

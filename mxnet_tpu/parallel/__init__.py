@@ -154,5 +154,7 @@ def init_process_group(coordinator_address: str, num_processes: int,
 from .step import (  # noqa: E402  (public API; needs defs above)
     TrainStep, DeviceBatch, plan_batch, hbm_budget_bytes,
 )
+from .infer import InferStep  # noqa: E402  (inference twin of TrainStep)
 
-__all__ += ["TrainStep", "DeviceBatch", "plan_batch", "hbm_budget_bytes"]
+__all__ += ["TrainStep", "DeviceBatch", "plan_batch", "hbm_budget_bytes",
+            "InferStep"]

@@ -1,0 +1,487 @@
+"""Whole-model jitted inference step: the serving twin of ``TrainStep``.
+
+Where ``TrainStep`` compiles forward+backward+optimizer into one donated
+XLA program, ``InferStep`` compiles the *serving* hot paths:
+
+- ``__call__`` — one jitted predict-mode forward (dropout off, aux state
+  frozen) for scoring / encoder workloads (e.g. BERT prefill);
+- ``prefill`` + ``decode_n`` — KV-cached autoregressive generation for
+  nets speaking the incremental protocol (``net.prefill`` /
+  ``net.decode_step``, see ``gluon.model_zoo.transformer``): prefill
+  encodes the (bucket-padded) prompt and seeds per-layer
+  ``(max_len, B, H, D)`` caches; ``decode_n`` runs a ``lax.while_loop``
+  of O(1) incremental steps with the cache DONATED into the loop and an
+  early exit once every row has emitted EOS. One jitted dispatch emits up
+  to ``max_new_tokens`` tokens — no per-token host round trips
+  (``tools/check_no_sync_in_step.py`` lints ``__call__``/``_dispatch``/
+  ``decode_n``).
+
+Shape stability reuses the PR-3 machinery: prompts pad to a
+``FixedBucketSampler.signatures()``-style bucket menu, ``warmup()``
+drives the REAL jitted prefill+decode programs per bucket signature, the
+``RecompileGuard`` counts every signature as exactly one compile and
+alarms on post-warmup churn, and the persistent compilation cache makes
+the programs outlive the process. ``amp='bfloat16'`` casts float params
+(minus the ``amp.lists`` norm families) ONCE at build — inference has no
+master-weight round trip, so the cast is free after construction.
+
+Env knobs: ``MXTPU_DECODE_MAX_LEN`` (default decode cache capacity, 256).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import compile_cache as _cc
+from .. import telemetry as _tel
+
+__all__ = ["InferStep", "decode_max_len"]
+
+
+def decode_max_len(default: int = 256) -> int:
+    """``MXTPU_DECODE_MAX_LEN``: default KV-cache capacity (= prompt-side
+    decode slots) for engines built without an explicit ``max_len``."""
+    v = os.environ.get("MXTPU_DECODE_MAX_LEN", "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def _sample_tokens(logits, key, method, top_k, temperature):
+    """Next-token draw from (B, V) logits. ``method``/``top_k`` are
+    trace-time constants; ``temperature`` is a traced scalar so serving
+    can change it without recompiling."""
+    if method == "greedy":
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if method == "top_k":
+        vals, idx = jax.lax.top_k(logits, top_k)
+        draw = jax.random.categorical(key, vals / temperature, axis=-1)
+        return jnp.take_along_axis(idx, draw[:, None], axis=1)[:, 0].astype(
+            jnp.int32)
+    if method == "sample":
+        return jax.random.categorical(
+            key, logits / temperature, axis=-1).astype(jnp.int32)
+    raise MXNetError(f"unknown sampling method {method!r}; "
+                     "use greedy/top_k/sample")
+
+
+class InferStep:
+    """Compile a net's inference paths into jitted, shape-stable programs.
+
+    Parameters
+    ----------
+    net : initialized Gluon Block. Any net gets the jitted ``__call__``
+        forward; nets implementing the incremental protocol
+        (``prefill(src, tgt_prefix, src_valid_length, max_len)`` +
+        ``decode_step(tokens, pos, state)``) additionally get
+        ``prefill``/``decode_n``/``generate``.
+    mesh / data_spec : optional GSPMD placement for batch inputs
+        (parameters are replicated — serving shards the batch).
+    amp : 'bfloat16'/'float16' — cast float params (minus ``amp.lists``
+        norm families) once at build; activations follow the param dtype.
+    max_len : decode cache capacity (``MXTPU_DECODE_MAX_LEN`` default).
+    bos_id / eos_id / pad_id : special token ids for generation.
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 data_spec=None, amp: Optional[str] = None,
+                 max_len: Optional[int] = None,
+                 bos_id: int = 1, eos_id: int = 2, pad_id: int = 0):
+        from .. import amp as _amp_mod
+
+        self._net = net
+        self._mesh = mesh
+        self._max_len = int(max_len) if max_len is not None \
+            else decode_max_len()
+        self._bos, self._eos, self._pad = int(bos_id), int(eos_id), int(pad_id)
+        if amp is not None:
+            amp = str(amp)
+            if amp not in ("bfloat16", "float16"):
+                raise MXNetError("amp must be 'bfloat16' or 'float16'")
+        self._amp = amp
+        self._params = list(net.collect_params().items())
+        for name, p in self._params:
+            if p._data is None:
+                raise MXNetError(
+                    f"parameter {name} not initialized; run one forward (or "
+                    "initialize with known shapes) before building InferStep")
+        fp32_pinned = _amp_mod.fp32_param_names(net) if amp else frozenset()
+        cdt = jnp.dtype(amp) if amp else None
+
+        def _cast(name, v):
+            # inference AMP: no fp32 masters needed — cast ONCE at build,
+            # norm-family params pinned fp32 per amp.lists
+            if cdt is not None and name not in fp32_pinned and \
+                    jnp.issubdtype(v.dtype, jnp.floating):
+                return v.astype(cdt)
+            return v
+
+        vals = {}
+        repl = NamedSharding(mesh, PartitionSpec()) if mesh is not None \
+            else None
+        for name, p in self._params:
+            v = _cast(name, p._data.data)
+            if repl is not None:
+                v = jax.device_put(v, repl)
+            vals[name] = v
+        self._values = vals
+        self._cache_dtype = cdt
+
+        # batch placement (mirrors TrainStep's data_spec contract)
+        if mesh is not None:
+            if data_spec is None:
+                data_spec = PartitionSpec("data") \
+                    if "data" in mesh.axis_names else PartitionSpec()
+            if isinstance(data_spec, (tuple, list)) and not isinstance(
+                    data_spec, PartitionSpec):
+                self._data_sharding = [NamedSharding(mesh, s)
+                                       for s in data_spec]
+            else:
+                self._data_sharding = NamedSharding(mesh, data_spec)
+        else:
+            self._data_sharding = None
+
+        self._fwd_tree = [None]  # output treedef captured at trace time
+        self._fwd_fn = self._build_forward()
+        # predict mode draws no randomness: one fixed key serves every
+        # forward dispatch (built here so _dispatch stays pure dispatch)
+        self._fixed_key = jax.random.PRNGKey(0)
+        self._prefill_fns = {}  # max_len is closed over; keyed by it
+        self._decode_fns = {}   # (max_new, method, top_k) -> jitted fn
+        self.compile_guard = _cc.RecompileGuard(
+            f"InferStep({type(net).__name__})")
+        _tel.set_info(amp_dtype=self._amp, infer_engine=type(net).__name__)
+
+    @property
+    def supports_decode(self) -> bool:
+        return hasattr(self._net, "prefill") and \
+            hasattr(self._net, "decode_step")
+
+    # ---------------------------------------------------------------- build
+    def _net_scope(self, values, key):
+        """Context stack for tracing the net functionally: params resolve
+        to the (cast, device) values, predict mode, supplied PRNG key."""
+        import contextlib
+
+        from ..gluon.block import _aux_scope, _trace_scope
+        from ..gluon.parameter import param_override
+        from .. import autograd
+        from .. import random as _random
+        from . import mesh_scope as _mesh_scope
+
+        name2p = {n: p for n, p in self._params}
+        mapping = {name2p[n]: NDArray(v) for n, v in values.items()}
+        stack = contextlib.ExitStack()
+        if self._mesh is not None:
+            stack.enter_context(_mesh_scope(self._mesh))
+        stack.enter_context(param_override(mapping))
+        stack.enter_context(_random.key_supply(key))
+        stack.enter_context(_aux_scope({}))  # aux writes dropped: predict
+        stack.enter_context(_trace_scope())
+        stack.enter_context(autograd._scope(False, False))
+        return stack
+
+    def _build_forward(self):
+        net, tree_holder = self._net, self._fwd_tree
+
+        def fwd(values, batch, key):
+            with self._net_scope(values, key):
+                out = net(*[NDArray(b) for b in batch])
+            leaves, tree = jax.tree.flatten(
+                out, is_leaf=lambda x: isinstance(x, NDArray))
+            tree_holder[0] = tree
+            return tuple(o.data if isinstance(o, NDArray) else jnp.asarray(o)
+                         for o in leaves)
+
+        return jax.jit(fwd)
+
+    def _get_prefill_fn(self, max_len):
+        fn = self._prefill_fns.get(max_len)
+        if fn is not None:
+            return fn
+        net, cache_dtype = self._net, self._cache_dtype
+
+        def prefill(values, src, vl, prime, key, temperature):
+            with self._net_scope(values, key):
+                logits, state = net.prefill(
+                    NDArray(src), NDArray(prime),
+                    src_valid_length=NDArray(vl), max_len=max_len,
+                    cache_dtype=cache_dtype)
+            return logits.data.astype(jnp.float32), state
+
+        fn = jax.jit(prefill)
+        self._prefill_fns[max_len] = fn
+        return fn
+
+    def _get_decode_fn(self, max_new, method, top_k):
+        cfg = (max_new, method, top_k)
+        fn = self._decode_fns.get(cfg)
+        if fn is not None:
+            return fn
+        net, eos, pad = self._net, self._eos, self._pad
+
+        def decode(values, state, first_logits, prefix_len, key,
+                   temperature):
+            B = first_logits.shape[0]
+            key, sub = jax.random.split(key)
+            tok0 = _sample_tokens(first_logits, sub, method, top_k,
+                                  temperature)
+            buf = jnp.full((B, max_new), pad, jnp.int32)
+            buf = jax.lax.dynamic_update_slice(buf, tok0[:, None], (0, 0))
+            fin0 = tok0 == eos
+
+            def cond(c):
+                i = c[0]
+                return jnp.logical_and(i < max_new,
+                                       jnp.logical_not(jnp.all(c[2])))
+
+            def body(c):
+                i, tok, fin, st, k, bf = c
+                # tok is the PREVIOUS emitted token buf[i-1]: it sits at
+                # absolute target position prefix_len + i - 1
+                with self._net_scope(values, jax.random.PRNGKey(0)):
+                    logits, st = net.decode_step(
+                        tok, prefix_len + i - 1, st)
+                logits = logits.data if isinstance(logits, NDArray) \
+                    else logits
+                k, sk = jax.random.split(k)
+                nxt = _sample_tokens(logits.astype(jnp.float32), sk, method,
+                                     top_k, temperature)
+                nxt = jnp.where(fin, jnp.int32(pad), nxt)
+                bf = jax.lax.dynamic_update_slice(bf, nxt[:, None], (0, i))
+                fin = jnp.logical_or(fin, nxt == eos)
+                return i + 1, nxt, fin, st, k, bf
+
+            _, _, fin, _, _, buf = jax.lax.while_loop(
+                cond, body, (jnp.int32(1), tok0, fin0, state, key, buf))
+            has_eos = (buf == eos).any(axis=1)
+            first_eos = jnp.argmax(buf == eos, axis=1)
+            lengths = jnp.where(has_eos, first_eos + 1,
+                                jnp.int32(max_new)).astype(jnp.int32)
+            return buf, lengths
+
+        # the cache pytree (argument 1) is DONATED into the loop: decode
+        # reuses the prefill-seeded buffers instead of copying them. The
+        # CPU test backend can't alias pass-through leaves (the static
+        # cross_kv projections) and warns per dispatch — skip there.
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        fn = jax.jit(decode, donate_argnums=donate)
+        self._decode_fns[cfg] = fn
+        return fn
+
+    # ----------------------------------------------------------------- call
+    def __call__(self, *batch):
+        """One jitted predict-mode forward. Accepts NDArrays / arrays;
+        returns the net's outputs as NDArrays. Pure dispatch after
+        ``_stage`` — the lint keeps it sync-free."""
+        from ..imperative import flush_bulk
+
+        flush_bulk()
+        staged = self._stage(batch)
+        return self._dispatch(staged)
+
+    def _stage(self, batch):
+        """Host-side staging (slow path): convert + optional device_put."""
+        arrs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
+                for b in batch]
+        sh = self._data_sharding
+        if sh is not None:
+            per = sh if isinstance(sh, list) else [sh] * len(arrs)
+            if len(per) != len(arrs):
+                raise MXNetError(
+                    f"data_spec sequence has {len(per)} specs but the "
+                    f"forward takes {len(arrs)} inputs")
+            arrs = [jax.device_put(a, s) for a, s in zip(arrs, per)]
+        return tuple(arrs)
+
+    def _dispatch(self, staged):
+        """Hot dispatch: signature accounting + the jitted call. Must stay
+        free of host syncs (``tools/check_no_sync_in_step.py``)."""
+        sig = ("fwd",) + tuple((a.shape, a.dtype.name) for a in staged)
+        self.compile_guard.observe(
+            sig, lambda: "fwd " + _cc.aval_summary(staged))
+        outs = self._fwd_fn(self._values, staged, self._fixed_key)
+        nds = [NDArray(o) for o in outs]
+        out = jax.tree.unflatten(self._fwd_tree[0], nds)
+        return out
+
+    # --------------------------------------------------------------- decode
+    @staticmethod
+    def _decode_cfg(max_new_tokens, method, top_k, seed):
+        """Host-side config normalization (kept out of the linted decode
+        dispatch — these are Python-value coercions, never device reads)."""
+        max_new = int(max_new_tokens)
+        if max_new < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        return max_new, str(method), int(top_k), \
+            0 if seed is None else int(seed)
+
+    def _stage_src(self, src, src_valid_length):
+        src = src.data if isinstance(src, NDArray) else jnp.asarray(src)
+        src = src.astype(jnp.int32)
+        if src_valid_length is None:
+            vl = jnp.full((src.shape[0],), src.shape[1], jnp.int32)
+        else:
+            vl = src_valid_length.data \
+                if isinstance(src_valid_length, NDArray) \
+                else jnp.asarray(src_valid_length)
+            vl = vl.astype(jnp.int32)
+        if self._data_sharding is not None and not isinstance(
+                self._data_sharding, list):
+            src = jax.device_put(src, self._data_sharding)
+        return src, vl
+
+    def decode_n(self, src, src_valid_length=None, max_new_tokens=32,
+                 method="greedy", top_k=0, temperature=1.0, seed=None,
+                 prefix=None):
+        """KV-cached generation: ONE prefill dispatch + ONE decode-loop
+        dispatch; returns ``(tokens (B, max_new), lengths (B,))`` as
+        NDArrays, asynchronously (no host sync — the decode hot path is
+        linted). ``prefix`` overrides the BOS priming column with an
+        explicit (B, Lp) target prefix."""
+        if not self.supports_decode:
+            raise MXNetError(
+                f"{type(self._net).__name__} does not implement the "
+                "incremental protocol (prefill/decode_step)")
+        max_new, method, top_k, seed = self._decode_cfg(
+            max_new_tokens, method, top_k, seed)
+        src, vl = self._stage_src(src, src_valid_length)
+        B = src.shape[0]
+        if prefix is None:
+            prime = jnp.full((B, 1), self._bos, jnp.int32)
+        else:
+            prime = (prefix.data if isinstance(prefix, NDArray)
+                     else jnp.asarray(prefix)).astype(jnp.int32)
+        if prime.shape[1] + max_new > self._max_len:
+            raise MXNetError(
+                f"prefix {prime.shape[1]} + max_new_tokens {max_new} "
+                f"exceeds the decode cache capacity max_len={self._max_len} "
+                "(MXTPU_DECODE_MAX_LEN / InferStep(max_len=...))")
+        key = jax.random.PRNGKey(seed)
+        temp = jnp.float32(temperature)
+        cfg = (max_new, method, top_k)
+        sig = ("decode", cfg, (src.shape, src.dtype.name),
+               (prime.shape, prime.dtype.name))
+        self.compile_guard.observe(
+            sig, lambda: f"decode{cfg} " + _cc.aval_summary((src, prime)))
+        prefill_fn = self._get_prefill_fn(self._max_len)
+        decode_fn = self._get_decode_fn(*cfg)
+        key, pk = jax.random.split(key)
+        logits, state = prefill_fn(self._values, src, vl, prime, pk, temp)
+        toks, lengths = decode_fn(self._values, state, logits,
+                                  jnp.int32(prime.shape[1]), key, temp)
+        return NDArray(toks), NDArray(lengths)
+
+    def generate(self, src, src_valid_length=None, max_new_tokens=32,
+                 **kwargs):
+        """User-facing generation. Same contract as ``decode_n``; when
+        telemetry is enabled the prefill and decode dispatches are timed
+        (blocking — the instrumented path trades the async dispatch for
+        honest ``infer/prefill_ms`` and ``infer/decode_ms_per_token``)."""
+        if not _tel._ENABLED:
+            return self.decode_n(src, src_valid_length,
+                                 max_new_tokens=max_new_tokens, **kwargs)
+        return self._generate_timed(src, src_valid_length, max_new_tokens,
+                                    **kwargs)
+
+    def _generate_timed(self, src, src_valid_length, max_new_tokens,
+                        **kwargs):
+        """Telemetry-instrumented generation (cold-ish path: syncs twice
+        per call to attribute prefill vs decode time)."""
+        import time
+
+        reg = _tel.registry()
+        t0 = time.perf_counter()
+        with _tel.span("infer.decode_n"):
+            toks, lengths = self.decode_n(
+                src, src_valid_length, max_new_tokens=max_new_tokens,
+                **kwargs)
+            jax.block_until_ready(toks.data)
+        total_ms = (time.perf_counter() - t0) * 1e3
+        n_tokens = int(jnp.sum(lengths.data))
+        reg.histogram("infer/prefill_ms").observe(total_ms)  # upper bound
+        if n_tokens:
+            reg.histogram("infer/decode_ms_per_token").observe(
+                total_ms / n_tokens)
+            reg.gauge("infer/tokens_per_sec").set(
+                n_tokens / (total_ms / 1e3))
+        reg.counter("infer/tokens").inc(n_tokens)
+        return toks, lengths
+
+    # -------------------------------------------------------------- warmup
+    def warmup(self, signatures, max_new_tokens=None, **decode_kwargs):
+        """AOT-compile the real jitted inference programs for every prompt
+        signature, so the serving loop never compiles.
+
+        ``signatures`` entries are either ``(batch, bucket)`` pairs (the
+        ``FixedBucketSampler.signatures()`` menu — int32 token prompts
+        assumed) or full warmup-style per-array spec sequences for the
+        generic forward. With ``max_new_tokens`` set (and a decode-capable
+        net) each prompt signature drives the REAL prefill+decode
+        programs on zero prompts; otherwise the plain forward. Marks the
+        guard steady afterwards; returns the number of fresh programs."""
+        import numpy as _host_np
+
+        reg = _tel.registry()
+        before = self.compile_guard.signatures
+        for entry in signatures:
+            if len(entry) == 2 and all(
+                    isinstance(x, (int, _host_np.integer)) for x in entry):
+                bs, bucket = int(entry[0]), int(entry[1])
+                src = _host_np.zeros((bs, bucket), _host_np.int32)
+                vl = _host_np.full((bs,), bucket, _host_np.int32)
+                if max_new_tokens is not None and self.supports_decode:
+                    out = self.decode_n(src, vl,
+                                        max_new_tokens=max_new_tokens,
+                                        **decode_kwargs)
+                    jax.block_until_ready(out[0].data)
+                else:
+                    out = self(src)
+                    leaf = jax.tree.leaves(
+                        out, is_leaf=lambda x: isinstance(x, NDArray))[0]
+                    jax.block_until_ready(leaf.data)
+            else:
+                specs = [_cc.normalize_spec(s) for s in entry]
+                host = [_host_np.zeros(shape, dtype)
+                        for shape, dtype in specs]
+                out = self(*host)
+                leaf = jax.tree.leaves(
+                    out, is_leaf=lambda x: isinstance(x, NDArray))[0]
+                jax.block_until_ready(leaf.data)
+        compiled = self.compile_guard.signatures - before
+        reg.counter("compile/warmup_compiles").inc(compiled)
+        self.compile_guard.mark_steady()
+        return compiled
+
+    def cache_info(self) -> dict:
+        """Signature cache summary (``compile_cache.RecompileGuard``)."""
+        return self.compile_guard.info()
+
+    def sync_params(self):
+        """Re-read the net's current parameter values (after external
+        updates, e.g. ``TrainStep.sync_params`` handed fresh weights)."""
+        from .. import amp as _amp_mod
+
+        fp32_pinned = _amp_mod.fp32_param_names(self._net) if self._amp \
+            else frozenset()
+        cdt = self._cache_dtype
+        repl = NamedSharding(self._mesh, PartitionSpec()) \
+            if self._mesh is not None else None
+        vals = {}
+        for name, p in self._params:
+            v = p._data.data
+            if cdt is not None and name not in fp32_pinned and \
+                    jnp.issubdtype(v.dtype, jnp.floating):
+                v = v.astype(cdt)
+            if repl is not None:
+                v = jax.device_put(v, repl)
+            vals[name] = v
+        self._values = vals

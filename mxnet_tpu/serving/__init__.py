@@ -1,0 +1,22 @@
+"""Serving front-end: request-level dynamic batching over ``InferStep``.
+
+The inference engine (``parallel.infer.InferStep``) turns one *batch* of
+prompts into tokens at O(1)/token; this package turns *concurrent
+requests* into those batches (Yu et al., Orca, OSDI 2022 — here the
+iteration granularity is one generation call, with per-request detach at
+EOS trim time): ``DynamicBatcher`` admits requests into fixed
+``(batch, bucket)`` slots — pad-to-bucket prompts, timeout-or-full
+dispatch, per-request future resolution — so the engine only ever sees
+the warmed shape menu and the steady-state loop never compiles.
+
+Env knobs: ``MXTPU_BATCHER_SLOTS`` (batch slots per dispatch, default 8),
+``MXTPU_BATCHER_TIMEOUT_MS`` (admission window, default 10),
+``MXTPU_DECODE_MAX_LEN`` (engine cache capacity — see
+``parallel.infer``).
+"""
+
+from .batcher import DynamicBatcher, GenerationResult, batcher_slots, \
+    batcher_timeout_ms
+
+__all__ = ["DynamicBatcher", "GenerationResult", "batcher_slots",
+           "batcher_timeout_ms"]

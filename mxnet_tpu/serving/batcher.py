@@ -1,0 +1,285 @@
+"""Dynamic batcher: concurrent generation requests -> fixed-shape batches.
+
+Serving traffic arrives one prompt at a time; the TPU wants full batches
+of warmed shapes. ``DynamicBatcher`` bridges them:
+
+- **Admission**: ``submit()`` enqueues a request and returns a
+  ``GenerationResult`` future. A background dispatcher collects up to
+  ``slots`` requests, waiting at most ``timeout_ms`` after the first
+  arrival — the classic timeout-or-full policy (latency bound under
+  trickle load, full batches under pressure).
+- **Fixed (batch, bucket) slots**: every dispatch pads prompts to the
+  smallest bucket-menu boundary that fits the batch and pads the batch
+  itself to exactly ``slots`` rows (empty rows carry ``valid_length=0``,
+  fully masked out of attention) — the engine only ever sees
+  ``len(bucket_keys)`` decode signatures, all warmed by
+  ``InferStep.warmup``, so steady-state serving never compiles.
+- **Per-request detach**: each request resolves independently — its
+  tokens are trimmed at ITS EOS (and its own ``max_new_tokens``) the
+  moment the batch's decode returns, and the slot is free for the next
+  dispatch; a long request never holds another request's result hostage.
+
+Telemetry (``infer/`` family): ``queue_wait_ms`` per request,
+``batch_occupancy`` per dispatch, ``prefill_ms``/``decode_ms_per_token``
+/``tokens_per_sec`` per dispatch, ``requests``/``tokens`` counters.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import telemetry as _tel
+
+__all__ = ["DynamicBatcher", "GenerationResult", "batcher_slots",
+           "batcher_timeout_ms"]
+
+
+def batcher_slots(default: int = 8) -> int:
+    """``MXTPU_BATCHER_SLOTS``: batch rows per dispatch."""
+    v = os.environ.get("MXTPU_BATCHER_SLOTS", "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def batcher_timeout_ms(default: float = 10.0) -> float:
+    """``MXTPU_BATCHER_TIMEOUT_MS``: admission window after the first
+    request of a batch arrives."""
+    v = os.environ.get("MXTPU_BATCHER_TIMEOUT_MS", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+class GenerationResult:
+    """Future for one submitted request. ``result(timeout)`` blocks until
+    the request's decode finished and returns the generated token list
+    (trimmed at EOS); ``exception()`` surfaces a dispatch failure."""
+
+    __slots__ = ("_event", "_tokens", "_error", "enqueued_at",
+                 "queue_wait_ms")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._tokens = None
+        self._error = None
+        self.enqueued_at = time.perf_counter()
+        self.queue_wait_ms = None
+
+    def _resolve(self, tokens):
+        self._tokens = tokens
+        self._event.set()
+
+    def _fail(self, err):
+        self._error = err
+        self._event.set()
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def exception(self):
+        return self._error
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("generation result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._tokens
+
+
+class _Request:
+    __slots__ = ("prompt", "max_new", "future")
+
+    def __init__(self, prompt, max_new, future):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.future = future
+
+
+class DynamicBatcher:
+    """Admit concurrent generation requests into fixed (batch, bucket)
+    engine dispatches.
+
+    Parameters
+    ----------
+    engine : ``parallel.infer.InferStep`` over a decode-capable net.
+    bucket_keys : ascending prompt-length menu (the warmup contract —
+        ``engine.warmup([(slots, k) for k in bucket_keys], max_new)``
+        compiles every shape this batcher can emit).
+    slots : batch rows per dispatch (``MXTPU_BATCHER_SLOTS``).
+    timeout_ms : admission window (``MXTPU_BATCHER_TIMEOUT_MS``).
+    max_new_tokens : decode length of every dispatch (per-request
+        ``max_new_tokens`` may only be <= this; results are trimmed).
+    sampling : dict of ``decode_n`` sampling kwargs (method/top_k/
+        temperature/seed) shared by the batch.
+    warmup : drive the engine's prefill+decode programs for the whole
+        menu at construction (recommended for serving).
+    """
+
+    def __init__(self, engine, bucket_keys: Sequence[int],
+                 slots: Optional[int] = None,
+                 timeout_ms: Optional[float] = None,
+                 max_new_tokens: int = 32, sampling: Optional[dict] = None,
+                 pad_id: Optional[int] = None, warmup: bool = False,
+                 start: bool = True):
+        if not getattr(engine, "supports_decode", False):
+            raise MXNetError(
+                "DynamicBatcher needs a decode-capable InferStep "
+                "(net with prefill/decode_step)")
+        self._engine = engine
+        self.bucket_keys = sorted(int(k) for k in bucket_keys)
+        if not self.bucket_keys:
+            raise MXNetError("bucket_keys must be non-empty")
+        self.slots = int(slots) if slots is not None else batcher_slots()
+        self.timeout_s = (timeout_ms if timeout_ms is not None
+                          else batcher_timeout_ms()) / 1e3
+        self.max_new = int(max_new_tokens)
+        self._sampling = dict(sampling or {})
+        self._pad = int(pad_id) if pad_id is not None else engine._pad
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = None
+        if warmup:
+            engine.warmup([(self.slots, k) for k in self.bucket_keys],
+                          max_new_tokens=self.max_new, **self._sampling)
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Stop the dispatcher; with ``drain`` (default) outstanding
+        requests are dispatched first."""
+        if drain:
+            deadline = time.perf_counter() + timeout
+            while not self._queue.empty() and \
+                    time.perf_counter() < deadline:
+                time.sleep(0.005)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------- requests
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None
+               ) -> GenerationResult:
+        """Enqueue one prompt (1-D int sequence). Returns a future whose
+        ``result()`` is the generated token list, trimmed at EOS and at
+        the request's ``max_new_tokens`` (<= the batcher's)."""
+        prompt = _np.asarray(prompt_ids, dtype=_np.int32).reshape(-1)
+        if prompt.shape[0] > self.bucket_keys[-1]:
+            raise MXNetError(
+                f"prompt length {prompt.shape[0]} exceeds the largest "
+                f"bucket key {self.bucket_keys[-1]}")
+        max_new = self.max_new if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new > self.max_new:
+            raise MXNetError(
+                f"request max_new_tokens {max_new} > batcher "
+                f"max_new_tokens {self.max_new}")
+        fut = GenerationResult()
+        self._queue.put(_Request(prompt, max_new, fut))
+        return fut
+
+    # ------------------------------------------------------------ dispatcher
+    def _run(self):
+        while not self._stop.is_set():
+            try:
+                first = self._queue.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            reqs = [first]
+            deadline = time.perf_counter() + self.timeout_s
+            while len(reqs) < self.slots:
+                remaining = deadline - time.perf_counter()
+                if remaining <= 0:
+                    break
+                try:
+                    reqs.append(self._queue.get(timeout=remaining))
+                except queue.Empty:
+                    break
+            t0 = time.perf_counter()
+            try:
+                out = self._dispatch(reqs)
+            except Exception as e:  # noqa: BLE001 - fail the futures, not the thread
+                for r in reqs:
+                    r.future._fail(e)
+                continue
+            self._resolve(reqs, out, t0)
+
+    def _bucket_for(self, max_len):
+        for k in self.bucket_keys:
+            if max_len <= k:
+                return k
+        raise MXNetError(
+            f"prompt length {max_len} > largest bucket key "
+            f"{self.bucket_keys[-1]}")
+
+    def _dispatch(self, reqs):
+        """Assemble one fixed (slots, bucket) batch and fire the engine.
+        Pure staging + dispatch — linted sync-free
+        (``tools/check_no_sync_in_step.py``): the host reads happen in
+        ``_resolve`` after the device work is in flight."""
+        bucket = self._bucket_for(max(r.prompt.shape[0] for r in reqs))
+        src = _np.full((self.slots, bucket), self._pad, _np.int32)
+        vl = _np.zeros((self.slots,), _np.int32)
+        for i, r in enumerate(reqs):
+            n = r.prompt.shape[0]
+            src[i, :n] = r.prompt
+            vl[i] = n
+        return self._engine.decode_n(
+            src, vl, max_new_tokens=self.max_new, **self._sampling)
+
+    def _resolve(self, reqs, out, t0):
+        """Per-request detach: trim each row at its EOS / its own
+        ``max_new_tokens`` and resolve its future. The host read here is
+        the sync point of the whole pipeline."""
+        tokens_nd, lengths_nd = out
+        tokens = tokens_nd.asnumpy()
+        lengths = lengths_nd.asnumpy()
+        dispatch_ms = (time.perf_counter() - t0) * 1e3
+        now = time.perf_counter()
+        reg = _tel.registry()
+        emitted = 0
+        for i, r in enumerate(reqs):
+            n = min(int(lengths[i]), r.max_new)
+            r.future.queue_wait_ms = (now - r.future.enqueued_at) * 1e3 \
+                - dispatch_ms
+            reg.histogram("infer/queue_wait_ms").observe(
+                max(r.future.queue_wait_ms, 0.0))
+            emitted += n
+            r.future._resolve(tokens[i, :n].tolist())
+        reg.counter("infer/requests").inc(len(reqs))
+        reg.counter("infer/tokens").inc(emitted)
+        reg.gauge("infer/batch_occupancy").set(len(reqs) / self.slots)
+        reg.histogram("infer/prefill_ms").observe(dispatch_ms)
+        if emitted:
+            reg.histogram("infer/decode_ms_per_token").observe(
+                dispatch_ms / emitted)
+            reg.gauge("infer/tokens_per_sec").set(
+                emitted / (dispatch_ms / 1e3))

@@ -294,6 +294,12 @@ def _install_jax_compile_listener():
 
 
 # ------------------------------------------------------------------ report
+def _hp(snap, name, q):
+    """Histogram percentile from a registry snapshot, None-safe."""
+    h = snap["histograms"].get(name)
+    return h[q] if h else None
+
+
 def report() -> dict:
     """One-call run summary: step-time percentiles, throughput, compile
     time, HBM high-water mark, plus the full registry snapshot."""
@@ -336,6 +342,20 @@ def report() -> dict:
         "input_wait_ms_p50": wait_hist["p50"] if wait_hist else None,
         "input_wait_ms_p95": wait_hist["p95"] if wait_hist else None,
         "input_queue_depth": snap["gauges"].get("input/queue_depth"),
+        # inference/serving (parallel.infer + serving.batcher): dispatch
+        # prefill/decode timing, serving throughput, admission latency,
+        # slot utilization — all None/0 in training-only processes
+        "infer_prefill_ms_p50": _hp(snap, "infer/prefill_ms", "p50"),
+        "infer_prefill_ms_p95": _hp(snap, "infer/prefill_ms", "p95"),
+        "infer_decode_ms_per_token_p50": _hp(
+            snap, "infer/decode_ms_per_token", "p50"),
+        "infer_tokens_per_sec": snap["gauges"].get("infer/tokens_per_sec"),
+        "infer_batch_occupancy": snap["gauges"].get(
+            "infer/batch_occupancy"),
+        "infer_queue_wait_ms_p50": _hp(snap, "infer/queue_wait_ms", "p50"),
+        "infer_queue_wait_ms_p95": _hp(snap, "infer/queue_wait_ms", "p95"),
+        "infer_requests": snap["counters"].get("infer/requests", 0),
+        "infer_tokens": snap["counters"].get("infer/tokens", 0),
         "counters": snap["counters"],
         "gauges": snap["gauges"],
         "histograms": snap["histograms"],

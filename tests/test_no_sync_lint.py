@@ -1,6 +1,8 @@
-"""tools/check_no_sync_in_step.py as a tier-1 unit test: the TrainStep
-pre-placed fast path (__call__ + _dispatch) must stay free of blocking
-host syncs, or the async device-feed overlap silently degrades."""
+"""tools/check_no_sync_in_step.py as a tier-1 unit test: the jitted hot
+paths — TrainStep's pre-placed fast path (__call__ + _dispatch), the
+inference engine's decode path (InferStep.__call__/_dispatch/decode_n)
+and the serving batcher's dispatch — must stay free of blocking host
+syncs, or the async overlap / O(1)-per-token decode silently degrades."""
 
 import os
 import sys
@@ -13,6 +15,22 @@ def test_fast_path_is_sync_free():
     violations = check_no_sync_in_step.find_violations()
     assert not violations, "\n".join(
         f"step.py:{ln}: {msg}" for ln, msg in violations)
+
+
+def test_all_hot_paths_are_sync_free():
+    """Train, inference, and serving hot paths together (TARGETS)."""
+    violations = check_no_sync_in_step.find_all_violations()
+    assert not violations, "\n".join(
+        f"{path}:{ln}: {msg}" for path, ln, msg in violations)
+
+
+def test_targets_cover_inference_engine():
+    """The lint must keep covering the decode hot path named in the
+    serving contract — a rename that silently drops coverage fails."""
+    covered = {(os.path.basename(p), cls): set(funcs)
+               for p, cls, funcs in check_no_sync_in_step.TARGETS}
+    assert "decode_n" in covered[("infer.py", "InferStep")]
+    assert "_dispatch" in covered[("batcher.py", "DynamicBatcher")]
 
 
 def test_lint_catches_a_violation(tmp_path):
@@ -30,3 +48,21 @@ def test_lint_catches_a_violation(tmp_path):
     assert len(violations) == 2
     assert any("float" in m for _, m in violations)
     assert any("asnumpy" in m for _, m in violations)
+
+
+def test_lint_catches_decode_violation(tmp_path):
+    """Same self-test for the inference target shape (custom class +
+    method list)."""
+    bad = tmp_path / "infer_bad.py"
+    bad.write_text(
+        "class InferStep:\n"
+        "    def decode_n(self, src):\n"
+        "        import jax\n"
+        "        out = self._fn(src)\n"
+        "        jax.block_until_ready(out)\n"
+        "        return out\n"
+    )
+    violations = check_no_sync_in_step.find_violations(
+        str(bad), "InferStep", ("decode_n",))
+    assert len(violations) == 1
+    assert "block_until_ready" in violations[0][1]

@@ -1,20 +1,29 @@
 #!/usr/bin/env python
-"""Lint: TrainStep's dispatch fast path must never block on the device.
+"""Lint: the jitted hot paths must never block on the device.
 
-The async device-feed pipeline (``gluon.data.prefetch``) only overlaps
-input with compute if ``TrainStep.__call__``'s pre-placed fast path —
-``__call__`` itself plus ``_dispatch`` — stays pure dispatch: any host
-synchronization there (``.asnumpy()``, ``float(loss)``, ``np.asarray`` on
-a device array, ``block_until_ready``) serializes the step against the
-transfer and silently un-does the tentpole. This check walks the AST of
-``mxnet_tpu/parallel/step.py`` and flags blocking calls in those bodies.
+Two pipelines depend on it:
+
+- **Training** — the async device-feed overlap (``gluon.data.prefetch``)
+  only works if ``TrainStep.__call__``'s pre-placed fast path (``__call__``
+  + ``_dispatch``) stays pure dispatch.
+- **Inference/serving** — the decode hot path (``InferStep.__call__`` /
+  ``_dispatch`` / ``decode_n`` and ``DynamicBatcher._dispatch``) must
+  fire prefill + the whole decode loop without a single host sync, or
+  every generation call serializes against the device and the O(1)/token
+  engine degrades back to host-latency-per-token.
+
+Any host synchronization there (``.asnumpy()``, ``float(loss)``,
+``np.asarray`` on a device array, ``block_until_ready``) silently un-does
+the tentpole; this check walks the AST of the listed (file, class,
+methods) targets and flags blocking calls.
 
 Run standalone (nonzero exit on violations)::
 
     python tools/check_no_sync_in_step.py
 
 or through the tier-1 suite (``tests/test_no_sync_lint.py`` imports
-``find_violations`` and asserts it returns nothing).
+``find_violations``/``find_all_violations`` and asserts they return
+nothing).
 """
 
 from __future__ import annotations
@@ -23,14 +32,26 @@ import ast
 import os
 import sys
 
-STEP_PY = os.path.normpath(os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), os.pardir,
-    "mxnet_tpu", "parallel", "step.py"))
+_ROOT = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir))
+STEP_PY = os.path.join(_ROOT, "mxnet_tpu", "parallel", "step.py")
+INFER_PY = os.path.join(_ROOT, "mxnet_tpu", "parallel", "infer.py")
+BATCHER_PY = os.path.join(_ROOT, "mxnet_tpu", "serving", "batcher.py")
 
-# the fast-path bodies: __call__ (DeviceBatch detection + dispatch) and
-# _dispatch (the staged-operand hot dispatch). _stage is deliberately NOT
-# linted — it is the slow path the fast path exists to skip.
+# the train-step fast-path bodies: __call__ (DeviceBatch detection +
+# dispatch) and _dispatch (the staged-operand hot dispatch). _stage is
+# deliberately NOT linted — it is the slow path the fast path skips.
 FAST_PATH_FUNCS = ("__call__", "_dispatch")
+
+# every linted (file, class, methods) hot path. The inference engine's
+# decode_n is the whole generation dispatch; the batcher's _dispatch
+# assembles and fires a batch (its _resolve is the designated sync
+# point and stays unlinted).
+TARGETS = (
+    (STEP_PY, "TrainStep", FAST_PATH_FUNCS),
+    (INFER_PY, "InferStep", ("__call__", "_dispatch", "decode_n")),
+    (BATCHER_PY, "DynamicBatcher", ("_dispatch",)),
+)
 
 # method attributes that force a device->host readback / host sync
 BLOCKING_ATTRS = {
@@ -48,57 +69,73 @@ BLOCKING_QUALIFIED = {
 }
 
 
-def find_violations(path: str = STEP_PY):
-    """Return [(lineno, message)] for blocking calls inside the fast-path
-    bodies of TrainStep."""
+def find_violations(path: str = STEP_PY, class_name: str = "TrainStep",
+                    funcs=FAST_PATH_FUNCS):
+    """Return [(lineno, message)] for blocking calls inside the given
+    class's listed method bodies."""
     with open(path) as f:
         tree = ast.parse(f.read(), filename=path)
     out = []
     classes = [n for n in tree.body
-               if isinstance(n, ast.ClassDef) and n.name == "TrainStep"]
+               if isinstance(n, ast.ClassDef) and n.name == class_name]
     if not classes:
-        return [(0, f"TrainStep class not found in {path}")]
-    funcs = [n for n in classes[0].body
-             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-             and n.name in FAST_PATH_FUNCS]
-    missing = set(FAST_PATH_FUNCS) - {f.name for f in funcs}
+        return [(0, f"{class_name} class not found in {path}")]
+    fns = [n for n in classes[0].body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+           and n.name in funcs]
+    missing = set(funcs) - {f.name for f in fns}
     if missing:
         out.append((classes[0].lineno,
-                    f"fast-path method(s) {sorted(missing)} not found — "
-                    "update FAST_PATH_FUNCS if the hot path was renamed"))
-    for fn in funcs:
+                    f"{class_name} hot-path method(s) {sorted(missing)} "
+                    "not found — update TARGETS if the hot path was "
+                    "renamed"))
+    for fn in fns:
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
                 continue
             f = node.func
             if isinstance(f, ast.Name) and f.id in BLOCKING_BUILTINS:
                 out.append((node.lineno,
-                            f"{fn.name}: host coercion {f.id}(...) blocks "
-                            "on the device value"))
+                            f"{class_name}.{fn.name}: host coercion "
+                            f"{f.id}(...) blocks on the device value"))
             elif isinstance(f, ast.Attribute):
                 if f.attr in BLOCKING_ATTRS:
                     out.append((node.lineno,
-                                f"{fn.name}: .{f.attr}() forces a "
-                                "device->host sync"))
+                                f"{class_name}.{fn.name}: .{f.attr}() "
+                                "forces a device->host sync"))
                 elif isinstance(f.value, ast.Name) and \
                         (f.value.id, f.attr) in BLOCKING_QUALIFIED:
                     out.append((node.lineno,
-                                f"{fn.name}: {f.value.id}.{f.attr}(...) "
+                                f"{class_name}.{fn.name}: "
+                                f"{f.value.id}.{f.attr}(...) "
                                 "materializes/stalls on host"))
     return out
 
 
+def find_all_violations():
+    """Lint every TARGETS entry; returns [(path, lineno, message)]."""
+    out = []
+    for path, cls, funcs in TARGETS:
+        for lineno, msg in find_violations(path, cls, funcs):
+            out.append((path, lineno, msg))
+    return out
+
+
 def main(argv=None):
-    path = (argv or sys.argv[1:] or [STEP_PY])[0]
-    violations = find_violations(path)
-    for lineno, msg in violations:
+    args = argv if argv is not None else sys.argv[1:]
+    if args:
+        violations = [(args[0], ln, msg)
+                      for ln, msg in find_violations(args[0])]
+    else:
+        violations = find_all_violations()
+    for path, lineno, msg in violations:
         print(f"{path}:{lineno}: {msg}")
     if violations:
-        print(f"{len(violations)} blocking call(s) in the TrainStep fast "
-              "path — move them off the dispatch path or stage them in "
-              "_stage/device_put_batch")
+        print(f"{len(violations)} blocking call(s) in jitted hot paths — "
+              "move them off the dispatch path (stage in _stage/"
+              "device_put_batch, sync in _resolve)")
         return 1
-    print("TrainStep fast path is sync-free")
+    print("train + inference hot paths are sync-free")
     return 0
 
 

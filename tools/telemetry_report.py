@@ -138,6 +138,36 @@ def _print_compile_family(report_path):
               "shape churn after warmup (bucket/pad inputs)")
 
 
+def _print_infer_family(report_path):
+    """Surface the ``infer/`` metric family (serving spine: prefill /
+    per-token decode latency, throughput, batcher admission wait and slot
+    occupancy) from a ``report.json`` registry snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith("infer/")}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k.startswith("infer/")}
+    hists = {k: v for k, v in report.get("histograms", {}).items()
+             if k.startswith("infer/")}
+    if not counters and not gauges and not hists:
+        return
+    print("\n== Inference / serving ==")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    for k in sorted(hists):
+        h = hists[k]
+        print(f"  {k:<38} p50={h.get('p50')} p95={h.get('p95')} "
+              f"n={h.get('count')}")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -177,6 +207,7 @@ def main(argv=None):
                          "Heartbeat")
         _print_json_file(os.path.join(directory, "report.json"), "Report")
         _print_compile_family(os.path.join(directory, "report.json"))
+        _print_infer_family(os.path.join(directory, "report.json"))
     return 0
 
 
