@@ -32,11 +32,22 @@ VOCAB = 32768
 CEILING = 3.3e5
 
 
-def fixed_main(amp=None, remat=None):
+def fixed_main(amp=None, remat=None, mesh=None, sharding=None):
     import mxnet_tpu as mx
     from mxnet_tpu import gluon, nd, optimizer as opt
     from mxnet_tpu.gluon.model_zoo.transformer import transformer_base
     from mxnet_tpu.parallel import TrainStep
+
+    mesh_obj = None
+    if mesh:
+        from mxnet_tpu.parallel import sharding as _shard
+
+        # --mesh NxM: in-graph SPMD over the first N*M visible devices;
+        # --sharding picks the placement rules (default fsdp: params +
+        # moments sharded so the per-device bytes drop mesh.size-fold)
+        mesh_obj = _shard.make_global_mesh(mesh)
+        if sharding is None:
+            sharding = "fsdp"
 
     net = transformer_base(src_vocab=VOCAB, tgt_vocab=VOCAB, max_length=512,
                            dropout=0.1)
@@ -59,7 +70,7 @@ def fixed_main(amp=None, remat=None):
                  {"compute_dtype": "bfloat16", "state_dtype": "bfloat16"})
     step_fn = TrainStep(net, _Loss(), opt.AdamW(learning_rate=1e-4),
                         steps_per_call=STEPS_PER_CALL, remat=remat,
-                        **precision)
+                        mesh=mesh_obj, sharding=sharding, **precision)
     rng = np.random.RandomState(0)
     n = BATCH * STEPS_PER_CALL
     src = nd.array(rng.randint(0, VOCAB, (n, SRC_LEN)), dtype="int32")
@@ -415,6 +426,14 @@ def main(argv=None):
     ap.add_argument("--auto-batch", action="store_true",
                     help="memory-guided batch planning ablation: fp32 "
                          "vs amp+remat at their largest fitting batches")
+    ap.add_argument("--mesh", default=None,
+                    help="device mesh for the fixed-config row: '4', "
+                         "'2x2' (data x model) or 'data=2,model=2' — the "
+                         "step runs SPMD over that many devices and the "
+                         "row carries mesh_shape/sharding columns")
+    ap.add_argument("--sharding", default=None,
+                    help="sharding rules with --mesh: 'replicated' "
+                         "(data parallel) or 'fsdp' (default)")
     ap.add_argument("--decode", action="store_true",
                     help="KV-cached vs naive re-forward decode ablation")
     ap.add_argument("--decode-tokens", type=int, default=32,
@@ -439,7 +458,8 @@ def main(argv=None):
         return amp_auto_batch_main(args)
     if args.variable_length:
         return variable_length_main(args)
-    return fixed_main(amp=args.amp, remat=args.remat)
+    return fixed_main(amp=args.amp, remat=args.remat, mesh=args.mesh,
+                      sharding=args.sharding)
 
 
 if __name__ == "__main__":

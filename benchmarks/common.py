@@ -38,6 +38,9 @@ def telemetry_fields(step_times=None, compile_time_s=None):
         "hbm_headroom_bytes": None,
         "amp_dtype": None,
         "remat_policy": None,
+        "mesh_shape": None,
+        "sharding": None,
+        "shard_param_bytes_per_shard": None,
     }
     report = None
     try:
@@ -49,6 +52,12 @@ def telemetry_fields(step_times=None, compile_time_s=None):
         info = _tel.run_info()
         fields["amp_dtype"] = info.get("amp_dtype")
         fields["remat_policy"] = info.get("remat_policy")
+        # SPMD sharding columns (parallel.sharding): the mesh/rules the
+        # row ran under and one device's share of the parameter bytes
+        fields["mesh_shape"] = info.get("mesh_shape")
+        fields["sharding"] = info.get("sharding")
+        fields["shard_param_bytes_per_shard"] = _tel.registry().gauge(
+            "shard/param_bytes_per_shard").value
     except Exception:  # noqa: BLE001 - telemetry must never kill a bench
         _tel = None
     if step_times:

@@ -49,6 +49,49 @@ def _norm_bounds(index, shape):
     return out
 
 
+def _encode_spec(a) -> Optional[list]:
+    """JSON-able PartitionSpec of a NamedSharded array (None otherwise):
+    one entry per dim — None, axis name, or a list of axis names."""
+    sh = getattr(a, "sharding", None)
+    spec = getattr(sh, "spec", None)
+    if spec is None:
+        return None
+    out = []
+    for part in tuple(spec):
+        if part is None:
+            out.append(None)
+        elif isinstance(part, (tuple, list)):
+            out.append(list(part))
+        else:
+            out.append(str(part))
+    return out
+
+
+def _spec_sharding(mesh, saved_spec, shape):
+    """Rebuild a NamedSharding on the CURRENT mesh from a saved spec.
+
+    Resharding onto a different mesh is allowed: axes the current mesh
+    does not have (or that no longer divide the dim) degrade to None —
+    that dim comes back replicated rather than failing the restore."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    parts = []
+    for i, part in enumerate(saved_spec or []):
+        names = [part] if isinstance(part, str) else list(part or [])
+        names = [n for n in names if n in mesh.shape]
+        if not names:
+            parts.append(None)
+            continue
+        factor = 1
+        for n in names:
+            factor *= int(mesh.shape[n])
+        if i >= len(shape) or shape[i] % factor:
+            parts.append(None)
+        else:
+            parts.append(names[0] if len(names) == 1 else tuple(names))
+    return NamedSharding(mesh, PartitionSpec(*parts))
+
+
 def save_sharded(directory: str, arrays: Dict[str, jax.Array],
                  extra: Optional[dict] = None) -> str:
     """Write ``arrays`` (possibly sharded jax arrays) under ``directory``.
@@ -103,7 +146,8 @@ def _save_sharded_impl(directory, arrays, extra, proc, nproc):
             "format": "mxnet_tpu-sharded-v1",
             "process_count": nproc,
             "arrays": {
-                name: {"shape": list(a.shape), "dtype": str(a.dtype)}
+                name: {"shape": list(a.shape), "dtype": str(a.dtype),
+                       "spec": _encode_spec(a)}
                 for name, a in
                 ((n, jax.numpy.asarray(v)) for n, v in arrays.items())
             },
@@ -156,21 +200,27 @@ def load_sharded(
     directory: str,
     shardings: Union[None, Dict[str, jax.sharding.Sharding],
                      Callable[[str], Optional[jax.sharding.Sharding]]] = None,
+    mesh=None,
 ) -> Dict[str, jax.Array]:
     """Re-assemble the saved arrays onto the CURRENT devices.
 
     ``shardings`` maps array name -> target ``jax.sharding.Sharding``
-    (dict or callable; None / missing name = default single-device /
-    fully-replicated placement). The target may differ from the layout
-    at save time — each addressable shard's global slice is assembled
-    from whichever saved pieces overlap it.
+    (dict or callable; None / missing name falls back). The target may
+    differ from the layout at save time — each addressable shard's
+    global slice is assembled from whichever saved pieces overlap it,
+    so no process ever materializes the full tree.
+
+    ``mesh`` — the NamedSharded round-trip path: arrays with no explicit
+    target re-place under their SAVED PartitionSpec on this mesh (axes
+    the mesh lacks, or that no longer divide, come back replicated).
+    With neither ``shardings`` nor ``mesh``, placement is single-device.
     """
     with (_tel.span("checkpoint.load_sharded")
           if _tel._ENABLED else _tel.NULL_SPAN):
-        return _load_sharded_impl(directory, shardings)
+        return _load_sharded_impl(directory, shardings, mesh)
 
 
-def _load_sharded_impl(directory, shardings=None):
+def _load_sharded_impl(directory, shardings=None, mesh=None):
     if not is_committed(directory):
         raise MXNetError(
             f"sharded checkpoint {directory} is not committed "
@@ -192,6 +242,10 @@ def _load_sharded_impl(directory, shardings=None):
             shape = tuple(spec["shape"])
             dtype = _np.dtype(spec["dtype"])
             sharding = get_sharding(name)
+            if sharding is None and mesh is not None:
+                # NamedSharded round-trip: re-place under the spec the
+                # array was SAVED with, on the restoring mesh
+                sharding = _spec_sharding(mesh, spec.get("spec"), shape)
             if sharding is None:
                 sharding = jax.sharding.SingleDeviceSharding(
                     jax.local_devices()[0])

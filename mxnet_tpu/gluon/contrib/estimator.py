@@ -277,7 +277,8 @@ class Estimator:
     """
 
     def __init__(self, net, loss, train_metrics=None, val_metrics=None,
-                 trainer=None, context=None, evaluation_loss=None):
+                 trainer=None, context=None, evaluation_loss=None,
+                 train_step=None):
         self.net = net
         self.loss = loss
         self.evaluation_loss = evaluation_loss or loss
@@ -285,9 +286,19 @@ class Estimator:
         self.val_metrics = _as_list(val_metrics) if val_metrics else \
             [type(m)() for m in self.train_metrics]
         self.train_loss_metric = _metric.Loss("train_loss")
-        self.trainer = trainer or Trainer(
-            net.collect_params(), "adam", {"learning_rate": 1e-3}
-        )
+        # train_step: a parallel.TrainStep over the SAME net — fit then
+        # drives the fused sharded XLA step (forward+backward+collectives+
+        # optimizer in ONE donated program, mesh/sharding rules included)
+        # instead of the eager autograd+Trainer path. No Trainer is built
+        # in that mode (the step owns the optimizer); per-batch pred/label
+        # stay on device, so only Loss-type train metrics update.
+        self.train_step = train_step
+        if train_step is not None:
+            self.trainer = trainer
+        else:
+            self.trainer = trainer or Trainer(
+                net.collect_params(), "adam", {"learning_rate": 1e-3}
+            )
         self.context = context
         self.stop_training = False
 
@@ -365,23 +376,29 @@ class Estimator:
                   if _tel._ENABLED else _tel.NULL_SPAN):
                 _dispatch(handlers, "epoch_begin", self)
                 self.train_loss_metric.reset()
-                epoch_iter = self._epoch_iter(train_data, prefetch)
+                epoch_iter = self._epoch_iter(
+                    train_data, prefetch, feed=self.train_step)
                 try:
                     for batch in epoch_iter:
-                        data, label = _split_batch(batch)
                         _dispatch(handlers, "batch_begin", self, batch=batch)
-                        if _tel._ENABLED:
+                        if self.train_step is not None:
+                            pred = label = None
+                            L = self._fused_step(batch)
+                        elif _tel._ENABLED:
+                            data, label = _split_batch(batch)
                             with _tel.span("estimator.forward_backward"):
                                 with autograd.record():
                                     pred = self.net(data)
                                     L = self.loss(pred, label)
                                 L.backward()
+                            self.trainer.step(_batch_size(batch))
                         else:
+                            data, label = _split_batch(batch)
                             with autograd.record():
                                 pred = self.net(data)
                                 L = self.loss(pred, label)
                             L.backward()
-                        self.trainer.step(_batch_size(batch))
+                            self.trainer.step(_batch_size(batch))
                         self.train_loss_metric.update(0, L)
                         _dispatch(handlers, "batch_end", self, batch=batch,
                                   pred=pred, label=label, loss=L)
@@ -418,6 +435,31 @@ class Estimator:
         def _shape_sig(x):
             return (tuple(x.shape), str(getattr(x, "dtype", "?")))
 
+        if self.train_step is not None:
+            # fused path: drive the REAL jitted step per signature
+            # (TrainStep.warmup marks the guard steady afterwards)
+            with (_tel.span("estimator.warmup") if _tel._ENABLED
+                  else _tel.NULL_SPAN):
+                if warmup is True:
+                    seen = []
+                    seen_set = set()
+                    cap = get_env("MXTPU_WARMUP_SCAN", 64, int)
+                    for i, batch in enumerate(train_data):
+                        if i >= cap:
+                            break
+                        data, label = _split_batch(batch)
+                        inputs = tuple(data) if isinstance(
+                            data, (list, tuple)) else (data,)
+                        sig = tuple(_shape_sig(a) for a in inputs) + (
+                            _shape_sig(label),)
+                        if sig in seen_set:
+                            continue
+                        seen_set.add(sig)
+                        seen.append(sig)
+                    self.train_step.warmup(seen)
+                else:
+                    self.train_step.warmup(list(warmup))
+            return
         with (_tel.span("estimator.warmup") if _tel._ENABLED
               else _tel.NULL_SPAN):
             if warmup is True:
@@ -449,17 +491,35 @@ class Estimator:
             L = self.loss(pred, label)
         L.backward()
 
+    def _fused_step(self, batch):
+        """One fused-step dispatch: a pre-placed ``DeviceBatch`` from the
+        prefetcher enters directly; raw batches flatten to the step's
+        ``(input0, ..., label)`` calling convention."""
+        from ...parallel.step import DeviceBatch
+
+        with (_tel.span("estimator.train_step") if _tel._ENABLED
+              else _tel.NULL_SPAN):
+            if isinstance(batch, DeviceBatch):
+                return self.train_step(batch)
+            data, label = _split_batch(batch)
+            inputs = tuple(data) if isinstance(data, (list, tuple)) \
+                else (data,)
+            return self.train_step(*inputs, label)
+
     @staticmethod
-    def _epoch_iter(train_data, prefetch):
+    def _epoch_iter(train_data, prefetch, feed=None):
         """One epoch's batch source: raw, or wrapped in the async device
         feed when ``prefetch`` is set (a fresh single-use pipeline per
-        epoch — the staging thread dies with the epoch)."""
+        epoch — the staging thread dies with the epoch). With ``feed``
+        (the fused ``TrainStep``), the prefetcher stages each batch onto
+        the step's declared placements — sharded mesh layouts included —
+        and yields pre-placed ``DeviceBatch`` objects."""
         if not prefetch:
             return train_data
         from ..data.prefetch import prefetch_to_device
 
         size = None if prefetch is True else int(prefetch)
-        return prefetch_to_device(train_data, size=size)
+        return prefetch_to_device(train_data, size=size, feed=feed)
 
     def _prepare_handlers(self, event_handlers, val_data, epochs, batches):
         handlers = list(_as_list(event_handlers) if event_handlers else [])

@@ -11,6 +11,7 @@ step needs no Trainer-level sync at all (the collective is compiled in).
 from __future__ import annotations
 
 import functools
+import logging
 import time as _time
 from typing import Optional
 
@@ -242,6 +243,23 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None or self._kvstore.num_workers == 1:
             return  # grads already global: single replica or in-program psum
+        from ..parallel import sharding as _shard
+
+        if _shard.mesh_spans_processes():
+            # the process-global mesh covers every worker: gradient sync
+            # is IN-GRAPH (GSPMD psum over the mesh) — the host-side
+            # push/pull loop would double-sum on top of it. Count the
+            # skip so the telemetry shows which sync path is live.
+            if not getattr(self, "_mesh_sync_noted", False):
+                self._mesh_sync_noted = True
+                logging.getLogger(__name__).info(
+                    "global mesh spans all %d processes: host KVStore "
+                    "allreduce skipped (gradient sync is in-graph)",
+                    self._kvstore.num_workers)
+            if _tel._ENABLED:
+                _tel.registry().counter(
+                    "shard/host_allreduce_skipped").inc()
+            return
         if self._update_on_kvstore:
             # the push inside _update() both all-reduces and applies the
             # server-side optimizer; pre-reducing here would double-sum and

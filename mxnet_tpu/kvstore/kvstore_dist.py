@@ -81,7 +81,30 @@ class KVStoreDist(KVStore):
     def num_workers(self):
         return self._num_workers
 
+    def _warn_if_mesh_owns_sync(self):
+        """One-time redundancy alarm: when the process-global mesh spans
+        every worker, gradient sync already happens IN-GRAPH (GSPMD psum
+        inside the jitted step) — an eager host push on top of it
+        double-sums. ``Trainer._allreduce_grads`` skips automatically;
+        direct kvstore users get this warning once."""
+        if getattr(self, "_warned_mesh_sync", False):
+            return
+        from ..parallel import sharding as _shard
+
+        if _shard.mesh_spans_processes():
+            self._warned_mesh_sync = True
+            import warnings
+
+            warnings.warn(
+                "KVStore.push with a process-global mesh spanning all "
+                "workers: gradient sync is in-graph (mesh psum); the "
+                "host allreduce is redundant and double-sums if the "
+                "grads were already synced. Build the step on the mesh "
+                "and drop the push/pull loop.", RuntimeWarning,
+                stacklevel=3)
+
     def _push_impl(self, key, value, priority=0):
+        self._warn_if_mesh_owns_sync()
         keys = _l(key)
         for k, vals in zip(keys, self._grouped(keys, value)):
             k = str(k)

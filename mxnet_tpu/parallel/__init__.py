@@ -151,10 +151,15 @@ def init_process_group(coordinator_address: str, num_processes: int,
     )
 
 
+from . import sharding  # noqa: E402  (SPMD sharding spine)
+from .sharding import (  # noqa: E402
+    ShardingRules, global_mesh, set_global_mesh, make_global_mesh,
+)
 from .step import (  # noqa: E402  (public API; needs defs above)
     TrainStep, DeviceBatch, plan_batch, hbm_budget_bytes,
 )
 from .infer import InferStep  # noqa: E402  (inference twin of TrainStep)
 
 __all__ += ["TrainStep", "DeviceBatch", "plan_batch", "hbm_budget_bytes",
-            "InferStep"]
+            "InferStep", "sharding", "ShardingRules", "global_mesh",
+            "set_global_mesh", "make_global_mesh"]

@@ -41,6 +41,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import compile_cache as _cc
 from .. import telemetry as _tel
+from . import sharding as _sharding
 
 __all__ = ["InferStep", "decode_max_len"]
 
@@ -83,8 +84,14 @@ class InferStep:
         (``prefill(src, tgt_prefix, src_valid_length, max_len)`` +
         ``decode_step(tokens, pos, state)``) additionally get
         ``prefill``/``decode_n``/``generate``.
-    mesh / data_spec : optional GSPMD placement for batch inputs
-        (parameters are replicated — serving shards the batch).
+    mesh / data_spec : optional GSPMD placement for batch inputs; with
+        no explicit mesh the process-global one
+        (``sharding.global_mesh()`` / ``MXTPU_MESH``) is adopted.
+    sharding : ``sharding.ShardingRules``, preset string or None (the
+        ``MXTPU_SHARDING`` default). Parameters are placed under the
+        rules — ``'fsdp'`` serves a model whose full params exceed one
+        chip's HBM (GSPMD gathers shards per layer); default/None keeps
+        the replicated-params + sharded-batch serving layout.
     amp : 'bfloat16'/'float16' — cast float params (minus ``amp.lists``
         norm families) once at build; activations follow the param dtype.
     max_len : decode cache capacity (``MXTPU_DECODE_MAX_LEN`` default).
@@ -94,10 +101,15 @@ class InferStep:
     def __init__(self, net, mesh: Optional[Mesh] = None,
                  data_spec=None, amp: Optional[str] = None,
                  max_len: Optional[int] = None,
-                 bos_id: int = 1, eos_id: int = 2, pad_id: int = 0):
+                 bos_id: int = 1, eos_id: int = 2, pad_id: int = 0,
+                 sharding=None):
         from .. import amp as _amp_mod
 
         self._net = net
+        rules = _sharding.ShardingRules.resolve(sharding)
+        if mesh is None:
+            mesh = _sharding.global_mesh()
+        self._sharding_rules = rules
         self._mesh = mesh
         self._max_len = int(max_len) if max_len is not None \
             else decode_max_len()
@@ -124,16 +136,28 @@ class InferStep:
                 return v.astype(cdt)
             return v
 
+        # param placement: the rules' spec per param (FSDP-sharded
+        # serving), else replicated — serving's classic layout
+        if mesh is not None:
+            if rules is not None:
+                def _param_sharding(name, shape):
+                    return rules.param_sharding(mesh, name, shape)
+            else:
+                def _param_sharding(name, shape):
+                    return NamedSharding(mesh, PartitionSpec())
+        else:
+            _param_sharding = None
+        self._param_sharding = _param_sharding
         vals = {}
-        repl = NamedSharding(mesh, PartitionSpec()) if mesh is not None \
-            else None
         for name, p in self._params:
             v = _cast(name, p._data.data)
-            if repl is not None:
-                v = jax.device_put(v, repl)
+            if _param_sharding is not None:
+                v = jax.device_put(v, _param_sharding(name, v.shape))
             vals[name] = v
         self._values = vals
         self._cache_dtype = cdt
+        if mesh is not None:
+            _sharding.publish_shard_metrics(vals, mesh, rules)
 
         # batch placement (mirrors TrainStep's data_spec contract)
         if mesh is not None:
@@ -467,21 +491,20 @@ class InferStep:
 
     def sync_params(self):
         """Re-read the net's current parameter values (after external
-        updates, e.g. ``TrainStep.sync_params`` handed fresh weights)."""
+        updates, e.g. ``TrainStep.sync_params`` handed fresh weights),
+        re-placing each under its declared sharding."""
         from .. import amp as _amp_mod
 
         fp32_pinned = _amp_mod.fp32_param_names(self._net) if self._amp \
             else frozenset()
         cdt = self._cache_dtype
-        repl = NamedSharding(self._mesh, PartitionSpec()) \
-            if self._mesh is not None else None
         vals = {}
         for name, p in self._params:
             v = p._data.data
             if cdt is not None and name not in fp32_pinned and \
                     jnp.issubdtype(v.dtype, jnp.floating):
                 v = v.astype(cdt)
-            if repl is not None:
-                v = jax.device_put(v, repl)
+            if self._param_sharding is not None:
+                v = jax.device_put(v, self._param_sharding(name, v.shape))
             vals[name] = v
         self._values = vals

@@ -31,6 +31,7 @@ from .. import random as _random
 from .. import telemetry as _tel
 from .. import optimizer as _opt
 from ..ops import optimizer_op as _fused
+from . import sharding as _sharding
 
 __all__ = ["TrainStep", "DeviceBatch", "plan_batch", "hbm_budget_bytes"]
 
@@ -54,7 +55,8 @@ def hbm_budget_bytes(limit_bytes=None) -> Optional[int]:
     return int(limit_bytes - head)
 
 
-def plan_batch(step, signature_fn, budget_bytes, start=1, max_batch=65536):
+def plan_batch(step, signature_fn, budget_bytes, start=1, max_batch=65536,
+               per_shard=None):
     """Largest global batch whose compiled step fits ``budget_bytes``.
 
     ``signature_fn(batch_size)`` returns the warmup-style signature
@@ -64,13 +66,24 @@ def plan_batch(step, signature_fn, budget_bytes, start=1, max_batch=65536):
     lowering only, nothing is materialized. Geometric probe up from
     ``start`` then bisection, so ~2*log2(answer) compiles (persistent
     compilation cache hits on re-runs). Returns ``(batch, peak_bytes)``;
-    ``(0, None)`` when even ``start`` does not fit."""
+    ``(0, None)`` when even ``start`` does not fit.
+
+    ``per_shard`` — bisect against the PER-DEVICE peak
+    (``peak_bytes_per_shard``): the budget is one device's HBM, and a
+    mesh splits the working set across ``mesh.size`` devices. Default
+    auto: per-shard whenever the step runs on a multi-device mesh
+    (``hbm_budget_bytes`` is per-device by construction — it reads the
+    min device ``bytes_limit``)."""
+    if per_shard is None:
+        m = getattr(step, "_mesh", None)
+        per_shard = m is not None and int(m.size) > 1
+    key = "peak_bytes_per_shard" if per_shard else "peak_bytes_estimate"
     memo = {}
 
     def peak(bs):
         if bs not in memo:
-            memo[bs] = step.memory_analysis(
-                signature_fn(bs))["peak_bytes_estimate"]
+            ma = step.memory_analysis(signature_fn(bs))
+            memo[bs] = ma.get(key, ma["peak_bytes_estimate"])
         return memo[bs]
 
     if peak(start) > budget_bytes:
@@ -211,10 +224,20 @@ class TrainStep:
     net : initialized Gluon Block
     loss_fn : gluon Loss block (applied as ``loss_fn(net(*data), label)``)
     optimizer : Optimizer instance (SGD/Adam/AdamW/LAMB fused)
-    mesh : jax Mesh or None (single device)
-    data_spec : PartitionSpec for every batch input (default shard axis 0
-        over 'data' when the mesh has a data axis)
-    param_rules : [(regex, PartitionSpec)] tensor-parallel placement rules
+    mesh : jax Mesh, or None — adopts the process-global mesh
+        (``sharding.global_mesh()`` / ``MXTPU_MESH``); single device when
+        neither is configured
+    sharding : ``sharding.ShardingRules``, preset string ('replicated',
+        'fsdp', 'fsdp:<axis>') or None (the ``MXTPU_SHARDING`` process
+        default). Maps params + optimizer state + batch inputs to
+        ``NamedSharding`` declaratively; 'fsdp' shards parameters AND
+        moments over the data axis so a model larger than one chip's
+        HBM trains (GSPMD inserts the gather/reduce-scatter collectives)
+    data_spec : PartitionSpec for every batch input (default: the rules'
+        batch spec, else shard axis 0 over 'data' when the mesh has one)
+    param_rules : [(regex, PartitionSpec)] tensor-parallel placement
+        rules; checked BEFORE the ``sharding`` rules, so explicit TP
+        placements compose with an FSDP default
     grad_accum : microbatch accumulation steps (lax.scan over microbatches)
 
     Sequence/context parallelism: give the mesh a ``seq`` axis, shard batch
@@ -231,13 +254,19 @@ class TrainStep:
                  donate: bool = True, grad_accum: int = 1,
                  compute_dtype=None, state_dtype=None, steps_per_call: int = 1,
                  remat: Optional[str] = None, amp: Optional[str] = None,
-                 loss_scaler=None):
+                 loss_scaler=None, sharding=None):
         from .. import amp as _amp_mod
         from .. import remat as _remat_mod
 
         self._net = net
         self._loss = loss_fn
         self._optimizer = optimizer
+        # sharding spine: explicit mesh/rules win; otherwise the
+        # process-global mesh (MXTPU_MESH) and rules (MXTPU_SHARDING)
+        rules = _sharding.ShardingRules.resolve(sharding)
+        if mesh is None:
+            mesh = _sharding.global_mesh()
+        self._sharding_rules = rules
         self._mesh = mesh
         self._accum = int(grad_accum)
         # steps_per_call > 1: run that many full optimizer steps per
@@ -317,8 +346,10 @@ class TrainStep:
         if mesh is not None:
             axis_names = mesh.axis_names
             if data_spec is None:
-                data_spec = PartitionSpec("data") if "data" in axis_names \
-                    else PartitionSpec()
+                data_spec = rules.batch_partition_spec(mesh) \
+                    if rules is not None else (
+                        PartitionSpec("data") if "data" in axis_names
+                        else PartitionSpec())
             # data_spec may be ONE spec for every input, or a sequence of
             # per-input specs covering (*batch, label) — ragged inputs like
             # a (B,) valid_length can't share the (B, S) spec
@@ -330,25 +361,39 @@ class TrainStep:
                 ]
             else:
                 self._data_sharding = NamedSharding(mesh, data_spec)
-            rules = [(re.compile(pat), spec) for pat, spec in param_rules]
+            # explicit param_rules first (TP placements), then the
+            # declarative rules' policy (FSDP/replicated), so both compose
+            legacy = [(re.compile(pat), spec) for pat, spec in param_rules]
+            shapes = {n: tuple(p._data.data.shape) for n, p in self._params}
+
+            def param_spec(name):
+                for pat, spec in legacy:
+                    if pat.search(name):
+                        return spec
+                if rules is not None:
+                    return rules.param_spec(
+                        name, shapes.get(name, ()), mesh)
+                return PartitionSpec()
 
             def param_sharding(name):
-                for pat, spec in rules:
-                    if pat.search(name):
-                        return NamedSharding(mesh, spec)
-                return NamedSharding(mesh, PartitionSpec())
+                return NamedSharding(mesh, param_spec(name))
 
+            self._param_spec = param_spec
             self._param_sharding = param_sharding
         else:
             self._data_sharding = None
+            self._param_spec = None
             self._param_sharding = None
 
         # device state ----------------------------------------------------
+        # non-aliasing placement: this state is DONATED every step, so it
+        # must never share buffers with the net's live Parameters
         vals: Dict[str, jax.Array] = {}
         for name, p in self._params:
             v = p._data.data
             if self._param_sharding is not None:
-                v = jax.device_put(v, self._param_sharding(name))
+                v = _sharding.device_put_donatable(
+                    v, self._param_sharding(name))
             vals[name] = v
         self._values = vals  # setter partitions into train/frozen dicts
         def _mk_state(v):
@@ -361,9 +406,12 @@ class TrainStep:
             n: _mk_state(vals[n]) for n in self._train_names
         }
         if self._param_sharding is not None:
+            # moments follow their param's placement (the ZeRO contract:
+            # FSDP shards optimizer state alongside the weights)
             self._opt_state = {
                 n: tuple(
-                    jax.device_put(s, self._param_sharding(n)) for s in st
+                    _sharding.device_put_donatable(
+                        s, self._param_sharding(n)) for s in st
                 )
                 for n, st in self._opt_state.items()
             }
@@ -415,6 +463,11 @@ class TrainStep:
             amp_dtype=(self._amp or (self._compute_dtype.name
                                      if self._compute_dtype else None)),
             remat_policy=self._remat)
+        # shard/ metric family: mesh shape, global vs per-shard param
+        # bytes, collective-traffic estimate (report()/bench rows)
+        if mesh is not None:
+            _sharding.publish_shard_metrics(
+                self._values, mesh, rules, trainable=self._train_names)
 
         self._step_fn = self._build(donate)
 
@@ -726,6 +779,11 @@ class TrainStep:
             "split": self._split_n,
             "mesh": self._mesh,
             "data_sharding": self._data_sharding,
+            # declarative rules in force (None = legacy/replicated) — the
+            # feeder stages batches onto their SHARDED placements, so the
+            # device transfer lands each row on its owning shard directly
+            "sharding": (self._sharding_rules.describe()
+                         if self._sharding_rules is not None else None),
         }
 
     def device_put_batch(self, batch_and_label) -> DeviceBatch:
@@ -1007,10 +1065,20 @@ class TrainStep:
         out["peak_bytes_estimate"] = (
             out["argument_bytes"] + out["output_bytes"] + out["temp_bytes"]
             - out["alias_bytes"])
+        if self._mesh is not None:
+            # XLA's analysis reports LOGICAL (global) sizes on this path;
+            # the mesh splits arguments/temps across its devices, so one
+            # device's working set is ~peak/mesh.size — the figure
+            # plan_batch bisects against the per-device HBM budget
+            n = int(self._mesh.size)
+            out["mesh_devices"] = n
+            out["peak_bytes_per_shard"] = out["peak_bytes_estimate"] // n
         limit = _tel.hbm_limit_bytes()
         out["hbm_limit_bytes"] = limit
+        peak = out.get("peak_bytes_per_shard",
+                       out["peak_bytes_estimate"])
         out["hbm_headroom_bytes"] = (
-            limit - out["peak_bytes_estimate"] if limit is not None else None)
+            limit - peak if limit is not None else None)
         return out
 
     def _signature_avals(self, signature):
@@ -1106,7 +1174,8 @@ class TrainStep:
         fine — device_put moves arbitrary source placements)."""
         def _place(name, v):
             if self._param_sharding is not None:
-                return jax.device_put(v, self._param_sharding(name))
+                return _sharding.device_put_donatable(
+                    v, self._param_sharding(name))
             return jnp.asarray(v)
 
         s = self._struct_names()
@@ -1129,7 +1198,8 @@ class TrainStep:
 
             def _repl(v):
                 v = jnp.asarray(v)
-                return jax.device_put(v, repl) if repl is not None else v
+                return _sharding.device_put_donatable(v, repl) \
+                    if repl is not None else v
 
             self._key_dev = _repl(sd["key"])
             self._t_dev = _repl(sd["t_dev"])
@@ -1309,7 +1379,8 @@ class TrainStep:
                     jnp.asarray(s_new)
                 v = v.astype(s_old.dtype)
                 if self._param_sharding is not None:
-                    v = jax.device_put(v, self._param_sharding(n))
+                    v = _sharding.device_put_donatable(
+                        v, self._param_sharding(n))
                 placed.append(v)
             self._opt_state[n] = tuple(placed)
         t = int(trainer._optimizer.num_update)

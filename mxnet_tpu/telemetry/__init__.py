@@ -325,6 +325,18 @@ def report() -> dict:
         "amp_dtype": _RUN_INFO.get("amp_dtype"),
         "remat_policy": _RUN_INFO.get("remat_policy"),
         "hbm_headroom_bytes": hbm_headroom_bytes(),
+        # SPMD sharding (parallel.sharding): the mesh/rules in force and
+        # the shard/ family's headline figures — how many bytes one
+        # device actually holds and the estimated per-step collective
+        # traffic (None/absent in unsharded processes)
+        "mesh_shape": _RUN_INFO.get("mesh_shape"),
+        "sharding": _RUN_INFO.get("sharding"),
+        "shard_param_bytes_total": snap["gauges"].get(
+            "shard/param_bytes_total"),
+        "shard_param_bytes_per_shard": snap["gauges"].get(
+            "shard/param_bytes_per_shard"),
+        "shard_collective_bytes_per_step": snap["gauges"].get(
+            "shard/collective_bytes_per_step_est"),
         "watchdog_stalls": snap["counters"].get("watchdog/stalls", 0),
         # shape stability (compile_cache): distinct compiled signatures,
         # post-warmup recompiles (should stay 0), persistent-cache reuse

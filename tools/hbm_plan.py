@@ -35,7 +35,7 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, os.path.dirname(_HERE))  # repo root
 
 
-def build_step(args, amp=None, remat=None):
+def build_step(args, amp=None, remat=None, mesh=None, sharding=None):
     import numpy as np  # noqa: F401
 
     import jax
@@ -69,7 +69,7 @@ def build_step(args, amp=None, remat=None):
             return NDArray(row.sum() / mask.sum())
 
     return TrainStep(net, MaskedCE(), opt.AdamW(learning_rate=1e-4),
-                     amp=amp, remat=remat)
+                     amp=amp, remat=remat, mesh=mesh, sharding=sharding)
 
 
 def plan(step, bucket_keys, budget, start=1, max_batch=65536):
@@ -84,9 +84,17 @@ def plan(step, bucket_keys, budget, start=1, max_batch=65536):
 
         batch, peak = plan_batch(step, sig, budget, start=start,
                                  max_batch=max_batch)
-        rows.append({"bucket_key": int(key), "max_batch": int(batch),
-                     "peak_bytes": int(peak) if peak is not None else None,
-                     "budget_bytes": int(budget)})
+        row = {"bucket_key": int(key), "max_batch": int(batch),
+               "peak_bytes": int(peak) if peak is not None else None,
+               "budget_bytes": int(budget)}
+        mesh = getattr(step, "_mesh", None)
+        if mesh is not None:
+            # the budget is ONE device's HBM; with a mesh, plan_batch
+            # bisected the PER-SHARD peak against it (the mesh splits
+            # the working set mesh.size ways)
+            row["mesh_devices"] = int(mesh.size)
+            row["per_shard"] = int(mesh.size) > 1
+        rows.append(row)
     return rows
 
 
@@ -102,6 +110,14 @@ def main(argv=None):
                     help="bfloat16|float16 mixed precision")
     ap.add_argument("--remat", default=None,
                     help="remat policy (mxnet_tpu.remat.POLICIES)")
+    ap.add_argument("--mesh", default=None,
+                    help="device mesh spec ('4', '2x2', 'data=2,model=2',"
+                         " 'auto'); the plan then bisects the PER-SHARD "
+                         "peak against the per-device budget")
+    ap.add_argument("--sharding", default=None,
+                    help="sharding rules preset for --mesh: 'replicated' "
+                         "(data parallel) or 'fsdp' (params+moments "
+                         "sharded; default when --mesh is set)")
     ap.add_argument("--units", type=int, default=32)
     ap.add_argument("--layers", type=int, default=1)
     ap.add_argument("--vocab", type=int, default=1000)
@@ -121,11 +137,26 @@ def main(argv=None):
               file=sys.stderr)
         return 2
 
-    step = build_step(args, amp=args.amp, remat=args.remat)
+    mesh = None
+    sharding = args.sharding
+    if args.mesh:
+        from mxnet_tpu.parallel import sharding as _shard
+
+        mesh = _shard.make_global_mesh(args.mesh)
+        if sharding is None:
+            sharding = "fsdp"
+    step = build_step(args, amp=args.amp, remat=args.remat, mesh=mesh,
+                      sharding=sharding)
     rows = plan(step, args.buckets, budget, start=args.start,
                 max_batch=args.max_batch)
+    mesh_str = None
+    if mesh is not None:
+        from mxnet_tpu.parallel import sharding as _shard
+
+        mesh_str = _shard.mesh_shape_str(mesh)
     for r in rows:
-        r.update({"amp": args.amp, "remat": args.remat})
+        r.update({"amp": args.amp, "remat": args.remat,
+                  "mesh": mesh_str, "sharding": sharding})
         print(json.dumps(r))
     fitting = [r for r in rows if r["max_batch"] > 0]
     print(json.dumps({
@@ -134,6 +165,7 @@ def main(argv=None):
         "unit": "samples",
         "budget_bytes": int(budget),
         "amp": args.amp, "remat": args.remat,
+        "mesh": mesh_str, "sharding": sharding,
         "buckets_fitting": len(fitting), "buckets_total": len(rows),
     }))
     return 0 if fitting else 1

@@ -168,6 +168,40 @@ def _print_infer_family(report_path):
               f"n={h.get('count')}")
 
 
+def _print_shard_family(report_path):
+    """Surface the ``shard/`` metric family (SPMD sharding spine: mesh
+    shape, global vs per-shard parameter bytes, collective-traffic
+    estimate, host-allreduce skips) from a ``report.json`` snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith("shard/")}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k.startswith("shard/")}
+    mesh = report.get("mesh_shape")
+    if not counters and not gauges and not mesh:
+        return
+    print("\n== SPMD sharding ==")
+    if mesh:
+        print(f"  {'mesh_shape':<38} {mesh}")
+    if report.get("sharding"):
+        print(f"  {'sharding':<38} {report['sharding']}")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    total = gauges.get("shard/param_bytes_total")
+    per = gauges.get("shard/param_bytes_per_shard")
+    if total and per and per < total:
+        print(f"  params per shard: {per / total:.1%} of the full tree "
+              f"({total / 1e6:.1f} MB -> {per / 1e6:.1f} MB/device)")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -208,6 +242,7 @@ def main(argv=None):
         _print_json_file(os.path.join(directory, "report.json"), "Report")
         _print_compile_family(os.path.join(directory, "report.json"))
         _print_infer_family(os.path.join(directory, "report.json"))
+        _print_shard_family(os.path.join(directory, "report.json"))
     return 0
 
 
