@@ -316,6 +316,135 @@ def decode_main(args):
     return 0 if ok else 1
 
 
+# -------------------------------------------------------- serve-chaos mode
+def serve_chaos_main(args):
+    """Self-healing serving ablation (CPU-sized): sustained open-loop
+    load on a 2-replica ``Router`` while (a) a hot weight swap lands
+    mid-stream (``CheckpointWatcher`` over a freshly committed sharded
+    checkpoint) and (b) one replica is killed by fault injection
+    (``serving.faults``, the ``batcher.thread`` point).
+
+    Acceptance: ZERO lost requests (every future resolves), responses
+    carry both the old and the new ``weights_version`` (the swap neither
+    dropped nor stalled the stream), ``serve/failovers >= 1``, and zero
+    steady-state recompiles through both events."""
+    import os
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu import checkpoint_sharded as cs
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+    from mxnet_tpu.parallel import InferStep
+    from mxnet_tpu.serving import (CheckpointWatcher, DynamicBatcher,
+                                   Replica, Router, faults)
+
+    V, B, T = args.vocab, args.batch_size, args.decode_tokens
+    bucket = args.max_len
+    rng = np.random.RandomState(args.seed)
+
+    def make_net(seed):
+        np.random.seed(seed)
+        mx.random.seed(seed)
+        net = TransformerModel(
+            src_vocab=V, tgt_vocab=V, units=args.units,
+            hidden_size=args.units * 2, num_layers=args.layers,
+            num_heads=2, max_length=bucket + T + 8, dropout=0.0,
+            prefix="serve_net_")
+        net.initialize(mx.initializer.Xavier())
+        net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                          nd.zeros((2, 8), dtype="int32"))
+        return net
+
+    # the serving net and the "newly trained" weights it will swap to
+    net = make_net(args.seed)
+    trained = make_net(args.seed + 1)
+    ckpt_root = tempfile.mkdtemp(prefix="mxtpu_serve_chaos_")
+    cs.save_sharded(
+        os.path.join(ckpt_root, "step_1"),
+        {n: p._data.data for n, p in trained.collect_params().items()})
+
+    def make_replica(name):
+        eng = InferStep(net, max_len=bucket + T + 4)
+        bat = DynamicBatcher(eng, bucket_keys=(bucket,), slots=B,
+                             timeout_ms=2.0, max_new_tokens=T,
+                             warmup=True, name=name)
+        return Replica(name, bat)
+
+    replicas = [make_replica("r0"), make_replica("r1")]
+    router = Router(replicas, retry_backoff_s=0.01,
+                    health_interval_s=0.02)
+    watcher = CheckpointWatcher(router.engines, ckpt_root, start=False)
+
+    n_requests = args.samples
+    futs, lat = [], []
+    faults.inject("batcher.thread", times=1, match="r1")
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        n = rng.randint(args.min_len, bucket + 1)
+        futs.append(router.submit(rng.randint(3, V, (n,)).astype("int32"),
+                                  max_new_tokens=T))
+        if i == n_requests // 3:
+            watcher.poll_once()  # hot swap mid-stream
+        time.sleep(0.001)
+    errors = 0
+    for f in futs:
+        try:
+            f.result(timeout=120)
+            lat.append((time.perf_counter() - f.enqueued_at) * 1e3)
+        except Exception:  # noqa: BLE001 - counted as lost
+            errors += 1
+    wall_s = time.perf_counter() - t0
+    router.stop()
+    faults.clear()
+
+    versions = sorted({f.weights_version for f in futs
+                       if f.weights_version is not None})
+    reg = mx.telemetry.registry()
+    recompiles = sum(
+        rep.engine.compile_guard.steady_state_recompiles
+        for rep in replicas)
+    lat.sort()
+    row = {
+        "metric": "transformer_serve_chaos_requests_per_sec",
+        "value": round(len(lat) / wall_s, 1),
+        "unit": "requests/sec",
+        "requests": n_requests,
+        "errors": errors,
+        "latency_ms_p50": round(_q(lat, 50), 1) if lat else None,
+        "latency_ms_p99": round(_q(lat, 99), 1) if lat else None,
+        "weights_versions": versions,
+        "serve_swaps": reg.counter("serve/swaps").value,
+        "serve_failovers": reg.counter("serve/failovers").value,
+        "serve_retries": reg.counter("serve/retries").value,
+        "serve_dropped": reg.counter("serve/dropped").value,
+        "steady_state_recompiles": recompiles,
+        "batch": B, "prompt_bucket": bucket, "decode_tokens": T,
+    }
+    print(json.dumps(row))
+    print(f"{n_requests} requests through swap+replica-kill: "
+          f"{errors} lost, versions {versions}, "
+          f"{row['serve_failovers']} failover(s), "
+          f"{row['serve_retries']} retries, p99 "
+          f"{row['latency_ms_p99']} ms, {recompiles} steady recompiles")
+    ok = (errors == 0 and len(versions) >= 2 and
+          row["serve_failovers"] >= 1 and recompiles == 0)
+    if not ok:
+        print("FAIL: swap+failover under load must lose zero requests, "
+              "serve both weight versions, evict the killed replica and "
+              "never recompile", file=sys.stderr)
+    return 0 if ok else 1
+
+
+def _q(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    rank = (p / 100.0) * (len(sorted_vals) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    return sorted_vals[lo] * (1 - (rank - lo)) + sorted_vals[hi] * (rank - lo)
+
+
 # ------------------------------------------------------- amp/auto-batch mode
 def amp_auto_batch_main(args):
     """HBM-aware compute ablation: fp32 no-remat vs amp(+remat), each at
@@ -438,6 +567,9 @@ def main(argv=None):
                     help="KV-cached vs naive re-forward decode ablation")
     ap.add_argument("--decode-tokens", type=int, default=32,
                     help="tokens generated per row in --decode mode")
+    ap.add_argument("--serve-chaos", action="store_true",
+                    help="self-healing serving ablation: hot weight swap "
+                         "+ replica kill under sustained router load")
     ap.add_argument("--max-batch", type=int, default=1024)
     ap.add_argument("--buckets", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=8)
@@ -452,6 +584,8 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.serve_chaos:
+        return serve_chaos_main(args)
     if args.decode:
         return decode_main(args)
     if args.auto_batch:

@@ -35,7 +35,8 @@ import numpy as _np
 from . import telemetry as _tel
 from .base import MXNetError
 
-__all__ = ["save_sharded", "load_sharded", "is_committed"]
+__all__ = ["save_sharded", "load_sharded", "is_committed", "commit_token",
+           "latest_committed"]
 
 
 def _norm_bounds(index, shape):
@@ -168,12 +169,58 @@ def is_committed(directory: str) -> bool:
     meta_path = os.path.join(directory, "ckpt_meta.json")
     if not os.path.exists(meta_path):
         return False
-    with open(meta_path) as f:
-        nproc = json.load(f).get("process_count", 1)
+    try:
+        with open(meta_path) as f:
+            nproc = json.load(f).get("process_count", 1)
+    except (OSError, ValueError):
+        # a torn/mid-write meta is simply "not committed yet" — pollers
+        # (serving.CheckpointWatcher) retry on their next tick
+        return False
     return all(
         os.path.exists(os.path.join(directory, f"DONE.p{k}"))
         for k in range(nproc)
     )
+
+
+def commit_token(directory: str) -> Optional[str]:
+    """Identity of a COMMITTED checkpoint's content, None otherwise.
+
+    ``save_sharded`` retracts and rewrites ``ckpt_meta.json`` on every
+    save, so its mtime changes whenever the directory's content does —
+    a poller comparing tokens sees exactly the commits, never a
+    half-written attempt (which has no meta / missing DONE markers)."""
+    if not is_committed(directory):
+        return None
+    try:
+        st = os.stat(os.path.join(directory, "ckpt_meta.json"))
+    except OSError:
+        return None
+    return f"{os.path.normpath(directory)}@{st.st_mtime_ns}"
+
+
+def latest_committed(directory: str):
+    """Newest committed checkpoint under ``directory``: the directory
+    itself, or any immediate subdirectory (the ``save_checkpoint(dir,
+    step=N)`` -> ``dir/step_N`` layout). Returns ``(path, token)`` or
+    None when nothing is committed yet."""
+    candidates = [directory]
+    try:
+        for name in os.listdir(directory):
+            sub = os.path.join(directory, name)
+            if os.path.isdir(sub):
+                candidates.append(sub)
+    except OSError:
+        return None
+    best = None
+    for cand in candidates:
+        if not is_committed(cand):
+            continue
+        mtime = os.stat(os.path.join(cand, "ckpt_meta.json")).st_mtime_ns
+        if best is None or mtime > best[0]:
+            best = (mtime, cand)
+    if best is None:
+        return None
+    return best[1], commit_token(best[1])
 
 
 class _PieceReader:

@@ -154,7 +154,13 @@ class InferStep:
             if _param_sharding is not None:
                 v = jax.device_put(v, _param_sharding(name, v.shape))
             vals[name] = v
+        # the LIVE param buffer: hot-swap (swap_params) stages a full
+        # replacement dict and flips this reference atomically between
+        # dispatches — dispatch paths snapshot it once per dispatch so a
+        # request's prefill and decode always see one coherent version
         self._values = vals
+        self._version_counter = 0
+        self._weights_version = "v0"
         self._cache_dtype = cdt
         if mesh is not None:
             _sharding.publish_shard_metrics(vals, mesh, rules)
@@ -188,6 +194,13 @@ class InferStep:
     def supports_decode(self) -> bool:
         return hasattr(self._net, "prefill") and \
             hasattr(self._net, "decode_step")
+
+    @property
+    def weights_version(self) -> str:
+        """Tag of the param set serving new dispatches. Responses carry
+        the version their dispatch ran on (``serving.DynamicBatcher``
+        stamps it onto each ``GenerationResult``)."""
+        return self._weights_version
 
     # ---------------------------------------------------------------- build
     def _net_scope(self, values, key):
@@ -332,7 +345,8 @@ class InferStep:
         sig = ("fwd",) + tuple((a.shape, a.dtype.name) for a in staged)
         self.compile_guard.observe(
             sig, lambda: "fwd " + _cc.aval_summary(staged))
-        outs = self._fwd_fn(self._values, staged, self._fixed_key)
+        vals = self._values  # one coherent read per dispatch (hot swap)
+        outs = self._fwd_fn(vals, staged, self._fixed_key)
         nds = [NDArray(o) for o in outs]
         out = jax.tree.unflatten(self._fwd_tree[0], nds)
         return out
@@ -399,8 +413,12 @@ class InferStep:
         prefill_fn = self._get_prefill_fn(self._max_len)
         decode_fn = self._get_decode_fn(*cfg)
         key, pk = jax.random.split(key)
-        logits, state = prefill_fn(self._values, src, vl, prime, pk, temp)
-        toks, lengths = decode_fn(self._values, state, logits,
+        # snapshot the live buffer ONCE: a concurrent hot swap flips
+        # self._values between dispatches, and this request's prefill and
+        # decode must run on the same weights
+        vals = self._values
+        logits, state = prefill_fn(vals, src, vl, prime, pk, temp)
+        toks, lengths = decode_fn(vals, state, logits,
                                   jnp.int32(prime.shape[1]), key, temp)
         return NDArray(toks), NDArray(lengths)
 
@@ -489,22 +507,74 @@ class InferStep:
         """Signature cache summary (``compile_cache.RecompileGuard``)."""
         return self.compile_guard.info()
 
-    def sync_params(self):
+    # -------------------------------------------------- weight lifecycle
+    def _bump_version(self, version: Optional[str]) -> str:
+        self._version_counter += 1
+        self._weights_version = version if version is not None \
+            else f"v{self._version_counter}"
+        _tel.set_info(weights_version=self._weights_version)
+        return self._weights_version
+
+    def sync_params(self, version: Optional[str] = None):
         """Re-read the net's current parameter values (after external
         updates, e.g. ``TrainStep.sync_params`` handed fresh weights),
-        re-placing each under its declared sharding."""
-        from .. import amp as _amp_mod
+        re-placing each under its declared sharding and bumping
+        ``weights_version``."""
+        self.swap_params(
+            staged=self.stage_params(
+                {name: p._data.data for name, p in self._params}),
+            version=version)
 
-        fp32_pinned = _amp_mod.fp32_param_names(self._net) if self._amp \
-            else frozenset()
-        cdt = self._cache_dtype
+    def stage_params(self, arrays) -> dict:
+        """Stage a full replacement param set into a standby device
+        buffer; the LIVE set is untouched (double buffering — staging can
+        run on a background thread while serving continues).
+
+        ``arrays`` maps param name -> array; ``TrainStep`` checkpoint
+        naming (``values/<name>``) is accepted, extra entries (optimizer
+        moments, scaler state) are ignored. Every engine param must be
+        present with its exact shape; values are cast to the LIVE entry's
+        dtype and placed under its sharding, so flipping to the staged
+        set can never change a dispatch signature (zero recompiles by
+        construction)."""
+        live = self._values
         vals = {}
-        for name, p in self._params:
-            v = p._data.data
-            if cdt is not None and name not in fp32_pinned and \
-                    jnp.issubdtype(v.dtype, jnp.floating):
-                v = v.astype(cdt)
+        for name, _ in self._params:
+            v = arrays.get(name)
+            if v is None:
+                v = arrays.get("values/" + name)
+            if v is None:
+                raise MXNetError(
+                    f"swap source is missing parameter {name!r}")
+            v = jnp.asarray(v)
+            cur = live[name]
+            if tuple(v.shape) != tuple(cur.shape):
+                raise MXNetError(
+                    f"swap shape mismatch for {name!r}: "
+                    f"{tuple(v.shape)} != {tuple(cur.shape)}")
+            v = v.astype(cur.dtype)
             if self._param_sharding is not None:
                 v = jax.device_put(v, self._param_sharding(name, v.shape))
             vals[name] = v
-        self._values = vals
+        return vals
+
+    def swap_params(self, arrays=None, *, staged: Optional[dict] = None,
+                    version: Optional[str] = None) -> str:
+        """Hot weight swap: flip the live param buffer to ``staged`` (or
+        to ``stage_params(arrays)``), atomically between dispatches.
+
+        In-flight dispatches hold their own snapshot and finish on the
+        OLD version; every dispatch entered after this call serves the
+        new one. The flip itself is one reference assignment — it stalls
+        serving by zero dispatches. Returns the new ``weights_version``
+        (``version`` or an auto-bumped ``v<N>`` tag)."""
+        if staged is None:
+            if arrays is None:
+                raise MXNetError("swap_params needs arrays= or staged=")
+            staged = self.stage_params(arrays)
+        elif set(staged) != {n for n, _ in self._params}:
+            raise MXNetError(
+                "staged param set does not cover the engine's params "
+                "(use stage_params())")
+        self._values = staged
+        return self._bump_version(version)

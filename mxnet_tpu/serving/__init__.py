@@ -1,22 +1,44 @@
-"""Serving front-end: request-level dynamic batching over ``InferStep``.
+"""Serving front-end: dynamic batching, hot weight swap, multi-replica
+routing with failover — the self-healing serving plane.
 
 The inference engine (``parallel.infer.InferStep``) turns one *batch* of
 prompts into tokens at O(1)/token; this package turns *concurrent
-requests* into those batches (Yu et al., Orca, OSDI 2022 — here the
-iteration granularity is one generation call, with per-request detach at
-EOS trim time): ``DynamicBatcher`` admits requests into fixed
-``(batch, bucket)`` slots — pad-to-bucket prompts, timeout-or-full
-dispatch, per-request future resolution — so the engine only ever sees
-the warmed shape menu and the steady-state loop never compiles.
+requests* into those batches and keeps doing so across weight updates
+and replica failures:
+
+- ``DynamicBatcher`` admits requests into fixed ``(batch, bucket)``
+  slots — pad-to-bucket prompts, timeout-or-full dispatch, per-request
+  future resolution, per-request deadlines — so the engine only ever
+  sees the warmed shape menu and the steady-state loop never compiles
+  (Yu et al., Orca, OSDI 2022: between decode dispatches is the safe
+  point for everything below).
+- ``CheckpointWatcher`` hot-swaps newly committed checkpoints into live
+  engines between dispatches (double-buffered device params,
+  version-tagged responses, zero dropped requests).
+- ``Router`` fronts N replicas behind one ``submit()``: health scoring
+  from the watchdog heartbeat + per-replica backlog, eviction with
+  transparent resubmission (bounded retries, exponential backoff,
+  per-request deadlines), respawn via a replica factory.
+- ``faults`` plants deterministic failure points in all of the above
+  (``MXTPU_FAULT_*``), so the failure paths are testable in tier-1.
 
 Env knobs: ``MXTPU_BATCHER_SLOTS`` (batch slots per dispatch, default 8),
 ``MXTPU_BATCHER_TIMEOUT_MS`` (admission window, default 10),
-``MXTPU_DECODE_MAX_LEN`` (engine cache capacity — see
-``parallel.infer``).
+``MXTPU_DECODE_MAX_LEN`` (engine cache capacity — see ``parallel.infer``),
+``MXTPU_SWAP_POLL_S`` (checkpoint poll period), ``MXTPU_RETRY_MAX``
+(router resubmissions per request), ``MXTPU_RESTART_BACKOFF_S`` (restart
+backoff base, shared with ``tools/launch.py``), ``MXTPU_FAULT_*``
+(fault-injection specs — see ``serving.faults``).
 """
 
-from .batcher import DynamicBatcher, GenerationResult, batcher_slots, \
-    batcher_timeout_ms
+from . import faults
+from .batcher import DeadlineExceeded, DynamicBatcher, GenerationResult, \
+    batcher_slots, batcher_timeout_ms
+from .router import Replica, ReplicaUnavailable, Router, restart_backoff_s, \
+    retry_max
+from .watcher import CheckpointWatcher, swap_poll_s
 
-__all__ = ["DynamicBatcher", "GenerationResult", "batcher_slots",
-           "batcher_timeout_ms"]
+__all__ = ["DynamicBatcher", "GenerationResult", "DeadlineExceeded",
+           "Router", "Replica", "ReplicaUnavailable", "CheckpointWatcher",
+           "faults", "batcher_slots", "batcher_timeout_ms", "swap_poll_s",
+           "retry_max", "restart_backoff_s"]

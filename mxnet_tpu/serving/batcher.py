@@ -36,9 +36,15 @@ import numpy as _np
 
 from ..base import MXNetError
 from .. import telemetry as _tel
+from . import faults as _faults
 
-__all__ = ["DynamicBatcher", "GenerationResult", "batcher_slots",
-           "batcher_timeout_ms"]
+__all__ = ["DynamicBatcher", "GenerationResult", "DeadlineExceeded",
+           "batcher_slots", "batcher_timeout_ms"]
+
+
+class DeadlineExceeded(MXNetError):
+    """A request's deadline passed while it was still queued (or before
+    the router could place it) — it is FAILED, never dispatched late."""
 
 
 def batcher_slots(default: int = 8) -> int:
@@ -63,10 +69,12 @@ def batcher_timeout_ms(default: float = 10.0) -> float:
 class GenerationResult:
     """Future for one submitted request. ``result(timeout)`` blocks until
     the request's decode finished and returns the generated token list
-    (trimmed at EOS); ``exception()`` surfaces a dispatch failure."""
+    (trimmed at EOS); ``exception()`` surfaces a dispatch failure.
+    ``weights_version`` tags which param set served the request (hot
+    weight swap) and ``replica`` which engine replica ran it (router)."""
 
     __slots__ = ("_event", "_tokens", "_error", "enqueued_at",
-                 "queue_wait_ms")
+                 "queue_wait_ms", "weights_version", "replica")
 
     def __init__(self):
         self._event = threading.Event()
@@ -74,6 +82,8 @@ class GenerationResult:
         self._error = None
         self.enqueued_at = time.perf_counter()
         self.queue_wait_ms = None
+        self.weights_version = None
+        self.replica = None
 
     def _resolve(self, tokens):
         self._tokens = tokens
@@ -98,12 +108,13 @@ class GenerationResult:
 
 
 class _Request:
-    __slots__ = ("prompt", "max_new", "future")
+    __slots__ = ("prompt", "max_new", "future", "deadline")
 
-    def __init__(self, prompt, max_new, future):
+    def __init__(self, prompt, max_new, future, deadline=None):
         self.prompt = prompt
         self.max_new = max_new
         self.future = future
+        self.deadline = deadline  # absolute perf_counter instant or None
 
 
 class DynamicBatcher:
@@ -124,6 +135,12 @@ class DynamicBatcher:
         temperature/seed) shared by the batch.
     warmup : drive the engine's prefill+decode programs for the whole
         menu at construction (recommended for serving).
+    name : tag for telemetry and fault matching (``serving.faults``);
+        the router names each replica's batcher after the replica.
+    watchdog : optional ``telemetry.Watchdog`` notified after every
+        resolved dispatch — its ``heartbeat.json`` is the router's
+        liveness signal for this replica (a hung dispatch stops the
+        notifications and the heartbeat goes stale).
     """
 
     def __init__(self, engine, bucket_keys: Sequence[int],
@@ -131,7 +148,8 @@ class DynamicBatcher:
                  timeout_ms: Optional[float] = None,
                  max_new_tokens: int = 32, sampling: Optional[dict] = None,
                  pad_id: Optional[int] = None, warmup: bool = False,
-                 start: bool = True):
+                 start: bool = True, name: Optional[str] = None,
+                 watchdog=None):
         if not getattr(engine, "supports_decode", False):
             raise MXNetError(
                 "DynamicBatcher needs a decode-capable InferStep "
@@ -146,6 +164,8 @@ class DynamicBatcher:
         self.max_new = int(max_new_tokens)
         self._sampling = dict(sampling or {})
         self._pad = int(pad_id) if pad_id is not None else engine._pad
+        self.name = name
+        self._watchdog = watchdog
         self._queue: "queue.Queue[_Request]" = queue.Queue()
         self._stop = threading.Event()
         self._thread = None
@@ -166,8 +186,10 @@ class DynamicBatcher:
 
     def stop(self, drain: bool = True, timeout: float = 30.0):
         """Stop the dispatcher; with ``drain`` (default) outstanding
-        requests are dispatched first."""
-        if drain:
+        requests are dispatched first. Anything still queued when the
+        thread is down is FAILED (a stopped batcher must never hold an
+        unresolvable future)."""
+        if drain and self.healthy:
             deadline = time.perf_counter() + timeout
             while not self._queue.empty() and \
                     time.perf_counter() < deadline:
@@ -176,6 +198,32 @@ class DynamicBatcher:
         if self._thread is not None:
             self._thread.join(timeout=timeout)
             self._thread = None
+        self.cancel_pending()
+
+    @property
+    def healthy(self) -> bool:
+        """True while the dispatcher thread is alive and accepting — the
+        router's per-replica liveness poll. Goes false on ``stop()`` and
+        when the thread died (a crash outside the dispatch try)."""
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stop.is_set()
+
+    def cancel_pending(self, error: Optional[BaseException] = None) -> int:
+        """Drain the queue, failing every undispatched request's future
+        (default error: RuntimeError naming the batcher). The router uses
+        this when evicting an unhealthy replica — the failed futures are
+        its signal to resubmit those requests elsewhere. Returns how many
+        requests were cancelled."""
+        n = 0
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                return n
+            r.future._fail(error if error is not None else RuntimeError(
+                f"DynamicBatcher{f' {self.name!r}' if self.name else ''} "
+                "stopped with this request still queued"))
+            n += 1
 
     def __enter__(self):
         self.start()
@@ -186,11 +234,19 @@ class DynamicBatcher:
         return False
 
     # ------------------------------------------------------------- requests
-    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None
-               ) -> GenerationResult:
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenerationResult:
         """Enqueue one prompt (1-D int sequence). Returns a future whose
         ``result()`` is the generated token list, trimmed at EOS and at
-        the request's ``max_new_tokens`` (<= the batcher's)."""
+        the request's ``max_new_tokens`` (<= the batcher's).
+
+        ``deadline_ms`` bounds the request's total latency from NOW: a
+        request still queued when its deadline passes is failed with
+        ``DeadlineExceeded`` instead of being dispatched late.
+
+        Submitting to a stopped (or crashed) batcher fails the future
+        immediately with a RuntimeError — a request must never enqueue
+        behind a dispatcher that will not run again."""
         prompt = _np.asarray(prompt_ids, dtype=_np.int32).reshape(-1)
         if prompt.shape[0] > self.bucket_keys[-1]:
             raise MXNetError(
@@ -203,12 +259,40 @@ class DynamicBatcher:
                 f"request max_new_tokens {max_new} > batcher "
                 f"max_new_tokens {self.max_new}")
         fut = GenerationResult()
-        self._queue.put(_Request(prompt, max_new, fut))
+        if not self.healthy:
+            fut._fail(RuntimeError(
+                f"DynamicBatcher{f' {self.name!r}' if self.name else ''} "
+                "is not accepting requests (stopped, or its dispatcher "
+                "thread died) — the request would never resolve"))
+            return fut
+        deadline = None if deadline_ms is None \
+            else time.perf_counter() + float(deadline_ms) / 1e3
+        self._queue.put(_Request(prompt, max_new, fut, deadline))
         return fut
 
     # ------------------------------------------------------------ dispatcher
     def _run(self):
+        try:
+            self._run_loop()
+        except BaseException as e:
+            # the thread is dying (a crash outside the dispatch try, e.g.
+            # the `batcher.thread` fault point): fail whatever is queued
+            # so no future is left unresolvable, then let it die —
+            # `healthy` flips false and the router (if any) takes over
+            self.cancel_pending(RuntimeError(
+                f"DynamicBatcher{f' {self.name!r}' if self.name else ''} "
+                "dispatcher thread died"))
+            # injected deaths exit quietly (the crash is the test's
+            # point); real crashes re-raise for the interpreter's
+            # thread-exception hook
+            if not isinstance(e, _faults.FaultInjected):
+                raise
+
+    def _run_loop(self):
         while not self._stop.is_set():
+            # fault point: an unhandled crash of the dispatcher thread
+            # (NOT caught by the dispatch try below) — a dead replica
+            _faults.fire("batcher.thread", tag=self.name)
             try:
                 first = self._queue.get(timeout=0.05)
             except queue.Empty:
@@ -223,6 +307,9 @@ class DynamicBatcher:
                     reqs.append(self._queue.get(timeout=remaining))
                 except queue.Empty:
                     break
+            reqs = self._expire(reqs)
+            if not reqs:
+                continue
             t0 = time.perf_counter()
             try:
                 out = self._dispatch(reqs)
@@ -231,6 +318,24 @@ class DynamicBatcher:
                     r.future._fail(e)
                 continue
             self._resolve(reqs, out, t0)
+
+    def _expire(self, reqs):
+        """Fail (never dispatch) requests whose deadline passed while
+        they were queued. Runs BEFORE batch assembly, so expired rows
+        don't occupy slots and the occupancy/queue-wait telemetry of the
+        dispatched batch is unaffected."""
+        now = time.perf_counter()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                _tel.registry().counter("serve/deadline_exceeded").inc()
+                r.future._fail(DeadlineExceeded(
+                    f"request deadline passed after "
+                    f"{(now - r.future.enqueued_at) * 1e3:.0f} ms in "
+                    "queue — not dispatched"))
+            else:
+                live.append(r)
+        return live
 
     def _bucket_for(self, max_len):
         for k in self.bucket_keys:
@@ -245,6 +350,8 @@ class DynamicBatcher:
         Pure staging + dispatch — linted sync-free
         (``tools/check_no_sync_in_step.py``): the host reads happen in
         ``_resolve`` after the device work is in flight."""
+        _faults.fire("batcher.hang", tag=self.name)
+        _faults.fire("batcher.dispatch", tag=self.name)
         bucket = self._bucket_for(max(r.prompt.shape[0] for r in reqs))
         src = _np.full((self.slots, bucket), self._pad, _np.int32)
         vl = _np.zeros((self.slots,), _np.int32)
@@ -252,14 +359,19 @@ class DynamicBatcher:
             n = r.prompt.shape[0]
             src[i, :n] = r.prompt
             vl[i] = n
-        return self._engine.decode_n(
+        # the version THIS dispatch serves, captured with the dispatch:
+        # responses are tagged with it even if a hot swap flips the
+        # engine's live buffer before the results are read back
+        version = getattr(self._engine, "weights_version", None)
+        out = self._engine.decode_n(
             src, vl, max_new_tokens=self.max_new, **self._sampling)
+        return out, version
 
     def _resolve(self, reqs, out, t0):
         """Per-request detach: trim each row at its EOS / its own
         ``max_new_tokens`` and resolve its future. The host read here is
         the sync point of the whole pipeline."""
-        tokens_nd, lengths_nd = out
+        (tokens_nd, lengths_nd), version = out
         tokens = tokens_nd.asnumpy()
         lengths = lengths_nd.asnumpy()
         dispatch_ms = (time.perf_counter() - t0) * 1e3
@@ -273,7 +385,12 @@ class DynamicBatcher:
             reg.histogram("infer/queue_wait_ms").observe(
                 max(r.future.queue_wait_ms, 0.0))
             emitted += n
+            r.future.weights_version = version
+            r.future.replica = self.name
             r.future._resolve(tokens[i, :n].tolist())
+        wd = self._watchdog
+        if wd is not None:
+            wd.notify_step(seconds=dispatch_ms / 1e3)
         reg.counter("infer/requests").inc(len(reqs))
         reg.counter("infer/tokens").inc(emitted)
         reg.gauge("infer/batch_occupancy").set(len(reqs) / self.slots)

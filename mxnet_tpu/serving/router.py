@@ -1,0 +1,450 @@
+"""Multi-replica serving router: health-scored placement, watchdog-driven
+failover, bounded transparent retries.
+
+One ``submit()`` front-end over N engine+batcher replicas. The router
+owns the request lifecycle end to end:
+
+- **Placement**: each request goes to the healthy replica with the
+  lightest load (router-tracked in-flight count + the replica's queued
+  backlog; ties break round-robin via the replica order).
+- **Health**: a replica is healthy while (a) its batcher's dispatcher
+  thread is alive (``DynamicBatcher.healthy``), (b) its watchdog
+  heartbeat — the PR-1 ``heartbeat.json``, written atomically — is fresh
+  and not flagged ``stalled``/``hard_hang``, and (c) it has not been
+  evicted. The health loop re-scores every ``health_interval_s``.
+- **Failover**: an unhealthy replica is evicted — its queued-but-
+  undispatched requests are cancelled out of its batcher and every
+  router request assigned to it is transparently resubmitted to a
+  healthy replica, with bounded retries (``MXTPU_RETRY_MAX``),
+  exponential backoff with jitter, and per-request deadlines
+  (``DeadlineExceeded`` rather than a late dispatch).
+- **Replacement**: with a ``replica_factory``, evictions trigger
+  respawn attempts under the same capped exponential backoff
+  (``MXTPU_RESTART_BACKOFF_S``) that ``tools/launch.py`` uses for
+  whole-job elastic restarts.
+
+Telemetry (``serve/`` family): ``requests``/``completed`` counters,
+``failovers`` (evictions), ``retries`` (resubmissions), ``dropped``
+(failed after retries exhausted), ``deadline_exceeded``,
+``replica_restarts``, ``replicas_healthy`` gauge.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional, Sequence
+
+from ..base import MXNetError
+from .. import telemetry as _tel
+from ..telemetry.watchdog import read_heartbeat
+from .batcher import DeadlineExceeded, DynamicBatcher, GenerationResult
+
+__all__ = ["Router", "Replica", "ReplicaUnavailable", "retry_max",
+           "restart_backoff_s"]
+
+
+class ReplicaUnavailable(MXNetError):
+    """The replica holding a request was evicted before dispatching it —
+    a retriable condition (the router resubmits elsewhere)."""
+
+
+def retry_max(default: int = 2) -> int:
+    """``MXTPU_RETRY_MAX``: resubmissions per request after its first
+    placement (0 = fail on the first replica error)."""
+    v = os.environ.get("MXTPU_RETRY_MAX", "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def restart_backoff_s(default: float = 1.0) -> float:
+    """``MXTPU_RESTART_BACKOFF_S``: base of the capped exponential
+    backoff between restart attempts — shared contract with
+    ``tools/launch.py``'s elastic relaunch."""
+    v = os.environ.get("MXTPU_RESTART_BACKOFF_S", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def backoff_delay(base: float, attempt: int, cap: float = 30.0,
+                  jitter: float = 0.25) -> float:
+    """Capped exponential backoff with multiplicative jitter: attempt 0
+    waits ~base, each further attempt doubles, never exceeding ``cap``
+    (pre-jitter). Jitter decorrelates replicas/restarts that failed at
+    the same instant."""
+    d = min(float(base) * (2.0 ** max(int(attempt), 0)), float(cap))
+    return d * (1.0 + float(jitter) * random.random())
+
+
+class Replica:
+    """One engine+batcher unit behind the router.
+
+    ``heartbeat_path`` points at a watchdog ``heartbeat.json`` (wire the
+    same ``Watchdog`` into the batcher via ``DynamicBatcher(...,
+    watchdog=...)`` so dispatches feed it). No path = liveness from the
+    dispatcher thread alone."""
+
+    def __init__(self, name: str, batcher: DynamicBatcher,
+                 heartbeat_path: Optional[str] = None,
+                 heartbeat_stale_s: float = 10.0):
+        self.name = str(name)
+        self.batcher = batcher
+        if batcher.name is None:
+            batcher.name = self.name
+        self.heartbeat_path = heartbeat_path
+        self.heartbeat_stale_s = float(heartbeat_stale_s)
+        self.evicted = False
+        self.inflight = 0  # router-assigned, guarded by the router lock
+
+    @property
+    def engine(self):
+        return self.batcher._engine
+
+    def health(self) -> tuple:
+        """(healthy, reason). Never raises — a health check that crashes
+        is itself an outage."""
+        if self.evicted:
+            return False, "evicted"
+        if not self.batcher.healthy:
+            return False, "dispatcher thread down"
+        if self.heartbeat_path is not None:
+            hb = read_heartbeat(self.heartbeat_path)
+            if hb is not None:
+                if hb.get("status") in ("stalled", "hard_hang"):
+                    return False, f"heartbeat status {hb['status']}"
+                age = time.time() - float(hb.get("time", 0.0))
+                if age > self.heartbeat_stale_s:
+                    return False, f"heartbeat stale ({age:.1f}s)"
+            # missing/torn file = unknown, not unhealthy: the watchdog
+            # may simply not have written yet
+        return True, "ok"
+
+    @property
+    def healthy(self) -> bool:
+        return self.health()[0]
+
+    def load(self) -> int:
+        """Placement score: requests the router has in flight here plus
+        the batcher's queued backlog (infer/ telemetry's queue_wait is
+        this backlog measured in time)."""
+        return self.inflight + self.batcher._queue.qsize()
+
+
+class _Routed:
+    """Router-side record of one request across (re)submissions."""
+
+    __slots__ = ("prompt", "max_new", "deadline", "outer", "replica",
+                 "inner", "attempts", "next_try_at", "created")
+
+    def __init__(self, prompt, max_new, deadline, outer):
+        self.prompt = prompt
+        self.max_new = max_new
+        self.deadline = deadline  # absolute perf_counter instant or None
+        self.outer = outer
+        self.replica = None
+        self.inner = None
+        self.attempts = 0  # placements so far
+        self.next_try_at = 0.0
+        self.created = time.perf_counter()
+
+
+class Router:
+    """Self-healing serving front-end over N replicas.
+
+    Parameters
+    ----------
+    replicas : sequence of ``Replica``.
+    max_retries : resubmissions per request after its first placement
+        (``MXTPU_RETRY_MAX`` default).
+    retry_backoff_s : base backoff between a request's placements.
+    deadline_ms : default per-request deadline (None = unbounded).
+    health_interval_s : replica re-scoring period.
+    replica_factory : zero-arg callable returning a fresh ``Replica``;
+        evictions schedule respawns under capped exponential backoff.
+    no_replica_timeout_s : how long a request may wait for ANY healthy
+        replica (e.g. during respawn) before failing.
+    """
+
+    def __init__(self, replicas: Sequence[Replica],
+                 max_retries: Optional[int] = None,
+                 retry_backoff_s: float = 0.05,
+                 deadline_ms: Optional[float] = None,
+                 health_interval_s: float = 0.05,
+                 replica_factory: Optional[Callable[[], Replica]] = None,
+                 respawn_backoff_s: Optional[float] = None,
+                 no_replica_timeout_s: float = 5.0,
+                 start: bool = True):
+        self._replicas = list(replicas)
+        if not self._replicas:
+            raise MXNetError("Router needs at least one replica")
+        self.max_retries = max_retries if max_retries is not None \
+            else retry_max()
+        self.retry_backoff_s = float(retry_backoff_s)
+        self.default_deadline_ms = deadline_ms
+        self.health_interval_s = float(health_interval_s)
+        self._factory = replica_factory
+        self._respawn_base = respawn_backoff_s if respawn_backoff_s \
+            is not None else restart_backoff_s()
+        self.no_replica_timeout_s = float(no_replica_timeout_s)
+        self._lock = threading.Lock()
+        self._inflight: list = []
+        self._respawn_at = None  # next respawn attempt instant
+        self._respawn_attempt = 0
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-router", daemon=True)
+        self._thread.start()
+
+    def stop(self, stop_replicas: bool = True, timeout: float = 30.0):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+        with self._lock:
+            pending = list(self._inflight)
+            self._inflight.clear()
+        for r in pending:
+            if not r.outer.done():
+                r.outer._fail(RuntimeError("router stopped"))
+        if stop_replicas:
+            for rep in self._replicas:
+                try:
+                    rep.batcher.stop(drain=False, timeout=1.0)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    pass
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    @property
+    def replicas(self) -> list:
+        return list(self._replicas)
+
+    @property
+    def engines(self) -> list:
+        """Live engines (for ``CheckpointWatcher`` wiring: one watcher
+        hot-swaps every replica)."""
+        return [rep.engine for rep in self._replicas if not rep.evicted]
+
+    # ------------------------------------------------------------- requests
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenerationResult:
+        """Route one prompt to a healthy replica. The returned future
+        resolves even across replica failures (transparent resubmission)
+        — it fails only on retry exhaustion, deadline expiry, or total
+        replica loss."""
+        outer = GenerationResult()
+        dl_ms = deadline_ms if deadline_ms is not None \
+            else self.default_deadline_ms
+        deadline = None if dl_ms is None \
+            else time.perf_counter() + float(dl_ms) / 1e3
+        r = _Routed(prompt_ids, max_new_tokens, deadline, outer)
+        _tel.registry().counter("serve/requests").inc()
+        with self._lock:
+            if not self._assign_locked(r) and not self._may_recover():
+                outer._fail(RuntimeError(
+                    "no healthy replicas and no replica_factory — "
+                    "request cannot be placed"))
+                return outer
+            self._inflight.append(r)
+        return outer
+
+    def _may_recover(self) -> bool:
+        """Whether waiting could produce a healthy replica: a respawn
+        factory exists, or some replica is merely degraded (not
+        evicted) and may come back fresh."""
+        return self._factory is not None or any(
+            not rep.evicted for rep in self._replicas)
+
+    def _assign_locked(self, r: _Routed) -> bool:
+        """Place ``r`` on the lightest-loaded healthy replica; False when
+        none is available (the monitor retries until
+        ``no_replica_timeout_s``)."""
+        candidates = [rep for rep in self._replicas if rep.healthy]
+        if not candidates:
+            r.inner = None
+            r.next_try_at = time.perf_counter() + self.health_interval_s
+            return False
+        rep = min(candidates, key=lambda x: x.load())
+        remaining_ms = None
+        if r.deadline is not None:
+            remaining_ms = (r.deadline - time.perf_counter()) * 1e3
+            if remaining_ms <= 0:
+                return True  # monitor fails it on the next tick
+        r.replica = rep
+        r.attempts += 1
+        rep.inflight += 1
+        r.inner = rep.batcher.submit(r.prompt, r.max_new,
+                                     deadline_ms=remaining_ms)
+        return True
+
+    # -------------------------------------------------------------- monitor
+    def _run(self):
+        last_health = 0.0
+        while not self._stop.wait(0.005):
+            now = time.perf_counter()
+            if now - last_health >= self.health_interval_s:
+                last_health = now
+                self._health_pass(now)
+            self._request_pass(now)
+
+    def _health_pass(self, now):
+        for rep in list(self._replicas):
+            if rep.evicted:
+                continue
+            ok, reason = rep.health()
+            if not ok:
+                self._evict(rep, reason)
+        healthy = sum(1 for rep in self._replicas if rep.healthy)
+        _tel.registry().gauge("serve/replicas_healthy").set(healthy)
+        if self._factory is not None and self._respawn_at is not None \
+                and now >= self._respawn_at:
+            self._respawn()
+
+    def _evict(self, rep: Replica, reason: str):
+        """Drain an unhealthy replica and mark every routed request on it
+        for resubmission."""
+        rep.evicted = True
+        reg = _tel.registry()
+        reg.counter("serve/failovers").inc()
+        _tel.instant("serve.failover", {"replica": rep.name,
+                                        "reason": reason})
+        # cancel what sits undispatched in its queue: the inner futures
+        # fail with ReplicaUnavailable and the request pass resubmits
+        try:
+            rep.batcher.cancel_pending(ReplicaUnavailable(
+                f"replica {rep.name} evicted: {reason}"))
+        except Exception:  # noqa: BLE001 - the queue may be torn mid-crash
+            pass
+        # a hung (not dead) dispatcher also holds requests it already
+        # popped; their inner futures will never resolve — fail them over
+        # too. A zombie completion later is ignored (outer settles once).
+        with self._lock:
+            for r in self._inflight:
+                if r.replica is rep and r.inner is not None \
+                        and not r.inner.done():
+                    r.inner = None
+                    r.replica = None
+                    r.next_try_at = 0.0
+        # stop the batcher without waiting on a possibly-hung thread
+        try:
+            rep.batcher.stop(drain=False, timeout=0.1)
+        except Exception:  # noqa: BLE001
+            pass
+        if self._factory is not None and self._respawn_at is None:
+            self._respawn_at = time.perf_counter() + backoff_delay(
+                self._respawn_base, self._respawn_attempt)
+
+    def _respawn(self):
+        try:
+            rep = self._factory()
+        except Exception as e:  # noqa: BLE001 - retry under backoff
+            self._respawn_attempt += 1
+            self._respawn_at = time.perf_counter() + backoff_delay(
+                self._respawn_base, self._respawn_attempt)
+            _tel.instant("serve.respawn_failed", {"error": repr(e)})
+            return
+        with self._lock:
+            self._replicas.append(rep)
+        self._respawn_attempt = 0
+        self._respawn_at = None
+        _tel.registry().counter("serve/replica_restarts").inc()
+        _tel.instant("serve.replica_restart", {"replica": rep.name})
+
+    def _request_pass(self, now):
+        reg = _tel.registry()
+        with self._lock:
+            records = list(self._inflight)
+        done = []
+        for r in records:
+            if r.outer.done():
+                done.append(r)
+                continue
+            if r.inner is None:
+                # waiting for a retry slot / a healthy replica
+                if r.deadline is not None and now > r.deadline:
+                    reg.counter("serve/deadline_exceeded").inc()
+                    r.outer._fail(DeadlineExceeded(
+                        "request deadline passed before it could be "
+                        "(re)placed on a healthy replica"))
+                    done.append(r)
+                elif now - r.created > self.no_replica_timeout_s \
+                        and not any(rep.healthy for rep in self._replicas):
+                    reg.counter("serve/dropped").inc()
+                    r.outer._fail(RuntimeError(
+                        f"no healthy replica within "
+                        f"{self.no_replica_timeout_s:.1f}s"))
+                    done.append(r)
+                elif now >= r.next_try_at:
+                    with self._lock:
+                        self._assign_locked(r)
+                continue
+            if r.inner.done():
+                if r.replica is not None:
+                    with self._lock:
+                        r.replica.inflight = max(0, r.replica.inflight - 1)
+                err = r.inner.exception()
+                if err is None:
+                    r.outer.weights_version = r.inner.weights_version
+                    r.outer.replica = r.inner.replica
+                    r.outer.queue_wait_ms = r.inner.queue_wait_ms
+                    r.outer._resolve(r.inner.result())
+                    reg.counter("serve/completed").inc()
+                    done.append(r)
+                elif isinstance(err, DeadlineExceeded):
+                    r.outer._fail(err)  # counted at the batcher
+                    done.append(r)
+                else:
+                    self._note_failure(r, err, now)
+                    if r.outer.done():
+                        done.append(r)
+            elif r.deadline is not None and now > r.deadline:
+                # dispatched but not resolving (e.g. hung engine): the
+                # deadline settles the OUTER future; a zombie inner
+                # completion is discarded
+                reg.counter("serve/deadline_exceeded").inc()
+                r.outer._fail(DeadlineExceeded(
+                    "request deadline passed while dispatched"))
+                done.append(r)
+        if done:
+            with self._lock:
+                self._inflight = [r for r in self._inflight
+                                  if r not in done]
+
+    def _note_failure(self, r: _Routed, err, now):
+        """Inner attempt failed: resubmit under bounded backoff, or fail
+        the outer future for good."""
+        reg = _tel.registry()
+        out_of_time = r.deadline is not None and now > r.deadline
+        if r.attempts > self.max_retries and not isinstance(
+                err, ReplicaUnavailable) or out_of_time:
+            reg.counter("serve/dropped").inc()
+            r.outer._fail(err if not out_of_time else DeadlineExceeded(
+                f"deadline passed after {r.attempts} attempts "
+                f"(last error: {err!r})"))
+            return
+        reg.counter("serve/retries").inc()
+        r.inner = None
+        r.replica = None
+        r.next_try_at = now + backoff_delay(
+            self.retry_backoff_s, r.attempts - 1, cap=5.0)

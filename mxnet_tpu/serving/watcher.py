@@ -1,0 +1,164 @@
+"""Background checkpoint watcher: train→serve hot weight swap.
+
+Replaces the manual, stop-the-world ``InferStep.sync_params()`` handoff:
+a ``CheckpointWatcher`` polls a checkpoint directory (the trainer keeps
+``save_checkpoint``-ing into it; commit is the ``checkpoint_sharded``
+DONE-marker protocol, so a half-written save is invisible), and when a
+NEW committed checkpoint appears it
+
+1. loads the arrays (host-side, off the serving threads),
+2. **stages** them into each engine's standby buffer
+   (``InferStep.stage_params`` — cast to the live dtype, placed under the
+   live sharding, so the flip cannot change a dispatch signature), and
+3. **flips** every engine's live buffer (``swap_params``) — one reference
+   assignment between decode dispatches.
+
+In-flight dispatches hold their own param snapshot and finish on the old
+version; responses are tagged with the ``weights_version`` their dispatch
+actually served. A torn or unloadable checkpoint counts
+``serve/swap_failures`` and the engines keep serving the old weights —
+the next poll retries.
+
+Env knobs: ``MXTPU_SWAP_POLL_S`` (poll period, default 2.0).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Callable, Optional
+
+from .. import checkpoint_sharded as _cs
+from .. import telemetry as _tel
+from . import faults as _faults
+
+__all__ = ["CheckpointWatcher", "swap_poll_s"]
+
+
+def swap_poll_s(default: float = 2.0) -> float:
+    """``MXTPU_SWAP_POLL_S``: checkpoint-directory poll period."""
+    v = os.environ.get("MXTPU_SWAP_POLL_S", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+class CheckpointWatcher:
+    """Poll ``directory`` for committed checkpoints and hot-swap them
+    into live engines.
+
+    Parameters
+    ----------
+    engines : one ``InferStep`` or a sequence (e.g. ``Router.engines`` —
+        every replica swaps to the same version). A zero-arg callable is
+        also accepted and re-evaluated per poll, so respawned replicas
+        join the swap set automatically.
+    directory : checkpoint root — either itself a sharded checkpoint or
+        a directory of ``step_N``-style checkpoint subdirectories; the
+        newest committed one wins (``checkpoint_sharded.latest_committed``).
+    poll_s : poll period (``MXTPU_SWAP_POLL_S`` default).
+    on_swap : callback ``(version, path)`` after a successful flip.
+    """
+
+    def __init__(self, engines, directory: str,
+                 poll_s: Optional[float] = None,
+                 on_swap: Optional[Callable[[str, str], None]] = None,
+                 start: bool = True):
+        # NB: an InferStep is itself callable (its jitted forward), so
+        # "factory" means callable-but-not-an-engine
+        if hasattr(engines, "stage_params"):
+            fixed = [engines]
+            self._engines_fn = lambda: fixed
+        elif callable(engines):
+            self._engines_fn = engines
+        else:
+            fixed = list(engines)
+            self._engines_fn = lambda: fixed
+        self.directory = directory
+        self.poll_s = float(poll_s) if poll_s is not None else swap_poll_s()
+        self.on_swap = on_swap
+        self._seen: Optional[str] = None
+        self.last_error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-ckpt-watcher", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.poll_once()
+            except Exception:  # noqa: BLE001 - poll_once already accounts;
+                pass           # a watcher crash must never take serving down
+
+    # ----------------------------------------------------------------- poll
+    @property
+    def current_version(self) -> Optional[str]:
+        return self._seen
+
+    def poll_once(self) -> Optional[str]:
+        """One poll: find the newest committed checkpoint; if it is new,
+        load + stage + flip every engine. Returns the new version tag, or
+        None (nothing new, or the swap failed and the old weights keep
+        serving)."""
+        found = _cs.latest_committed(self.directory)
+        if found is None:
+            return None
+        path, token = found
+        if token == self._seen:
+            return None
+        reg = _tel.registry()
+        try:
+            # fault point: a checkpoint that commits but cannot be read
+            # back (torn file, lost shard) mid-swap
+            _faults.fire("ckpt.load", tag=path)
+            arrays = _cs.load_sharded(path)
+            engines = list(self._engines_fn())
+            # stage EVERYTHING before flipping ANYTHING: either all
+            # replicas move to the new version or none does
+            staged = [eng.stage_params(arrays) for eng in engines]
+        except Exception as e:  # noqa: BLE001 - keep serving old weights
+            self.last_error = e
+            reg.counter("serve/swap_failures").inc()
+            _tel.instant("serve.swap_failure",
+                         {"path": path, "error": repr(e)})
+            return None
+        version = os.path.basename(os.path.normpath(path)) + \
+            ":" + token.rsplit("@", 1)[-1]
+        for eng, vals in zip(engines, staged):
+            eng.swap_params(staged=vals, version=version)
+        self._seen = token
+        self.last_error = None
+        reg.counter("serve/swaps").inc()
+        _tel.set_info(weights_version=version)
+        _tel.instant("serve.swap", {"path": path, "version": version})
+        if self.on_swap is not None:
+            try:
+                self.on_swap(version, path)
+            except Exception:  # noqa: BLE001 - user callback
+                pass
+        return version

@@ -368,6 +368,25 @@ def report() -> dict:
         "infer_queue_wait_ms_p95": _hp(snap, "infer/queue_wait_ms", "p95"),
         "infer_requests": snap["counters"].get("infer/requests", 0),
         "infer_tokens": snap["counters"].get("infer/tokens", 0),
+        # self-healing serving (serving.router/.watcher/.faults): which
+        # weights are live and how often the plane healed itself — hot
+        # swaps, replica evictions (failovers), transparent retries, and
+        # the requests that were genuinely lost (should stay 0)
+        "weights_version": _RUN_INFO.get("weights_version"),
+        "serve_swaps": snap["counters"].get("serve/swaps", 0),
+        "serve_swap_failures": snap["counters"].get(
+            "serve/swap_failures", 0),
+        "serve_failovers": snap["counters"].get("serve/failovers", 0),
+        "serve_retries": snap["counters"].get("serve/retries", 0),
+        "serve_dropped": snap["counters"].get("serve/dropped", 0),
+        "serve_deadline_exceeded": snap["counters"].get(
+            "serve/deadline_exceeded", 0),
+        "serve_replica_restarts": snap["counters"].get(
+            "serve/replica_restarts", 0),
+        "serve_replicas_healthy": snap["gauges"].get(
+            "serve/replicas_healthy"),
+        "serve_faults_injected": snap["counters"].get(
+            "serve/faults_injected", 0),
         "counters": snap["counters"],
         "gauges": snap["gauges"],
         "histograms": snap["histograms"],

@@ -32,7 +32,21 @@ import threading
 import time
 from typing import Callable, Optional
 
-__all__ = ["Watchdog"]
+__all__ = ["Watchdog", "read_heartbeat"]
+
+
+def read_heartbeat(path: str) -> Optional[dict]:
+    """Parse a ``heartbeat.json``; None when missing or torn.
+
+    The writer publishes via ``os.replace`` so a torn read should be
+    impossible on a POSIX filesystem — but a health check must never
+    crash on a weird one, so decode failures degrade to None (= unknown)
+    rather than raising."""
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 class Watchdog:
@@ -117,6 +131,8 @@ class Watchdog:
             }
 
     def _write_heartbeat(self, status="alive"):
+        if self._heartbeat_suppressed():
+            return
         state = self._state()
         state.update({
             "status": status,
@@ -124,13 +140,35 @@ class Watchdog:
             "time": time.time(),
             "monotonic": time.monotonic(),
         })
-        tmp = self.heartbeat_path + ".tmp"
+        # atomic publish: unique tmp per writer (two watchdogs sharing a
+        # directory never interleave into one tmp file), fsync'd before
+        # the rename so the visible file is always complete JSON — a
+        # router health-reading this file concurrently can never observe
+        # a partial write
+        tmp = (f"{self.heartbeat_path}.{os.getpid()}"
+               f".{threading.get_ident()}.tmp")
         try:
             with open(tmp, "w") as f:
                 json.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp, self.heartbeat_path)
         except OSError:
-            pass
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def _heartbeat_suppressed(self) -> bool:
+        """Fault point ``watchdog.heartbeat`` (serving.faults): a stale
+        heartbeat with the process otherwise alive — the condition the
+        serving router's health scoring must catch."""
+        try:
+            from ..serving import faults as _faults
+        except Exception:  # noqa: BLE001 - minimal installs
+            return False
+        return _faults.check("watchdog.heartbeat",
+                             tag=self.directory) is not None
 
     def _dump_stacks(self, tag: str) -> Optional[str]:
         path = os.path.join(self.directory, f"stacks_{tag}.txt")

@@ -35,6 +35,15 @@ import pytest
 jax.config.update("jax_default_matmul_precision", "highest")
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running; excluded from the tier-1 run "
+        "(-m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injected failure-path scenario "
+        "(serving resilience; runs in tier-1)")
+
+
 @pytest.fixture(autouse=True)
 def _seed_rng():
     import mxnet_tpu as mx

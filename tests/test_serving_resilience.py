@@ -1,0 +1,798 @@
+"""Self-healing serving plane: hot weight swap, router failover, faults.
+
+Contracts under test (ISSUE 7 tentpole):
+
+- a hot weight swap under sustained ``DynamicBatcher`` load loses ZERO
+  requests, responses carry the ``weights_version`` their dispatch
+  actually served, and post-swap greedy outputs are BIT-identical to a
+  fresh engine built from the same checkpoint;
+- killing one of two router replicas mid-load (fault injection, no real
+  process death needed) completes every submitted future with
+  ``serve/failovers >= 1`` and zero steady-state recompiles;
+- the failure paths themselves are deterministic: ``serving.faults``
+  drives dispatch raises, dispatcher-thread death, hangs, stale
+  heartbeats, and torn checkpoints from env specs or test code.
+"""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import checkpoint_sharded as cs
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+from mxnet_tpu.parallel import InferStep
+from mxnet_tpu.serving import (CheckpointWatcher, DeadlineExceeded,
+                               DynamicBatcher, Replica, ReplicaUnavailable,
+                               Router, faults)
+from mxnet_tpu.telemetry.watchdog import Watchdog, read_heartbeat
+
+
+def _make_net(seed, prefix="serve_net_"):
+    """Tiny decode-capable transformer. A FIXED prefix keeps param names
+    identical across instances — the train->serve checkpoint contract
+    (trainer and server build the net from the same code)."""
+    np.random.seed(seed)
+    mx.random.seed(seed)
+    net = TransformerModel(src_vocab=61, tgt_vocab=61, units=16,
+                           hidden_size=32, num_layers=1, num_heads=2,
+                           max_length=64, dropout=0.0, prefix=prefix)
+    net.initialize(mx.initializer.Xavier())
+    net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                      nd.zeros((2, 8), dtype="int32"))
+    return net
+
+
+@pytest.fixture(scope="module")
+def net_a():
+    return _make_net(0)
+
+
+@pytest.fixture(scope="module")
+def net_b():
+    return _make_net(1)
+
+
+@pytest.fixture(scope="module")
+def shared_engine(net_a):
+    """One warmed engine reused by the batcher/router tests (router
+    replicas may share an engine — two batchers, one param set)."""
+    eng = InferStep(net_a, max_len=24)
+    eng.warmup([(2, 8)], max_new_tokens=4)
+    return eng
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _batcher(engine, **kw):
+    cfg = dict(bucket_keys=(8,), slots=2, timeout_ms=5.0,
+               max_new_tokens=4)
+    cfg.update(kw)
+    return DynamicBatcher(engine, **cfg)
+
+
+def _prompts(rng, n, lo=3, hi=61, lmin=3, lmax=8):
+    return [rng.randint(lo, hi, (rng.randint(lmin, lmax + 1),))
+            .astype(np.int32) for _ in range(n)]
+
+
+def _save_params(directory, net):
+    return cs.save_sharded(
+        directory, {n: p._data.data
+                    for n, p in net.collect_params().items()})
+
+
+# ---------------------------------------------------------------- faults
+class TestFaultHarness:
+    def test_programmatic_inject_and_fire(self):
+        faults.inject("x.p", times=2)
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("x.p")
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("x.p")
+        faults.fire("x.p")  # exhausted -> no-op
+        assert faults.specs()[0]["fired"] == 2
+
+    def test_after_skips_hits(self):
+        faults.inject("x.after", times=1, after=2)
+        faults.fire("x.after")
+        faults.fire("x.after")
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("x.after")
+
+    def test_match_restricts_tag(self):
+        faults.inject("x.m", times=None, match="r1")
+        faults.fire("x.m", tag="r2")  # no match -> no-op
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("x.m", tag="r1-main")
+        faults.fire("x.m", tag=None)  # no tag can never match
+
+    def test_delay_mode_sleeps_not_raises(self):
+        faults.inject("x.d", times=1, delay=0.05)
+        t0 = time.perf_counter()
+        faults.fire("x.d")
+        assert time.perf_counter() - t0 >= 0.045
+
+    def test_env_spec_parsed(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_FAULT_E_P", "times=1;match=zz")
+        assert faults.check("e.p", tag="aa") is None
+        assert faults.check("e.p", tag="a-zz-a") is not None
+        assert faults.check("e.p", tag="a-zz-a") is None  # exhausted
+
+    def test_env_spec_bad_key_raises(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_FAULT_E_BAD", "bogus=1")
+        with pytest.raises(MXNetError):
+            faults.check("e.bad")
+
+    def test_fault_counter(self):
+        before = mx.telemetry.registry().counter(
+            "serve/faults_injected").value
+        faults.inject("x.c", times=1)
+        with pytest.raises(faults.FaultInjected):
+            faults.fire("x.c")
+        assert mx.telemetry.registry().counter(
+            "serve/faults_injected").value == before + 1
+
+
+# -------------------------------------------------------------- heartbeat
+class TestAtomicHeartbeat:
+    def test_never_observes_partial_json(self, tmp_path):
+        """Hammer heartbeat writes from two watchdogs sharing a
+        directory while reading concurrently: every read parses — the
+        tmp+fsync+rename publish can never expose a partial file."""
+        wds = [Watchdog(str(tmp_path), interval=9.0) for _ in range(2)]
+        stop = threading.Event()
+        bad = []
+
+        def writer(wd):
+            while not stop.is_set():
+                wd._write_heartbeat()
+
+        threads = [threading.Thread(target=writer, args=(wd,), daemon=True)
+                   for wd in wds]
+        for t in threads:
+            t.start()
+        path = os.path.join(str(tmp_path), "heartbeat.json")
+        deadline = time.perf_counter() + 1.0
+        reads = 0
+        while time.perf_counter() < deadline:
+            try:
+                with open(path) as f:
+                    json.load(f)
+                reads += 1
+            except FileNotFoundError:
+                continue
+            except ValueError as e:
+                bad.append(e)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert reads > 0 and not bad, \
+            f"{len(bad)} torn heartbeat reads out of {reads}"
+
+    def test_tmp_name_unique_per_writer(self, tmp_path):
+        wd = Watchdog(str(tmp_path), interval=9.0)
+        wd._write_heartbeat()
+        # the shared fixed-name tmp file of the old scheme must be gone
+        assert not os.path.exists(wd.heartbeat_path + ".tmp")
+        assert read_heartbeat(wd.heartbeat_path)["status"] == "alive"
+
+    def test_read_heartbeat_torn_is_none(self, tmp_path):
+        p = tmp_path / "heartbeat.json"
+        p.write_text('{"status": "al')  # torn mid-write
+        assert read_heartbeat(str(p)) is None
+        assert read_heartbeat(str(tmp_path / "missing.json")) is None
+
+    def test_suppression_fault_freezes_heartbeat(self, tmp_path):
+        wd = Watchdog(str(tmp_path), interval=9.0)
+        wd._write_heartbeat()
+        first = read_heartbeat(wd.heartbeat_path)
+        faults.inject("watchdog.heartbeat", times=None,
+                      match=str(tmp_path))
+        time.sleep(0.01)
+        wd._write_heartbeat()
+        assert read_heartbeat(wd.heartbeat_path)["time"] == first["time"]
+
+
+# ---------------------------------------------------------- batcher health
+class TestBatcherHealth:
+    def test_healthy_lifecycle(self, shared_engine):
+        bat = _batcher(shared_engine)
+        assert bat.healthy
+        bat.stop()
+        assert not bat.healthy
+
+    def test_submit_after_stop_fails_future_immediately(
+            self, shared_engine):
+        bat = _batcher(shared_engine)
+        bat.stop()
+        fut = bat.submit([3, 4, 5])
+        assert fut.done()
+        with pytest.raises(RuntimeError, match="not accepting"):
+            fut.result(timeout=0)
+
+    def test_submit_after_thread_death_fails_future(self, shared_engine):
+        faults.inject("batcher.thread", times=1, match="dead-replica")
+        bat = _batcher(shared_engine, name="dead-replica")
+        deadline = time.perf_counter() + 10
+        while bat._thread.is_alive() and time.perf_counter() < deadline:
+            time.sleep(0.005)
+        assert not bat.healthy
+        fut = bat.submit([3, 4])
+        assert fut.done() and isinstance(fut.exception(), RuntimeError)
+
+    def test_stop_fails_undrained_queue(self, shared_engine):
+        """stop(drain=False) with work still queued (here: stuck behind
+        a hung dispatch) fails those futures instead of leaking them."""
+        faults.inject("batcher.hang", times=1, delay=0.3,
+                      match="undrained")
+        bat = _batcher(shared_engine, name="undrained")
+        blocker = bat.submit([9, 10])  # dispatched, hangs 300 ms
+        time.sleep(0.05)
+        queued = bat.submit([3, 4, 5])
+        assert not queued.done()
+        bat.stop(drain=False)
+        assert isinstance(blocker.result(timeout=60), list)
+        assert queued.done()
+        with pytest.raises(RuntimeError, match="queued"):
+            queued.result(timeout=0)
+
+    def test_thread_death_fails_queued_futures(self, shared_engine):
+        """A crashing dispatcher fails what it held queued — no future
+        is ever left unresolvable."""
+        faults.inject("batcher.hang", times=1, delay=0.3,
+                      match="dying-replica")
+        faults.inject("batcher.thread", times=1, after=1,
+                      match="dying-replica")
+        bat = _batcher(shared_engine, name="dying-replica",
+                       timeout_ms=1.0)
+        fut = bat.submit([3, 4])  # dispatched, hangs 300 ms
+        time.sleep(0.1)
+        fut2 = bat.submit([5, 6])  # queued; the thread dies next pass
+        assert isinstance(fut.result(timeout=60), list)
+        with pytest.raises(RuntimeError):
+            fut2.result(timeout=60)
+
+
+# ------------------------------------------------------------- deadlines
+class TestDeadlines:
+    def test_expired_in_queue_fails_not_dispatches(self, shared_engine):
+        """A request whose deadline passes while queued (here: behind a
+        hung dispatch) is failed with DeadlineExceeded; the batch it
+        would have ridden dispatches without it and occupancy telemetry
+        reflects only the live rows."""
+        mx.telemetry.reset()
+        faults.inject("batcher.hang", times=1, delay=0.2,
+                      match="dl-replica")
+        bat = _batcher(shared_engine, slots=2, timeout_ms=5.0,
+                       name="dl-replica")
+        try:
+            blocker = bat.submit([9, 10])  # dispatched, hangs 200 ms
+            time.sleep(0.05)  # blocker is in its (hung) dispatch alone
+            doomed = bat.submit([3, 4, 5], deadline_ms=20.0)
+            live = bat.submit([6, 7, 8])  # same batch as doomed, no limit
+            assert isinstance(blocker.result(timeout=60), list)
+            assert isinstance(live.result(timeout=60), list)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=60)
+            reg = mx.telemetry.registry()
+            assert reg.counter("serve/deadline_exceeded").value == 1
+            # the expired row never occupied a slot: the second dispatch
+            # carried 1 live row of 2 slots, and only 2 requests total
+            # were ever dispatched
+            assert reg.gauge("infer/batch_occupancy").value == 0.5
+            assert reg.counter("infer/requests").value == 2
+        finally:
+            bat.stop()
+            mx.telemetry.reset()
+
+    def test_unexpired_deadline_dispatches_normally(self, shared_engine):
+        bat = _batcher(shared_engine)
+        try:
+            fut = bat.submit([3, 4, 5], deadline_ms=60_000.0)
+            assert isinstance(fut.result(timeout=60), list)
+        finally:
+            bat.stop()
+
+    def test_router_deadline_on_hung_replica(self, shared_engine):
+        """A dispatched-but-hung request settles via its deadline
+        instead of waiting on the wedged engine forever."""
+        faults.inject("batcher.hang", times=1, delay=1.5,
+                      match="hang-replica")
+        bat = _batcher(shared_engine, name="hang-replica")
+        router = Router([Replica("hang-replica", bat)],
+                        health_interval_s=10.0, start=True)
+        try:
+            fut = router.submit([3, 4, 5], deadline_ms=150.0)
+            with pytest.raises(DeadlineExceeded):
+                fut.result(timeout=60)
+        finally:
+            router.stop()
+
+
+# ------------------------------------------------------------ weight swap
+class TestHotWeightSwap:
+    def test_swap_params_flips_version_and_values(self, net_a, net_b):
+        eng = InferStep(net_a, max_len=24)
+        assert eng.weights_version == "v0"
+        arrays = {n: p._data.data
+                  for n, p in net_b.collect_params().items()}
+        ver = eng.swap_params(arrays)
+        assert ver == "v1" and eng.weights_version == "v1"
+        name = next(iter(arrays))
+        np.testing.assert_array_equal(
+            np.asarray(eng._values[name]), np.asarray(arrays[name]))
+
+    def test_swap_validates_names_and_shapes(self, net_a):
+        eng = InferStep(net_a, max_len=24)
+        with pytest.raises(MXNetError, match="missing parameter"):
+            eng.swap_params({})
+        arrays = {n: p._data.data
+                  for n, p in net_a.collect_params().items()}
+        k = next(iter(arrays))
+        bad = dict(arrays)
+        bad[k] = np.zeros((3, 3), np.float32)
+        with pytest.raises(MXNetError, match="shape mismatch"):
+            eng.swap_params(bad)
+
+    def test_swap_accepts_trainstep_naming(self, net_a, net_b):
+        eng = InferStep(net_a, max_len=24)
+        arrays = {"values/" + n: p._data.data
+                  for n, p in net_b.collect_params().items()}
+        arrays["opt/m/whatever"] = np.zeros((1,), np.float32)  # ignored
+        assert eng.swap_params(arrays) == "v1"
+
+    def test_swapped_outputs_bit_identical_to_fresh_engine(
+            self, net_a, net_b, tmp_path):
+        """Acceptance: post-swap greedy outputs == a fresh engine loaded
+        from the same checkpoint, bit-identically."""
+        _save_params(str(tmp_path / "step_1"), net_b)
+        eng = InferStep(net_a, max_len=24)
+        rng = np.random.RandomState(3)
+        src = rng.randint(3, 61, (2, 8)).astype(np.int32)
+        vl = np.array([6, 8], np.int32)
+        before = eng.decode_n(src, vl, max_new_tokens=4)
+        before = (before[0].asnumpy(), before[1].asnumpy())
+        w = CheckpointWatcher(eng, str(tmp_path), start=False)
+        ver = w.poll_once()
+        assert ver is not None and eng.weights_version == ver
+        after = eng.decode_n(src, vl, max_new_tokens=4)
+        after = (after[0].asnumpy(), after[1].asnumpy())
+        fresh_eng = InferStep(net_b, max_len=24)
+        fresh = fresh_eng.decode_n(src, vl, max_new_tokens=4)
+        fresh = (fresh[0].asnumpy(), fresh[1].asnumpy())
+        assert not np.array_equal(after[0], before[0])
+        np.testing.assert_array_equal(after[0], fresh[0])
+        np.testing.assert_array_equal(after[1], fresh[1])
+        # a swap to IDENTICAL shapes/dtypes adds no program signatures
+        assert eng.compile_guard.steady_state_recompiles == 0
+
+    def test_swap_under_load_loses_nothing(self, net_b, tmp_path):
+        """Acceptance: a swap mid-stream resolves every future, tags the
+        responses with the version that served them, and never
+        recompiles."""
+        net = _make_net(7)
+        eng = InferStep(net, max_len=24)
+        eng.warmup([(2, 8)], max_new_tokens=4)
+        _save_params(str(tmp_path / "step_9"), net_b)
+        watcher = CheckpointWatcher(eng, str(tmp_path), start=False)
+        bat = _batcher(eng, warmup=False)
+        rng = np.random.RandomState(11)
+        futs = []
+        try:
+            for i, p in enumerate(_prompts(rng, 30)):
+                futs.append(bat.submit(p))
+                if i == 12:
+                    assert watcher.poll_once() is not None
+                time.sleep(0.002)
+            results = [f.result(timeout=120) for f in futs]
+        finally:
+            bat.stop()
+        assert all(isinstance(r, list) for r in results)
+        versions = {f.weights_version for f in futs}
+        assert "v0" in versions and len(versions) == 2, versions
+        # version tags are MONOTONIC: once the swap lands, no later
+        # dispatch serves the old weights
+        seen_new = False
+        for f in futs:
+            if f.weights_version != "v0":
+                seen_new = True
+            else:
+                assert not seen_new, "old version served after the swap"
+        assert eng.compile_guard.steady_state_recompiles == 0
+
+    def test_torn_checkpoint_keeps_serving_old(self, net_a, net_b,
+                                               tmp_path):
+        mx.telemetry.reset()
+        _save_params(str(tmp_path / "step_1"), net_b)
+        eng = InferStep(net_a, max_len=24)
+        w = CheckpointWatcher(eng, str(tmp_path), start=False)
+        faults.inject("ckpt.load", times=1)
+        assert w.poll_once() is None
+        assert isinstance(w.last_error, faults.FaultInjected)
+        assert eng.weights_version == "v0"
+        assert mx.telemetry.registry().counter(
+            "serve/swap_failures").value == 1
+        # fault exhausted: the NEXT poll retries the same commit and wins
+        assert w.poll_once() is not None
+        assert mx.telemetry.registry().counter("serve/swaps").value == 1
+        mx.telemetry.reset()
+
+    def test_uncommitted_checkpoint_invisible(self, net_a, net_b,
+                                              tmp_path):
+        d = tmp_path / "step_1"
+        _save_params(str(d), net_b)
+        os.unlink(d / "DONE.p0")  # retract the commit
+        assert cs.latest_committed(str(tmp_path)) is None
+        w = CheckpointWatcher(InferStep(net_a, max_len=24), str(tmp_path),
+                              start=False)
+        assert w.poll_once() is None
+
+    def test_latest_committed_prefers_newest(self, net_a, net_b,
+                                             tmp_path):
+        _save_params(str(tmp_path / "step_1"), net_a)
+        time.sleep(0.01)
+        _save_params(str(tmp_path / "step_2"), net_b)
+        path, token = cs.latest_committed(str(tmp_path))
+        assert path.endswith("step_2") and token is not None
+
+    def test_commit_token_changes_on_resave(self, net_a, tmp_path):
+        d = str(tmp_path / "ck")
+        _save_params(d, net_a)
+        t1 = cs.commit_token(d)
+        time.sleep(0.01)
+        _save_params(d, net_a)
+        t2 = cs.commit_token(d)
+        assert t1 is not None and t2 is not None and t1 != t2
+
+    def test_background_thread_swaps(self, net_a, net_b, tmp_path):
+        eng = InferStep(net_a, max_len=24)
+        w = CheckpointWatcher(eng, str(tmp_path), poll_s=0.02)
+        try:
+            assert eng.weights_version == "v0"
+            _save_params(str(tmp_path / "step_3"), net_b)
+            deadline = time.perf_counter() + 30
+            while eng.weights_version == "v0" and \
+                    time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert eng.weights_version.startswith("step_3:")
+        finally:
+            w.stop()
+
+
+# ----------------------------------------------------------------- router
+class TestRouter:
+    def _two_replicas(self, engine, **bkw):
+        b1 = _batcher(engine, name="r1", **bkw)
+        b2 = _batcher(engine, name="r2", **bkw)
+        return [Replica("r1", b1), Replica("r2", b2)]
+
+    def test_basic_routing_completes(self, shared_engine):
+        router = Router(self._two_replicas(shared_engine),
+                        health_interval_s=0.02)
+        rng = np.random.RandomState(5)
+        try:
+            futs = [router.submit(p) for p in _prompts(rng, 8)]
+            res = [f.result(timeout=120) for f in futs]
+        finally:
+            router.stop()
+        assert all(isinstance(r, list) for r in res)
+        assert all(f.replica in ("r1", "r2") for f in futs)
+
+    def test_failover_on_replica_death(self, shared_engine):
+        """Acceptance: killing one of two replicas mid-load completes
+        every future, serve/failovers >= 1, zero steady recompiles."""
+        mx.telemetry.reset()
+        router = Router(self._two_replicas(shared_engine),
+                        retry_backoff_s=0.01, health_interval_s=0.02)
+        faults.inject("batcher.thread", times=1, after=1, match="r1")
+        rng = np.random.RandomState(6)
+        futs = []
+        try:
+            for p in _prompts(rng, 16):
+                futs.append(router.submit(p))
+                time.sleep(0.002)
+            res = [f.result(timeout=120) for f in futs]
+        finally:
+            router.stop()
+        assert all(isinstance(r, list) for r in res)
+        reg = mx.telemetry.registry()
+        assert reg.counter("serve/failovers").value >= 1
+        assert reg.counter("serve/dropped").value == 0
+        assert reg.counter("serve/completed").value == len(futs)
+        assert [r for r in router.replicas if r.name == "r1"][0].evicted
+        assert shared_engine.compile_guard.steady_state_recompiles == 0
+        mx.telemetry.reset()
+
+    def test_dispatch_error_retries_on_other_replica(self, shared_engine):
+        """A transient dispatch failure is retried transparently — the
+        caller sees tokens, the registry sees the retry."""
+        mx.telemetry.reset()
+        router = Router(self._two_replicas(shared_engine),
+                        retry_backoff_s=0.01, health_interval_s=0.02)
+        faults.inject("batcher.dispatch", times=1)
+        rng = np.random.RandomState(7)
+        try:
+            fut = router.submit(rng.randint(3, 61, (5,)).astype(np.int32))
+            assert isinstance(fut.result(timeout=120), list)
+        finally:
+            router.stop()
+        assert mx.telemetry.registry().counter(
+            "serve/retries").value >= 1
+        mx.telemetry.reset()
+
+    def test_retries_bounded_then_dropped(self, shared_engine):
+        mx.telemetry.reset()
+        router = Router(self._two_replicas(shared_engine),
+                        max_retries=1, retry_backoff_s=0.01,
+                        health_interval_s=0.02)
+        faults.inject("batcher.dispatch", times=None)  # every dispatch
+        rng = np.random.RandomState(8)
+        try:
+            fut = router.submit(rng.randint(3, 61, (5,)).astype(np.int32))
+            with pytest.raises(faults.FaultInjected):
+                fut.result(timeout=120)
+        finally:
+            router.stop()
+        reg = mx.telemetry.registry()
+        assert reg.counter("serve/dropped").value == 1
+        assert reg.counter("serve/retries").value == 1  # bounded
+        mx.telemetry.reset()
+
+    def test_no_healthy_replica_fails_fast(self, shared_engine):
+        rep = Replica("r1", _batcher(shared_engine))
+        router = Router([rep], health_interval_s=0.02,
+                        no_replica_timeout_s=0.2)
+        try:
+            rep.batcher.stop()
+            deadline = time.perf_counter() + 10
+            while not rep.evicted and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            fut = router.submit([3, 4, 5])
+            with pytest.raises(RuntimeError, match="no healthy"):
+                fut.result(timeout=60)
+        finally:
+            router.stop()
+
+    def test_queued_requests_resubmitted_on_eviction(self, shared_engine):
+        """The eviction contract end-to-end: requests queued (and even
+        in-flight) on a replica when it is evicted are transparently
+        replayed on the healthy one — every future resolves, on r2."""
+        faults.inject("batcher.hang", times=1, delay=0.5, match="r1")
+        b1 = _batcher(shared_engine, name="r1")
+        b2 = _batcher(shared_engine, name="r2")
+        rep1, rep2 = Replica("r1", b1), Replica("r2", b2)
+        router = Router([rep1, rep2], retry_backoff_s=0.01,
+                        health_interval_s=0.02)
+        rng = np.random.RandomState(9)
+        try:
+            # bias placement onto r1, whose first dispatch will hang
+            rep2.inflight = 100
+            futs = [router.submit(p) for p in _prompts(rng, 4)]
+            time.sleep(0.05)  # first req dispatched+hung, rest queued
+            rep2.inflight = 0
+            router._evict(rep1, "test: operator eviction")
+            res = [f.result(timeout=120) for f in futs]
+        finally:
+            router.stop()
+        assert all(isinstance(r, list) for r in res)
+        assert all(f.replica == "r2" for f in futs)
+        assert rep1.evicted
+        assert mx.telemetry.registry().counter(
+            "serve/failovers").value >= 1
+
+    def test_heartbeat_staleness_evicts(self, shared_engine, tmp_path):
+        """Watchdog-driven failover: the replica's dispatcher is alive
+        but its heartbeat is frozen (suppression fault) — the router
+        evicts on staleness and the healthy replica serves."""
+        mx.telemetry.reset()
+        hb_dir = str(tmp_path / "wd1")
+        wd = Watchdog(hb_dir, interval=0.02)
+        b1 = _batcher(shared_engine, name="r1", watchdog=wd)
+        b2 = _batcher(shared_engine, name="r2")
+        wd.start()
+        rep1 = Replica("r1", b1, heartbeat_path=wd.heartbeat_path,
+                       heartbeat_stale_s=0.15)
+        router = Router([rep1, Replica("r2", b2)],
+                        retry_backoff_s=0.01, health_interval_s=0.02)
+        try:
+            rng = np.random.RandomState(10)
+            # serves normally while the heartbeat is fresh
+            fut = router.submit(rng.randint(3, 61, (5,)).astype(np.int32))
+            fut.result(timeout=120)
+            # wait until the FIRST heartbeat actually landed: freezing a
+            # never-written heartbeat is indistinguishable from "no
+            # watchdog wired", which health() treats as unknown
+            deadline = time.perf_counter() + 30
+            while read_heartbeat(wd.heartbeat_path) is None and \
+                    time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert read_heartbeat(wd.heartbeat_path) is not None
+            faults.inject("watchdog.heartbeat", times=None, match=hb_dir)
+            deadline = time.perf_counter() + 30
+            while not rep1.evicted and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert rep1.evicted
+            fut2 = router.submit(
+                rng.randint(3, 61, (5,)).astype(np.int32))
+            assert isinstance(fut2.result(timeout=120), list)
+            assert fut2.replica == "r2"
+            assert mx.telemetry.registry().counter(
+                "serve/failovers").value >= 1
+        finally:
+            router.stop()
+            wd.stop()
+            mx.telemetry.reset()
+
+    def test_respawn_via_factory(self, shared_engine):
+        mx.telemetry.reset()
+        made = []
+
+        def factory():
+            rep = Replica(f"r{2 + len(made)}", _batcher(shared_engine))
+            made.append(rep)
+            return rep
+
+        rep1 = Replica("r1", _batcher(shared_engine))
+        router = Router([rep1], replica_factory=factory,
+                        respawn_backoff_s=0.01, retry_backoff_s=0.01,
+                        health_interval_s=0.02)
+        rng = np.random.RandomState(12)
+        try:
+            faults.inject("batcher.thread", times=1, match="r1")
+            # poke r1 so its thread hits the fault point and dies
+            deadline = time.perf_counter() + 30
+            while not made and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert made, "factory never invoked after eviction"
+            fut = router.submit(rng.randint(3, 61, (5,)).astype(np.int32))
+            assert isinstance(fut.result(timeout=120), list)
+            assert fut.replica == made[0].name
+            assert mx.telemetry.registry().counter(
+                "serve/replica_restarts").value == 1
+        finally:
+            router.stop()
+            mx.telemetry.reset()
+
+    def test_backoff_delay_shape(self):
+        from mxnet_tpu.serving.router import backoff_delay
+
+        d0 = backoff_delay(1.0, 0, jitter=0.0)
+        d3 = backoff_delay(1.0, 3, jitter=0.0)
+        dcap = backoff_delay(1.0, 30, cap=30.0, jitter=0.0)
+        assert d0 == 1.0 and d3 == 8.0 and dcap == 30.0
+        j = backoff_delay(1.0, 0, jitter=0.25)
+        assert 1.0 <= j <= 1.25
+
+
+# -------------------------------------------------------- elastic restarts
+class TestElasticBackoff:
+    def test_restart_backoff_and_counter(self):
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(__file__), "..", "tools"))
+        import launch
+
+        mx.telemetry.reset()
+        delays = []
+        rc = launch.launch_elastic(
+            1, [sys.executable, "-c", "import sys; sys.exit(3)"],
+            max_restarts=2, backoff_s=0.2, _sleep=delays.append)
+        assert rc == 3
+        assert len(delays) == 2  # no sleep after the final attempt
+        assert 0.2 <= delays[0] <= 0.25 * 1.01
+        assert 0.4 <= delays[1] <= 0.5 * 1.01
+        assert mx.telemetry.registry().counter(
+            "launch/restarts").value == 2
+        mx.telemetry.reset()
+
+    def test_env_default_backoff(self, monkeypatch):
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(__file__), "..", "tools"))
+        import launch
+
+        monkeypatch.setenv("MXTPU_RESTART_BACKOFF_S", "0.125")
+        assert launch.restart_backoff_s() == 0.125
+        monkeypatch.setenv("MXTPU_RESTART_BACKOFF_S", "junk")
+        assert launch.restart_backoff_s() == 1.0
+
+
+# ------------------------------------------------------------- telemetry
+class TestServeTelemetry:
+    def test_report_serve_fields(self):
+        mx.telemetry.reset()
+        reg = mx.telemetry.registry()
+        reg.counter("serve/swaps").inc(2)
+        reg.counter("serve/failovers").inc()
+        reg.gauge("serve/replicas_healthy").set(3)
+        mx.telemetry.set_info(weights_version="step_5:abc")
+        rep = mx.telemetry.report()
+        assert rep["serve_swaps"] == 2
+        assert rep["serve_failovers"] == 1
+        assert rep["serve_replicas_healthy"] == 3
+        assert rep["serve_dropped"] == 0
+        assert rep["weights_version"] == "step_5:abc"
+        mx.telemetry.reset()
+
+    def test_telemetry_report_tool_prints_serve_family(self, tmp_path,
+                                                       capsys):
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(__file__), "..", "tools"))
+        import telemetry_report
+
+        report = {
+            "weights_version": "step_7:123",
+            "counters": {"serve/swaps": 1, "serve/failovers": 2,
+                         "serve/dropped": 1, "launch/restarts": 3},
+            "gauges": {"serve/replicas_healthy": 1},
+        }
+        p = tmp_path / "report.json"
+        p.write_text(json.dumps(report))
+        telemetry_report._print_serve_family(str(p))
+        out = capsys.readouterr().out
+        assert "Self-healing serving" in out
+        assert "serve/failovers" in out and "2" in out
+        assert "launch/restarts" in out
+        assert "WARNING" in out  # dropped > 0
+
+
+# ------------------------------------------------------------ chaos smoke
+@pytest.mark.chaos
+def test_chaos_smoke_swap_and_failover_end_to_end(tmp_path, monkeypatch,
+                                                  net_b):
+    """Tier-1 chaos scenario, env-spec driven end to end: 2 replicas
+    behind a router + checkpoint watcher; MXTPU_FAULT_BATCHER_THREAD
+    kills replica r1 mid-load while a hot swap lands. Every future
+    resolves, both weight versions served, serve/failovers >= 1, zero
+    steady recompiles."""
+    mx.telemetry.reset()
+    monkeypatch.setenv("MXTPU_FAULT_BATCHER_THREAD",
+                       "times=1;after=1;match=r1")
+    faults.clear()  # drop the cached (unset) env scan for this point
+
+    net = _make_net(21)
+    eng = InferStep(net, max_len=24)
+    eng.warmup([(2, 8)], max_new_tokens=4)
+    reps = [Replica("r1", _batcher(eng, name="r1")),
+            Replica("r2", _batcher(eng, name="r2"))]
+    router = Router(reps, retry_backoff_s=0.01, health_interval_s=0.02)
+    _save_params(str(tmp_path / "step_1"), net_b)
+    watcher = CheckpointWatcher(router.engines, str(tmp_path),
+                                start=False)
+    rng = np.random.RandomState(13)
+    futs = []
+    try:
+        for i, p in enumerate(_prompts(rng, 24)):
+            futs.append(router.submit(p))
+            if i == 10:
+                assert watcher.poll_once() is not None
+            time.sleep(0.002)
+        results = [f.result(timeout=120) for f in futs]
+    finally:
+        router.stop()
+        mx.telemetry.disable()
+    assert all(isinstance(r, list) for r in results)
+    versions = {f.weights_version for f in futs}
+    assert "v0" in versions and len(versions) == 2, versions
+    reg = mx.telemetry.registry()
+    assert reg.counter("serve/failovers").value >= 1
+    assert reg.counter("serve/swaps").value == 1
+    assert reg.counter("serve/dropped").value == 0
+    assert eng.compile_guard.steady_state_recompiles == 0
+    mx.telemetry.reset()

@@ -130,9 +130,37 @@ def launch_local(num_procs: int, command, coordinator: str | None = None,
     return rc
 
 
+def restart_backoff_s(default: float = 1.0) -> float:
+    """``MXTPU_RESTART_BACKOFF_S``: base delay of the capped exponential
+    backoff between elastic restart attempts (shared contract with the
+    serving router's replica respawn)."""
+    v = os.environ.get("MXTPU_RESTART_BACKOFF_S", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def _count_restart(attempt: int, rc: int, delay: float):
+    """Restart accounting in the launcher's telemetry registry (the
+    ``launch/`` family; best-effort — the launcher must run even where
+    the package is not importable)."""
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        _tel.registry().counter("launch/restarts").inc()
+        _tel.instant("launch.restart",
+                     {"attempt": attempt, "rc": rc, "backoff_s": delay})
+    except Exception:  # noqa: BLE001
+        pass
+
+
 def launch_elastic(num_procs: int, command, max_restarts: int = 0,
                    coordinator: str | None = None,
-                   timeout: float | None = None):
+                   timeout: float | None = None,
+                   backoff_s: float | None = None,
+                   max_backoff_s: float = 30.0,
+                   _sleep=time.sleep):
     """Restart-based failure recovery (SURVEY §5: the reference
     ecosystem's answer to worker failure was checkpoint + full-job
     restart — there is no partial-membership mode in a bulk-synchronous
@@ -143,8 +171,18 @@ def launch_elastic(num_procs: int, command, max_restarts: int = 0,
     coordinator port (a user-supplied ``coordinator`` is honored on the
     FIRST attempt only — relaunching on the dead attempt's port could
     collide with TIME_WAIT sockets or stale coordination-service state);
-    ``MXNET_TPU_RESTART_COUNT`` tells workers which attempt they are."""
+    ``MXNET_TPU_RESTART_COUNT`` tells workers which attempt they are.
+
+    Restarts are spaced by capped exponential backoff with jitter
+    (``backoff_s`` base, ``MXTPU_RESTART_BACKOFF_S`` default 1.0,
+    doubling per attempt up to ``max_backoff_s``): a job that dies
+    instantly — bad binary, dead coordinator host, full disk — must not
+    hammer the scheduler/rendezvous with back-to-back relaunches.
+    Restarts are counted in the telemetry registry (``launch/restarts``)."""
+    import random
+
     attempts = max_restarts + 1
+    base = backoff_s if backoff_s is not None else restart_backoff_s()
     rc = 0
     for attempt in range(attempts):
         os.environ["MXNET_TPU_RESTART_COUNT"] = str(attempt)
@@ -153,9 +191,17 @@ def launch_elastic(num_procs: int, command, max_restarts: int = 0,
                           else None, timeout=timeout)
         if rc == 0:
             return 0
-        print(f"launch: attempt {attempt + 1}/{attempts} failed rc={rc}"
-              + ("; restarting from the latest checkpoint"
-                 if attempt + 1 < attempts else "; giving up"))
+        if attempt + 1 >= attempts:
+            print(f"launch: attempt {attempt + 1}/{attempts} failed "
+                  f"rc={rc}; giving up")
+            break
+        delay = min(base * (2.0 ** attempt), max_backoff_s) \
+            * (1.0 + 0.25 * random.random())
+        print(f"launch: attempt {attempt + 1}/{attempts} failed rc={rc}; "
+              f"restarting from the latest checkpoint in {delay:.1f}s")
+        _count_restart(attempt, rc, delay)
+        if delay > 0:
+            _sleep(delay)
     return rc
 
 
@@ -209,6 +255,11 @@ def main(argv=None):
         help="relaunch the whole job up to N times when a worker dies "
         "(workers resume from the latest committed checkpoint)",
     )
+    ap.add_argument(
+        "--restart-backoff", type=float, default=None,
+        help="base seconds of the capped exponential backoff between "
+        "restart attempts (default: MXTPU_RESTART_BACKOFF_S or 1.0)",
+    )
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args(argv)
     command = args.command
@@ -220,7 +271,8 @@ def main(argv=None):
         if args.max_restarts > 0:
             rc = launch_elastic(args.num_workers, command,
                                 max_restarts=args.max_restarts,
-                                coordinator=args.coordinator)
+                                coordinator=args.coordinator,
+                                backoff_s=args.restart_backoff)
         else:
             rc = launch_local(args.num_workers, command, args.coordinator)
     else:

@@ -168,6 +168,37 @@ def _print_infer_family(report_path):
               f"n={h.get('count')}")
 
 
+def _print_serve_family(report_path):
+    """Surface the ``serve/`` metric family (self-healing serving plane:
+    hot weight swaps, replica failovers, transparent retries, dropped
+    requests, injected faults) from a ``report.json`` snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith(("serve/", "launch/"))}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k.startswith("serve/")}
+    version = report.get("weights_version")
+    if not counters and not gauges and not version:
+        return
+    print("\n== Self-healing serving ==")
+    if version:
+        print(f"  {'weights_version':<38} {version}")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    dropped = counters.get("serve/dropped", 0)
+    if dropped:
+        print(f"  WARNING: {dropped} request(s) dropped after retry "
+              "exhaustion — check replica health and MXTPU_RETRY_MAX")
+
+
 def _print_shard_family(report_path):
     """Surface the ``shard/`` metric family (SPMD sharding spine: mesh
     shape, global vs per-shard parameter bytes, collective-traffic
@@ -243,6 +274,7 @@ def main(argv=None):
         _print_compile_family(os.path.join(directory, "report.json"))
         _print_infer_family(os.path.join(directory, "report.json"))
         _print_shard_family(os.path.join(directory, "report.json"))
+        _print_serve_family(os.path.join(directory, "report.json"))
     return 0
 
 
