@@ -316,6 +316,159 @@ def decode_main(args):
     return 0 if ok else 1
 
 
+# --------------------------------------------------------- open-loop mode
+def open_loop_main(args):
+    """Continuous-vs-fixed batching under Poisson open-loop load (the
+    ISSUE-8 acceptance ablation, CPU-sized).
+
+    One seeded request stream — exponential inter-arrival gaps at
+    ``--open-loop RATE`` req/s, uniform prompt lengths, and a 50/50 mix
+    of short (``T // 4``) and long (``T``) ``max_new_tokens`` — is
+    replayed against (a) the PR-5 fixed-dispatch ``DynamicBatcher``
+    (every batch decodes the full ``T`` and a finished row idles its slot
+    until the batch drains) and (b) the paged-KV ``ContinuousBatcher``
+    (iteration-level retire/admit). Gates: sustained decode-batch
+    occupancy >= 0.9 for the continuous engine and >= 1.5x the fixed
+    batcher's decode tokens/sec, with zero steady-state recompiles."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+    from mxnet_tpu.parallel import InferStep
+    from mxnet_tpu.serving import ContinuousBatcher, DynamicBatcher
+    from .common import infer_fields
+
+    V, B, T = args.vocab, args.batch_size, args.decode_tokens
+    bucket = args.max_len
+    rate = args.open_loop
+    n_requests = args.samples
+    # scheduling quality only shows when MODEL COMPUTE is the scheduled
+    # resource: at the other modes' micro sizes a decode step costs less
+    # than its dispatch and every scheduler measures python overhead, so
+    # this mode floors the model at a small-but-real serving size
+    units = max(args.units, 128)
+    layers = max(args.layers, 2)
+    iter_tokens = args.iter_tokens if args.iter_tokens is not None else 8
+
+    net = TransformerModel(
+        src_vocab=V, tgt_vocab=V, units=units,
+        hidden_size=units * 2, num_layers=layers, num_heads=2,
+        max_length=bucket + T + 8, dropout=0.0)
+    net.initialize(mx.initializer.Xavier())
+    net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                      nd.zeros((2, 8), dtype="int32"))
+
+    # one seeded workload, replayed identically against both schedulers.
+    # The max_new mix mirrors real serving traffic: mostly short
+    # responses with a long tail (the regime Orca/PagedAttention target —
+    # the fixed batcher decodes EVERY batch to the full T while its short
+    # rows idle their slots)
+    short = max(T // 8, 2)
+    rng = np.random.RandomState(args.seed)
+    stream = []
+    for _ in range(n_requests):
+        n = rng.randint(args.min_len, bucket + 1)
+        stream.append({
+            "gap": rng.exponential(1.0 / rate) if rate > 0 else 0.0,
+            "prompt": rng.randint(3, V, (n,)).astype("int32"),
+            "max_new": short if rng.rand() < 0.8 else T,
+        })
+    total_requested = sum(r["max_new"] for r in stream)
+
+    def drive(batcher):
+        futs = []
+        t0 = time.perf_counter()
+        for r in stream:
+            if r["gap"]:
+                time.sleep(r["gap"])
+            futs.append(batcher.submit(r["prompt"],
+                                       max_new_tokens=r["max_new"]))
+        tokens = ttfts = 0
+        ttft_list, lat_list = [], []
+        for f in futs:
+            out = f.result(timeout=600)
+            tokens += len(out)
+            done = time.perf_counter()
+            lat_list.append((done - f.enqueued_at) * 1e3 / max(len(out), 1))
+            if f.first_token_at is not None:
+                ttft_list.append((f.first_token_at - f.enqueued_at) * 1e3)
+                ttfts += 1
+        wall = time.perf_counter() - t0
+        ttft_list.sort()
+        lat_list.sort()
+        return {
+            "tokens": tokens,
+            "tokens_per_sec": round(tokens / wall, 1),
+            "wall_s": round(wall, 3),
+            "ttft_ms_p50": round(_q(ttft_list, 50), 1) if ttft_list
+            else None,
+            "ttft_ms_p95": round(_q(ttft_list, 95), 1) if ttft_list
+            else None,
+            "token_latency_ms_p50": round(_q(lat_list, 50), 2),
+            "token_latency_ms_p95": round(_q(lat_list, 95), 2),
+        }
+
+    # ---- fixed (PR-5): whole-batch dispatches at the batcher's max_new
+    eng_f = InferStep(net, max_len=bucket + T + 4)
+    fixed_bat = DynamicBatcher(eng_f, bucket_keys=(bucket,), slots=B,
+                               timeout_ms=2.0, max_new_tokens=T,
+                               warmup=True, name="fixed")
+    fixed = drive(fixed_bat)
+    fixed_bat.stop()
+    fixed["steady_state_recompiles"] = \
+        eng_f.compile_guard.steady_state_recompiles
+
+    # ---- continuous: iteration-level retire/admit over the paged pool
+    eng_c = InferStep(net, max_len=bucket + T + 4)
+    cont_bat = ContinuousBatcher(
+        eng_c, bucket_keys=(bucket,), slots=B, max_new_tokens=T,
+        page_size=args.page_size, iter_tokens=iter_tokens,
+        warmup=True, name="continuous")
+    cont = drive(cont_bat)
+    occupancy = round(cont_bat.sustained_occupancy, 4)
+    stats = dict(cont_bat.stats)
+    pool = cont_bat.pool
+    cont_bat.stop()
+    cont["steady_state_recompiles"] = \
+        eng_c.compile_guard.steady_state_recompiles
+    cont["sustained_occupancy"] = occupancy
+    cont["iterations"] = stats["iterations"]
+    cont["preempted"] = stats["preempted"]
+
+    speedup = round(cont["tokens_per_sec"] / max(fixed["tokens_per_sec"],
+                                                 1e-9), 2)
+    row = {
+        "metric": "transformer_open_loop_tokens_per_sec",
+        "value": cont["tokens_per_sec"],
+        "unit": "tokens/sec",
+        "open_loop_rate": rate,
+        "requests": n_requests,
+        "tokens_requested": total_requested,
+        "sustained_occupancy": occupancy,
+        "speedup_vs_fixed": speedup,
+        "fixed": fixed,
+        "continuous": cont,
+        "slots": B, "prompt_bucket": bucket, "decode_tokens": T,
+        "page_size": pool.page_size, "num_pages": pool.num_pages,
+        "iter_tokens": cont_bat.iter_tokens,
+    }
+    row.update(infer_fields())
+    print(json.dumps(row))
+    print(f"open loop @ {rate}/s, {n_requests} req (max_new {short}|{T} "
+          f"mix): fixed {fixed['tokens_per_sec']} tok/s "
+          f"(ttft p50 {fixed['ttft_ms_p50']} ms) vs continuous "
+          f"{cont['tokens_per_sec']} tok/s ({speedup}x, occupancy "
+          f"{occupancy}, ttft p50 {cont['ttft_ms_p50']} ms, "
+          f"{stats['preempted']} preemptions, "
+          f"{cont['steady_state_recompiles']} steady recompiles)")
+    ok = (occupancy >= 0.9 and speedup >= 1.5
+          and cont["steady_state_recompiles"] == 0)
+    if not ok:
+        print("FAIL: continuous batching must sustain >= 90% occupancy "
+              "and >= 1.5x fixed-batcher tokens/sec with zero steady "
+              "recompiles", file=sys.stderr)
+    return 0 if ok else 1
+
+
 # -------------------------------------------------------- serve-chaos mode
 def serve_chaos_main(args):
     """Self-healing serving ablation (CPU-sized): sustained open-loop
@@ -567,6 +720,19 @@ def main(argv=None):
                     help="KV-cached vs naive re-forward decode ablation")
     ap.add_argument("--decode-tokens", type=int, default=32,
                     help="tokens generated per row in --decode mode")
+    ap.add_argument("--open-loop", type=float, nargs="?", const=500.0,
+                    default=None, metavar="RATE",
+                    help="with --decode: Poisson open-loop load at RATE "
+                         "req/s (default 500 = saturating on the CPU "
+                         "rig) through ContinuousBatcher vs the fixed "
+                         "DynamicBatcher at the same mixed-length "
+                         "workload")
+    ap.add_argument("--page-size", type=int, default=None,
+                    help="KV pool page size for --open-loop "
+                         "(MXTPU_PAGE_SIZE default)")
+    ap.add_argument("--iter-tokens", type=int, default=None,
+                    help="decode tokens per scheduler iteration for "
+                         "--open-loop (MXTPU_ITER_TOKENS default)")
     ap.add_argument("--serve-chaos", action="store_true",
                     help="self-healing serving ablation: hot weight swap "
                          "+ replica kill under sustained router load")
@@ -586,6 +752,8 @@ def main(argv=None):
     args = ap.parse_args(argv)
     if args.serve_chaos:
         return serve_chaos_main(args)
+    if args.open_loop is not None:
+        return open_loop_main(args)
     if args.decode:
         return decode_main(args)
     if args.auto_batch:

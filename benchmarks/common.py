@@ -84,6 +84,16 @@ def infer_fields():
         "batch_occupancy": None,
         "queue_wait_ms_p50": None,
         "steady_state_recompiles": None,
+        # continuous batching / paged KV columns (serving.
+        # ContinuousBatcher): time-to-first-token, pool pressure,
+        # admission flow and the backpressure/preemption counters
+        "ttft_ms_p50": None,
+        "ttft_ms_p95": None,
+        "pages_in_use": None,
+        "page_fragmentation": None,
+        "admitted_per_iter_p50": None,
+        "rejected_backpressure": None,
+        "preempted": None,
     }
     try:
         from mxnet_tpu import telemetry as _tel
@@ -98,8 +108,19 @@ def infer_fields():
                 h["infer/decode_ms_per_token"]["p50"]
         if "infer/queue_wait_ms" in h:
             fields["queue_wait_ms_p50"] = h["infer/queue_wait_ms"]["p50"]
+        if "infer/ttft_ms" in h:
+            fields["ttft_ms_p50"] = h["infer/ttft_ms"]["p50"]
+            fields["ttft_ms_p95"] = h["infer/ttft_ms"]["p95"]
+        if "infer/admitted_per_iter" in h:
+            fields["admitted_per_iter_p50"] = \
+                h["infer/admitted_per_iter"]["p50"]
         fields["infer_tokens_per_sec"] = g.get("infer/tokens_per_sec")
         fields["batch_occupancy"] = g.get("infer/batch_occupancy")
+        fields["pages_in_use"] = g.get("infer/pages_in_use")
+        fields["page_fragmentation"] = g.get("infer/page_fragmentation")
+        fields["rejected_backpressure"] = snap["counters"].get(
+            "infer/rejected_backpressure", 0)
+        fields["preempted"] = snap["counters"].get("infer/preempted", 0)
         fields["steady_state_recompiles"] = snap["counters"].get(
             "compile/steady_state_recompiles", 0)
     except Exception:  # noqa: BLE001 - telemetry must never kill a bench

@@ -313,7 +313,19 @@ class Estimator:
         optional ``parallel.infer.InferStep`` over the same net — batches
         then run through its jitted, shape-guarded forward (warm it with
         the loader's signature menu for a compile-free pass) instead of
-        the eager/hybridized path."""
+        the eager/hybridized path.
+
+        ``engine`` may also be a serving BATCHER (anything with
+        ``submit()`` — the ``serving.make_batcher`` default is the
+        paged-KV ``ContinuousBatcher``; ``MXTPU_BATCHER=fixed`` falls
+        back to ``DynamicBatcher``): each batch's rows are then submitted
+        as individual generation requests through iteration-level
+        scheduling and the per-batch output is a ``(tokens (B, max_new),
+        lengths (B,))`` NDArray pair, trimmed/padded exactly like
+        ``InferStep.decode_n``. Batches are ``src`` arrays or ``(src,
+        valid_length)`` tuples in that mode."""
+        if engine is not None and hasattr(engine, "submit"):
+            return self._predict_generate(data, batch_fn, engine)
         runner = engine if engine is not None else self.net
         outs = []
         for batch in data:
@@ -326,6 +338,51 @@ class Estimator:
             with (_tel.span("estimator.predict_batch") if _tel._ENABLED
                   else _tel.NULL_SPAN):
                 outs.append(runner(*inputs))
+        return outs
+
+    def _predict_generate(self, data, batch_fn, batcher):
+        """Generation pass through a serving batcher: rows fan out as
+        requests (continuous batching keeps the decode batch full across
+        batch boundaries), results gather back into per-batch
+        ``(tokens, lengths)`` pairs."""
+        import numpy as np
+
+        from ...ndarray.ndarray import NDArray
+        from ... import ndarray as _nd
+
+        outs = []
+        for batch in data:
+            if batch_fn is not None:
+                batch = batch_fn(batch)
+            if isinstance(batch, (list, tuple)):
+                src = batch[0]
+                vl = batch[1] if len(batch) > 1 else None
+            else:
+                src, vl = batch, None
+            src = src.asnumpy() if isinstance(src, NDArray) \
+                else np.asarray(src)
+            src = src.astype(np.int32)
+            B, L = src.shape
+            if vl is None:
+                vl_np = np.full((B,), L, np.int32)
+            else:
+                vl_np = (vl.asnumpy() if isinstance(vl, NDArray)
+                         else np.asarray(vl)).astype(np.int32)
+            with (_tel.span("estimator.predict_batch") if _tel._ENABLED
+                  else _tel.NULL_SPAN):
+                futs = [batcher.submit(
+                    src[i, :vl_np[i]] if vl_np[i] else src[i, :1])
+                    for i in range(B)]
+                toks = np.full((B, batcher.max_new), batcher._pad,
+                               np.int32)
+                lengths = np.zeros((B,), np.int32)
+                for i, f in enumerate(futs):
+                    got = f.result(timeout=600)
+                    n = min(len(got), batcher.max_new)
+                    toks[i, :n] = got[:n]
+                    lengths[i] = n
+            outs.append((_nd.array(toks, dtype="int32"),
+                         _nd.array(lengths, dtype="int32")))
         return outs
 
     # ------------------------------------------------------------- evaluate

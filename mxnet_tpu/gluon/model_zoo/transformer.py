@@ -20,6 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...base import MXNetError
 from ...ndarray.ndarray import NDArray
 from ..block import HybridBlock
 from ..nn import (
@@ -119,6 +120,20 @@ class TransformerDecoderLayer(HybridBlock):
         x = x + self.drop(c)
         y = x + self.ffn(self.ln3(x))
         return y, (k_c, v_c)
+
+    def step_paged(self, x, k_pool, v_pool, page_table, pos, active,
+                   cross_kv, mem_valid_length=None):
+        """``step`` over the paged KV pool: per-row ``pos`` (B,) cache
+        lengths instead of one shared scalar offset — the continuous-
+        batching contract where every slot sits at its own depth."""
+        a, k_pool, v_pool = self.self_attn.paged_step(
+            self.ln1(x), k_pool, v_pool, page_table, pos, active)
+        x = x + self.drop(a)
+        c = self.cross_attn.attend(self.ln2(x), cross_kv[0], cross_kv[1],
+                                   valid_length=mem_valid_length)
+        x = x + self.drop(c)
+        y = x + self.ffn(self.ln3(x))
+        return y, k_pool, v_pool
 
 
 class TransformerEncoder(HybridBlock):
@@ -239,19 +254,13 @@ class TransformerModel(HybridBlock):
         pytree — per-layer ``(max_len, B, H, D)`` self-attention cache
         pairs (prefix written at rows ``[0, Lp)``), static cross-attention
         memory projections, and the source mask."""
-        from ... import ndarray as F
-
-        memory = self.encode(src_ids, src_valid_length)
-        x = self._embed(F, self.tgt_embed, tgt_prefix)
-        B = x.shape[0]
-        vl_raw = None if src_valid_length is None else (
-            src_valid_length.data if isinstance(src_valid_length, NDArray)
-            else jnp.asarray(src_valid_length))
+        logits, self_parts, cross_parts, vl_raw = self.prefill_parts(
+            src_ids, tgt_prefix, src_valid_length)
+        B = tgt_prefix.shape[0]
         self_kv, cross_kv = [], []
         for i in range(self.decoder._n):
             layer = getattr(self.decoder, f"layer{i}")
-            x, (k_s, v_s), (k_m, v_m) = layer.prefill(
-                x, memory, mem_valid_length=src_valid_length)
+            k_s, v_s = self_parts[i]
             kc, vc = layer.self_attn.init_cache(
                 B, max_len, cache_dtype or k_s.dtype)
             zero = (0, 0, 0, 0)
@@ -260,12 +269,36 @@ class TransformerModel(HybridBlock):
             vc = jax.lax.dynamic_update_slice(vc, jnp.swapaxes(v_s, 0, 1),
                                               zero)
             self_kv.append((kc, vc))
-            cross_kv.append((k_m, v_m))
-        out = self.decoder.ln(x)
-        logits = self._logits(F, out[:, -1:, :])[:, 0]
+            cross_kv.append(cross_parts[i])
         state = {"self_kv": tuple(self_kv), "cross_kv": tuple(cross_kv),
                  "mem_vl": vl_raw}
         return logits, state
+
+    def prefill_parts(self, src_ids, tgt_prefix, src_valid_length=None):
+        """The prefill compute WITHOUT a cache layout: encode the source,
+        run the target prefix, and return the raw per-layer pieces —
+        ``(last_logits, [(k_s, v_s)], [(k_m, v_m)], mem_vl)`` with the
+        prefix K/V as ``(B, Lp, H, D)`` arrays. ``prefill`` packs them
+        into dense ``(max_len, B, H, D)`` caches; the paged engine
+        scatters them into pool pages instead — both consume the exact
+        same forward, so the two layouts start from identical state."""
+        from ... import ndarray as F
+
+        memory = self.encode(src_ids, src_valid_length)
+        x = self._embed(F, self.tgt_embed, tgt_prefix)
+        vl_raw = None if src_valid_length is None else (
+            src_valid_length.data if isinstance(src_valid_length, NDArray)
+            else jnp.asarray(src_valid_length))
+        self_parts, cross_parts = [], []
+        for i in range(self.decoder._n):
+            layer = getattr(self.decoder, f"layer{i}")
+            x, (k_s, v_s), (k_m, v_m) = layer.prefill(
+                x, memory, mem_valid_length=src_valid_length)
+            self_parts.append((k_s, v_s))
+            cross_parts.append((k_m, v_m))
+        out = self.decoder.ln(x)
+        logits = self._logits(F, out[:, -1:, :])[:, 0]
+        return logits, self_parts, cross_parts, vl_raw
 
     def decode_step(self, tokens, pos, state):
         """One O(1) incremental decode step: place ``tokens`` (B,) int32
@@ -291,15 +324,130 @@ class TransformerModel(HybridBlock):
                         "cross_kv": state["cross_kv"], "mem_vl": mem_vl}
 
     def _embed_step(self, tokens, pos):
-        """Single-position target embedding (token + absolute position)."""
+        """Single-position target embedding (token + absolute position).
+        ``pos`` is a scalar (every row at the same depth — the dense
+        decode loop) or a per-row (B,) vector (paged continuous batching,
+        where each slot sits at its own depth)."""
         tok = tokens.data if isinstance(tokens, NDArray) else \
             jnp.asarray(tokens)
         B = tok.shape[0]
         ids = NDArray(tok.reshape(B, 1).astype(jnp.int32))
         pos_ids = NDArray(jnp.broadcast_to(
-            jnp.asarray(pos, jnp.int32).reshape(1, 1), (B, 1)))
+            jnp.asarray(pos, jnp.int32).reshape(-1, 1), (B, 1)))
         return self.drop(self.tgt_embed(ids) * (self._units ** 0.5)
                          + self.pos_embed(pos_ids))
+
+    # -------------------------------------------------------- paged decode
+    # The paged protocol (continuous batching, ISSUE 8): K/V live in shared
+    # per-layer (num_pages, page_size, H, D) pools with per-slot page
+    # tables; cross-attention memory sits in per-slot (slots, mem_len, H,
+    # D) buffers written once at admission. The batch dimension is the
+    # SLOT menu — static shape, dynamic occupancy.
+
+    def init_paged_state(self, slots, num_pages, page_size, mem_len,
+                         dtype=None):
+        """Allocate the paged decode state: per-decoder-layer K/V pools,
+        per-slot cross-attention memory buffers, and the per-slot source
+        valid lengths. ``state['page_tables']`` starts all-trash (page 0);
+        the serving-side ``PagePool`` owns the real table."""
+        k_pools, v_pools, cross_k, cross_v = [], [], [], []
+        for i in range(self.decoder._n):
+            layer = getattr(self.decoder, f"layer{i}")
+            kp, vp = layer.self_attn.init_page_pool(num_pages, page_size,
+                                                    dtype)
+            k_pools.append(kp)
+            v_pools.append(vp)
+            H = layer.cross_attn._num_heads
+            D = layer.cross_attn._head_dim
+            dt = dtype if dtype is not None \
+                else layer.cross_attn.out_proj.weight.dtype
+            z = jnp.zeros((int(slots), int(mem_len), H, D), jnp.dtype(dt))
+            cross_k.append(z)
+            cross_v.append(z)
+        return {
+            "k_pools": tuple(k_pools), "v_pools": tuple(v_pools),
+            "cross_k": tuple(cross_k), "cross_v": tuple(cross_v),
+            "mem_vl": jnp.zeros((int(slots),), jnp.int32),
+        }
+
+    def prefill_paged(self, src_ids, tgt_prime, src_valid_length, state,
+                      slot_ids, first_pages, active):
+        """Admission prefill INTO pages: run the identical prefill forward
+        (``prefill_parts``) over a padded admission batch, then scatter
+        row ``r``'s prefix K/V into page ``first_pages[r]`` and its memory
+        projections into slot ``slot_ids[r]``'s cross buffers.
+
+        Rows with ``active[r]`` False are padding: their page writes land
+        in the trash page 0 and their slot writes carry an out-of-bounds
+        ``slot_ids[r]`` (= slots), which jax scatter semantics DROP — so
+        one fixed ``(slots, bucket)`` admission shape serves any number of
+        admitted requests without touching live slots. Returns
+        ``(last_logits, new_state)``; the single-column prime (BOS) lands
+        at logical position 0, so the admitted row starts with cache
+        length 1."""
+        if tgt_prime.shape[1] != 1:
+            raise MXNetError(
+                "prefill_paged primes with a single BOS column; explicit "
+                "prefixes decode through the dense engine path")
+        logits, self_parts, cross_parts, vl_raw = self.prefill_parts(
+            src_ids, tgt_prime, src_valid_length)
+        first_pages = jnp.where(active, jnp.asarray(first_pages, jnp.int32),
+                                0)
+        mem_len = state["cross_k"][0].shape[1]
+        k_pools, v_pools, cross_k, cross_v = [], [], [], []
+        for i in range(self.decoder._n):
+            k_s, v_s = self_parts[i]
+            kp = state["k_pools"][i].at[first_pages, 0].set(
+                k_s[:, 0].astype(state["k_pools"][i].dtype))
+            vp = state["v_pools"][i].at[first_pages, 0].set(
+                v_s[:, 0].astype(state["v_pools"][i].dtype))
+            k_pools.append(kp)
+            v_pools.append(vp)
+            k_m, v_m = cross_parts[i]
+            pad = mem_len - k_m.shape[1]
+            if pad:
+                widths = ((0, 0), (0, pad), (0, 0), (0, 0))
+                k_m = jnp.pad(k_m, widths)
+                v_m = jnp.pad(v_m, widths)
+            dt = state["cross_k"][i].dtype
+            cross_k.append(state["cross_k"][i].at[slot_ids].set(
+                k_m.astype(dt)))
+            cross_v.append(state["cross_v"][i].at[slot_ids].set(
+                v_m.astype(dt)))
+        vl = vl_raw if vl_raw is not None else jnp.full(
+            (src_ids.shape[0],), src_ids.shape[1], jnp.int32)
+        mem_vl = state["mem_vl"].at[slot_ids].set(vl.astype(jnp.int32))
+        new_state = {"k_pools": tuple(k_pools), "v_pools": tuple(v_pools),
+                     "cross_k": tuple(cross_k), "cross_v": tuple(cross_v),
+                     "mem_vl": mem_vl}
+        return logits, new_state
+
+    def decode_step_paged(self, tokens, pos, state, page_tables, active):
+        """One O(1) paged decode step over the SLOT batch: ``tokens``
+        (slots,) int32 at per-row absolute positions ``pos`` (slots,),
+        gathered/scattered through ``page_tables`` (slots, P). Rows with
+        ``active`` False write to the trash page and their logits are
+        garbage (the scheduler discards them). Returns ``(logits,
+        new_state)`` with the updated pools."""
+        from ... import ndarray as F
+
+        x = self._embed_step(tokens, pos)
+        mem_vl_nd = NDArray(state["mem_vl"])
+        k_pools, v_pools = [], []
+        for i in range(self.decoder._n):
+            layer = getattr(self.decoder, f"layer{i}")
+            x, kp, vp = layer.step_paged(
+                x, state["k_pools"][i], state["v_pools"][i], page_tables,
+                pos, active, (state["cross_k"][i], state["cross_v"][i]),
+                mem_valid_length=mem_vl_nd)
+            k_pools.append(kp)
+            v_pools.append(vp)
+        out = self.decoder.ln(x)
+        logits = self._logits(F, out)[:, 0]
+        new_state = dict(state)
+        new_state["k_pools"] = tuple(k_pools)
+        new_state["v_pools"] = tuple(v_pools)
+        return logits, new_state
 
     def generate(self, src_ids, src_valid_length=None, max_new_tokens=32,
                  **kwargs):
@@ -307,8 +455,15 @@ class TransformerModel(HybridBlock):
         ``parallel.infer.InferStep``. Engine kwargs (``amp``, ``max_len``,
         ``bos_id``/``eos_id``/``pad_id``) configure the cached engine;
         the rest (``method``, ``top_k``, ``temperature``, ``seed``) pass
-        through to ``InferStep.generate``. Returns ``(tokens, lengths)``
-        NDArrays."""
+        through.
+
+        Greedy calls route through a cached ``serving.ContinuousBatcher``
+        (iteration-level scheduling over the paged KV pool — rows that hit
+        EOS free their slot and pages immediately) unless
+        ``MXTPU_BATCHER=fixed`` (the PR-5 fixed-dispatch ``decode_n``
+        path). Sampling with an explicit ``seed`` keeps the direct path:
+        its key schedule is per-dispatch and only reproducible there.
+        Returns ``(tokens, lengths)`` NDArrays either way."""
         from ...parallel.infer import InferStep
 
         eng_keys = ("amp", "max_len", "bos_id", "eos_id", "pad_id")
@@ -320,9 +475,75 @@ class TransformerModel(HybridBlock):
             object.__setattr__(self, "_infer_steps", steps)
         if cache_key not in steps:
             steps[cache_key] = InferStep(self, **eng_kw)
-        return steps[cache_key].generate(
+        engine = steps[cache_key]
+        if self._use_batcher_path(engine, kwargs):
+            return self._generate_batched(engine, cache_key, src_ids,
+                                          src_valid_length,
+                                          max_new_tokens, **kwargs)
+        return engine.generate(
             src_ids, src_valid_length, max_new_tokens=max_new_tokens,
             **kwargs)
+
+    @staticmethod
+    def _use_batcher_path(engine, kwargs) -> bool:
+        from ...serving.batcher import batcher_kind
+
+        if batcher_kind() in ("fixed", "off", "direct"):
+            return False
+        if kwargs.get("method", "greedy") != "greedy" or \
+                kwargs.get("seed") is not None:
+            return False  # per-dispatch key schedule: direct path only
+        return getattr(engine, "supports_paged", False)
+
+    def _generate_batched(self, engine, cache_key, src_ids,
+                          src_valid_length, max_new_tokens, **kwargs):
+        """One synchronous generate() call as N serving requests through a
+        cached ContinuousBatcher: submit every row, gather the trimmed
+        token lists back into the ``decode_n``-shaped ``(tokens (B,
+        max_new), lengths (B,))`` pair."""
+        import numpy as _np
+
+        from ... import ndarray as _nd
+        from ...serving.batcher import ContinuousBatcher
+
+        src = src_ids.asnumpy() if hasattr(src_ids, "asnumpy") \
+            else _np.asarray(src_ids)
+        src = src.astype(_np.int32)
+        B, L = src.shape
+        if src_valid_length is None:
+            vl = _np.full((B,), L, _np.int32)
+        else:
+            vl = (src_valid_length.asnumpy()
+                  if hasattr(src_valid_length, "asnumpy")
+                  else _np.asarray(src_valid_length)).astype(_np.int32)
+        max_new = int(max_new_tokens)
+        batchers = getattr(self, "_batchers", None)
+        if batchers is None:
+            batchers = {}
+            object.__setattr__(self, "_batchers", batchers)
+        bk = (cache_key, B, L)
+        bat = batchers.get(bk)
+        if bat is None or bat.max_new < max_new:
+            if bat is not None:
+                bat.stop()
+            bat = ContinuousBatcher(
+                engine, bucket_keys=(L,), slots=min(B, 8),
+                max_new_tokens=max(max_new, 8),
+                sampling={k: v for k, v in kwargs.items()
+                          if k in ("method", "top_k", "temperature")},
+                name="generate")
+            batchers[bk] = bat
+        futs = [bat.submit(src[i, :vl[i]] if vl[i] else src[i, :1],
+                           max_new_tokens=max_new) for i in range(B)]
+        toks = _np.full((B, max_new), bat._pad, _np.int32)
+        lengths = _np.zeros((B,), _np.int32)
+        for i, f in enumerate(futs):
+            got = f.result(timeout=600)
+            n = min(len(got), max_new)
+            toks[i, :n] = got[:n]
+            lengths[i] = n
+        return _nd.array(toks, dtype="int32"), \
+            _nd.array(lengths, dtype="int32")
 
 
 def transformer_base(**kwargs):

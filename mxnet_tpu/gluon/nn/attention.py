@@ -316,3 +316,76 @@ class MultiHeadAttention(HybridBlock):
                  self._head_dim)
         z = jnp.zeros(shape, jnp.dtype(dtype))
         return z, z
+
+    # ------------------------------------------------------------ paged mode
+    # Paged KV cache (Kwon et al., PagedAttention, SOSP 2023): instead of a
+    # dense (max_len, B, H, D) slab per dispatch, K/V live in a shared
+    # (num_pages, page_size, H, D) pool; each batch row owns a PAGE TABLE
+    # row mapping its logical token positions to pool pages. Reads gather
+    # through the table, writes scatter through it — so a request holds
+    # only ceil(len/page_size) pages, freed the moment it retires. Page 0
+    # is reserved as the TRASH page: inactive/finished rows write there and
+    # padded table entries point at it, keeping every dispatch shape-stable
+    # with no masking branches. serving.pages.PagePool owns the free list.
+
+    def init_page_pool(self, num_pages, page_size, dtype=None):
+        """Zeroed ``(num_pages, page_size, H, D)`` K/V pool pair shared by
+        every request decoding through this layer. ``dtype`` defaults to
+        the layer's parameter dtype (AMP engines get compute-dtype pools).
+        """
+        if dtype is None:
+            dtype = self.out_proj.weight.dtype
+        shape = (int(num_pages), int(page_size), self._num_heads,
+                 self._head_dim)
+        z = jnp.zeros(shape, jnp.dtype(dtype))
+        return z, z
+
+    def paged_step(self, query, k_pool, v_pool, page_table, pos, active):
+        """One incremental self-attention step through a paged KV cache.
+
+        ``query`` (B, 1, units) is the current token's hidden state;
+        ``k_pool``/``v_pool`` are the shared ``(num_pages, page_size, H,
+        D)`` pools; ``page_table`` (B, P) int32 maps row ``b``'s logical
+        position ``p`` to pool page ``page_table[b, p // page_size]``;
+        ``pos`` (B,) int32 is each row's cache length (= this token's
+        absolute position); ``active`` (B,) bool masks live rows — rows
+        that finished (or hold no request) write to the trash page 0, so
+        their garbage never lands in another request's pages.
+
+        The new token's K/V scatter to ``(page, pos % page_size)`` and the
+        query attends causally over the GATHERED ``(B, P*page_size, H, D)``
+        view with ``q_offset=pos`` — identical masked-softmax math to the
+        dense ``step`` path, so at equal logical capacity the two are
+        bit-identical (asserted in tests/test_paged.py).
+        Returns ``(out, k_pool, v_pool)`` with the updated pools."""
+        from ... import ndarray as F
+        from ...ndarray.ndarray import NDArray
+
+        if not self._self_attention:
+            raise MXNetError("paged_step() updates a self-attention cache; "
+                             "cross-attention uses attend()")
+        qkv = self.qkv_proj(query)
+        B = qkv.shape[0]
+        qkv = qkv.reshape(B, 1, self._num_heads, 3 * self._head_dim)
+        d = self._head_dim
+        q = qkv[:, :, :, 0 * d:1 * d]
+        k_t = qkv[:, :, :, 1 * d:2 * d].data[:, 0]  # (B, H, D)
+        v_t = qkv[:, :, :, 2 * d:3 * d].data[:, 0]
+        pos = jnp.asarray(pos, jnp.int32)
+        page_size = k_pool.shape[1]
+        rows = jnp.arange(B)
+        # inactive rows resolve to (trash page, offset 0); pos // page_size
+        # is in-bounds for active rows by the PagePool.ensure() contract
+        slot = jnp.where(active, pos // page_size, 0)
+        page = jnp.where(active, page_table[rows, slot], 0)
+        off = jnp.where(active, pos % page_size, 0)
+        k_pool = k_pool.at[page, off].set(k_t)
+        v_pool = v_pool.at[page, off].set(v_t)
+        # gather the logical (B, P*page_size, H, D) view through the table
+        P = page_table.shape[1]
+        k = k_pool[page_table].reshape(B, P * page_size, self._num_heads, d)
+        v = v_pool[page_table].reshape(B, P * page_size, self._num_heads, d)
+        out = F.flash_attention(
+            q, NDArray(k), NDArray(v), None, causal=self._causal,
+            sm_scale=self._sm_scale(), layout="BSHD", q_offset=pos)
+        return self._finish(F, out), k_pool, v_pool

@@ -186,6 +186,7 @@ class InferStep:
         self._fixed_key = jax.random.PRNGKey(0)
         self._prefill_fns = {}  # max_len is closed over; keyed by it
         self._decode_fns = {}   # (max_new, method, top_k) -> jitted fn
+        self._paged_fns = {}    # paged prefill/decode-iter programs
         self.compile_guard = _cc.RecompileGuard(
             f"InferStep({type(net).__name__})")
         _tel.set_info(amp_dtype=self._amp, infer_engine=type(net).__name__)
@@ -194,6 +195,15 @@ class InferStep:
     def supports_decode(self) -> bool:
         return hasattr(self._net, "prefill") and \
             hasattr(self._net, "decode_step")
+
+    @property
+    def supports_paged(self) -> bool:
+        """Whether the net speaks the PAGED protocol (``prefill_paged`` /
+        ``decode_step_paged`` / ``init_paged_state``) — the continuous-
+        batching engine path (``serving.ContinuousBatcher``)."""
+        return hasattr(self._net, "prefill_paged") and \
+            hasattr(self._net, "decode_step_paged") and \
+            hasattr(self._net, "init_paged_state")
 
     @property
     def weights_version(self) -> str:
@@ -421,6 +431,157 @@ class InferStep:
         toks, lengths = decode_fn(vals, state, logits,
                                   jnp.int32(prime.shape[1]), key, temp)
         return NDArray(toks), NDArray(lengths)
+
+    # ---------------------------------------------------------- paged decode
+    # Continuous batching (ISSUE 8): decode runs as ONE dispatch per
+    # ITERATION over a shared paged KV pool instead of one while_loop per
+    # request batch. Between iterations the scheduler (serving.
+    # ContinuousBatcher) retires EOS rows, frees their pages and admits
+    # queued requests into the vacated slots — the dispatch shapes (slot
+    # count, page-table width, pool size) never change, so the whole
+    # serving loop compiles exactly twice per bucket menu entry (one
+    # admission prefill + one decode-iteration program) and never again.
+
+    def init_paged_state(self, slots, num_pages, page_size, mem_len):
+        """Allocate the device-side paged decode state (per-layer pools +
+        per-slot cross-attention buffers) in the engine's cache dtype.
+        ``num_pages`` counts ALLOCATABLE pages; one extra trash page (id
+        0) is added, matching ``serving.pages.PagePool`` ids."""
+        if not self.supports_paged:
+            raise MXNetError(
+                f"{type(self._net).__name__} does not implement the paged "
+                "protocol (prefill_paged/decode_step_paged)")
+        return self._net.init_paged_state(
+            int(slots), int(num_pages) + 1, int(page_size), int(mem_len),
+            dtype=self._cache_dtype)
+
+    def _get_paged_prefill_fn(self, method, top_k):
+        cfg = ("paged_prefill", method, top_k)
+        fn = self._paged_fns.get(cfg)
+        if fn is not None:
+            return fn
+        net, bos = self._net, self._bos
+
+        def prefill(values, state, src, vl, slot_ids, first_pages, active,
+                    key, temperature):
+            B = src.shape[0]
+            prime = jnp.full((B, 1), bos, jnp.int32)
+            with self._net_scope(values, key):
+                logits, new_state = net.prefill_paged(
+                    NDArray(src), NDArray(prime), NDArray(vl), state,
+                    slot_ids, first_pages, active)
+            logits = logits.data if isinstance(logits, NDArray) else logits
+            key, sub = jax.random.split(key)
+            tok0 = _sample_tokens(logits.astype(jnp.float32), sub, method,
+                                  top_k, temperature)
+            return tok0, new_state
+
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        fn = jax.jit(prefill, donate_argnums=donate)
+        self._paged_fns[cfg] = fn
+        return fn
+
+    def _get_decode_iter_fn(self, steps, method, top_k):
+        cfg = ("decode_iter", steps, method, top_k)
+        fn = self._paged_fns.get(cfg)
+        if fn is not None:
+            return fn
+        net, eos, pad = self._net, self._eos, self._pad
+
+        def decode(values, state, page_tables, tokens, lengths, active,
+                   key, temperature):
+            B = tokens.shape[0]
+            buf = jnp.full((B, steps), pad, jnp.int32)
+            fin0 = jnp.logical_not(active)
+
+            def body(j, c):
+                tok, fin, st, k, bf = c
+                live = jnp.logical_not(fin)
+                with self._net_scope(values, jax.random.PRNGKey(0)):
+                    logits, st = net.decode_step_paged(
+                        NDArray(tok), lengths + j, st, page_tables, live)
+                logits = logits.data if isinstance(logits, NDArray) \
+                    else logits
+                k, sk = jax.random.split(k)
+                nxt = _sample_tokens(logits.astype(jnp.float32), sk,
+                                     method, top_k, temperature)
+                nxt = jnp.where(fin, jnp.int32(pad), nxt)
+                bf = jax.lax.dynamic_update_slice(
+                    bf, nxt[:, None], (0, j))
+                fin = jnp.logical_or(fin, nxt == eos)
+                return nxt, fin, st, k, bf
+
+            _, _, state, _, buf = jax.lax.fori_loop(
+                0, steps, body, (tokens, fin0, state, key, buf))
+            return buf, state
+
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        fn = jax.jit(decode, donate_argnums=donate)
+        self._paged_fns[cfg] = fn
+        return fn
+
+    @staticmethod
+    def _paged_cfg(method, top_k, seed, steps=1):
+        """Host-side config normalization (kept out of the linted paged
+        dispatches — Python-value coercions, never device reads)."""
+        return str(method), int(top_k), 0 if seed is None else int(seed), \
+            max(int(steps), 1)
+
+    def prefill_paged(self, state, src, src_valid_length, slot_ids,
+                      first_pages, active, method="greedy", top_k=0,
+                      temperature=1.0, seed=0):
+        """One admission dispatch: prefill the (padded) admission batch
+        INTO pool pages/slot buffers and sample each admitted row's first
+        token. Pure staging + dispatch, sync-free by lint
+        (``tools/check_no_sync_in_step.py``) — the scheduler reads the
+        returned tokens at its designated sync point. Returns
+        ``(tok0 (slots,) NDArray, new_state)``."""
+        src = jnp.asarray(src, jnp.int32)
+        vl = jnp.asarray(src_valid_length, jnp.int32)
+        slot_ids = jnp.asarray(slot_ids, jnp.int32)
+        first_pages = jnp.asarray(first_pages, jnp.int32)
+        active = jnp.asarray(active, jnp.bool_)
+        method, top_k, seed, _ = self._paged_cfg(method, top_k, seed)
+        cfg = (method, top_k)
+        sig = ("paged_prefill", cfg, (src.shape, src.dtype.name),
+               state["k_pools"][0].shape, state["cross_k"][0].shape)
+        self.compile_guard.observe(
+            sig, lambda: f"paged_prefill{cfg} " + _cc.aval_summary((src,)))
+        fn = self._get_paged_prefill_fn(*cfg)
+        vals = self._values  # one coherent weight snapshot per dispatch
+        tok0, new_state = fn(vals, state, src, vl, slot_ids, first_pages,
+                             active, jax.random.PRNGKey(seed),
+                             jnp.float32(temperature))
+        return NDArray(tok0), new_state
+
+    def decode_iter(self, state, page_tables, tokens, lengths, active,
+                    steps=1, method="greedy", top_k=0, temperature=1.0,
+                    seed=0):
+        """One decode ITERATION over the slot batch: ``steps`` incremental
+        tokens per live row in a single jitted dispatch, K/V read and
+        written through ``page_tables``. The big pool state is the
+        donated carry; tokens/lengths/active are small per-dispatch host
+        operands. Sync-free by lint — the scheduler's collect phase is
+        the sync point. Returns ``(tok_block (slots, steps) NDArray,
+        new_state)``."""
+        page_tables = jnp.asarray(page_tables, jnp.int32)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        active = jnp.asarray(active, jnp.bool_)
+        method, top_k, seed, steps = self._paged_cfg(method, top_k, seed,
+                                                     steps)
+        cfg = (steps, method, top_k)
+        sig = ("decode_iter", cfg, (page_tables.shape, tokens.shape),
+               state["k_pools"][0].shape, state["cross_k"][0].shape)
+        self.compile_guard.observe(
+            sig, lambda: f"decode_iter{cfg} "
+            + _cc.aval_summary((page_tables, tokens)))
+        fn = self._get_decode_iter_fn(steps, method, top_k)
+        vals = self._values
+        buf, new_state = fn(vals, state, page_tables, tokens, lengths,
+                            active, jax.random.PRNGKey(seed),
+                            jnp.float32(temperature))
+        return NDArray(buf), new_state
 
     def generate(self, src, src_valid_length=None, max_new_tokens=32,
                  **kwargs):

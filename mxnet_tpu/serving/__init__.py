@@ -6,12 +6,20 @@ prompts into tokens at O(1)/token; this package turns *concurrent
 requests* into those batches and keeps doing so across weight updates
 and replica failures:
 
-- ``DynamicBatcher`` admits requests into fixed ``(batch, bucket)``
-  slots — pad-to-bucket prompts, timeout-or-full dispatch, per-request
-  future resolution, per-request deadlines — so the engine only ever
-  sees the warmed shape menu and the steady-state loop never compiles
-  (Yu et al., Orca, OSDI 2022: between decode dispatches is the safe
-  point for everything below).
+- ``ContinuousBatcher`` (the ``MXTPU_BATCHER=continuous`` default) runs
+  Orca-style ITERATION-LEVEL scheduling over a paged KV cache
+  (``serving.pages`` + the paged attention mode): between decode
+  iterations it retires EOS/deadline rows, frees their pages, and
+  admits queued requests into the vacated slots via a jitted
+  prefill-into-pages dispatch — occupancy is dynamic, shapes are
+  static, tokens stream per iteration, and admission control rejects
+  with ``Backpressure`` when the pool can't absorb more work.
+- ``DynamicBatcher`` (``MXTPU_BATCHER=fixed``) admits requests into
+  fixed ``(batch, bucket)`` slots — pad-to-bucket prompts,
+  timeout-or-full dispatch, per-request future resolution, per-request
+  deadlines — the strict one-weight-version-per-request fallback (Yu
+  et al., Orca, OSDI 2022: between decode dispatches is the safe point
+  for everything below).
 - ``CheckpointWatcher`` hot-swaps newly committed checkpoints into live
   engines between dispatches (double-buffered device params,
   version-tagged responses, zero dropped requests).
@@ -22,7 +30,11 @@ and replica failures:
 - ``faults`` plants deterministic failure points in all of the above
   (``MXTPU_FAULT_*``), so the failure paths are testable in tier-1.
 
-Env knobs: ``MXTPU_BATCHER_SLOTS`` (batch slots per dispatch, default 8),
+Env knobs: ``MXTPU_BATCHER`` (scheduler kind, default ``continuous``),
+``MXTPU_PAGE_SIZE``/``MXTPU_PAGES`` (KV pool geometry),
+``MXTPU_ITER_TOKENS`` (decode tokens per scheduler iteration),
+``MXTPU_ADMIT_*`` (backpressure thresholds — see ``serving.pages``),
+``MXTPU_BATCHER_SLOTS`` (batch slots per dispatch, default 8),
 ``MXTPU_BATCHER_TIMEOUT_MS`` (admission window, default 10),
 ``MXTPU_DECODE_MAX_LEN`` (engine cache capacity — see ``parallel.infer``),
 ``MXTPU_SWAP_POLL_S`` (checkpoint poll period), ``MXTPU_RETRY_MAX``
@@ -32,13 +44,18 @@ backoff base, shared with ``tools/launch.py``), ``MXTPU_FAULT_*``
 """
 
 from . import faults
-from .batcher import DeadlineExceeded, DynamicBatcher, GenerationResult, \
-    batcher_slots, batcher_timeout_ms
+from . import pages
+from .batcher import Backpressure, ContinuousBatcher, DeadlineExceeded, \
+    DynamicBatcher, GenerationResult, batcher_kind, batcher_slots, \
+    batcher_timeout_ms, iter_tokens_default, make_batcher
+from .pages import PagePool
 from .router import Replica, ReplicaUnavailable, Router, restart_backoff_s, \
     retry_max
 from .watcher import CheckpointWatcher, swap_poll_s
 
-__all__ = ["DynamicBatcher", "GenerationResult", "DeadlineExceeded",
+__all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
+           "DeadlineExceeded", "Backpressure", "PagePool", "pages",
            "Router", "Replica", "ReplicaUnavailable", "CheckpointWatcher",
-           "faults", "batcher_slots", "batcher_timeout_ms", "swap_poll_s",
+           "faults", "batcher_slots", "batcher_timeout_ms", "batcher_kind",
+           "iter_tokens_default", "make_batcher", "swap_poll_s",
            "retry_max", "restart_backoff_s"]

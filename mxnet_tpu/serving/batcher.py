@@ -1,31 +1,37 @@
-"""Dynamic batcher: concurrent generation requests -> fixed-shape batches.
+"""Serving batchers: concurrent generation requests -> fixed-shape
+engine dispatches.
 
-Serving traffic arrives one prompt at a time; the TPU wants full batches
-of warmed shapes. ``DynamicBatcher`` bridges them:
+Two schedulers share one admission/lifecycle spine (``_BatcherBase``):
 
-- **Admission**: ``submit()`` enqueues a request and returns a
-  ``GenerationResult`` future. A background dispatcher collects up to
-  ``slots`` requests, waiting at most ``timeout_ms`` after the first
-  arrival — the classic timeout-or-full policy (latency bound under
-  trickle load, full batches under pressure).
-- **Fixed (batch, bucket) slots**: every dispatch pads prompts to the
-  smallest bucket-menu boundary that fits the batch and pads the batch
-  itself to exactly ``slots`` rows (empty rows carry ``valid_length=0``,
-  fully masked out of attention) — the engine only ever sees
-  ``len(bucket_keys)`` decode signatures, all warmed by
-  ``InferStep.warmup``, so steady-state serving never compiles.
-- **Per-request detach**: each request resolves independently — its
-  tokens are trimmed at ITS EOS (and its own ``max_new_tokens``) the
-  moment the batch's decode returns, and the slot is free for the next
-  dispatch; a long request never holds another request's result hostage.
+- ``ContinuousBatcher`` (default, ``MXTPU_BATCHER=continuous``) —
+  Orca-style ITERATION-LEVEL scheduling (Yu et al., OSDI 2022) over a
+  PAGED KV cache (Kwon et al., SOSP 2023). The decode batch is a static
+  menu of ``slots``; each iteration dispatches one jitted
+  ``InferStep.decode_iter`` burst, then — between dispatches — retires
+  rows that hit EOS / their ``max_new_tokens`` / their deadline, frees
+  their pages back to the pool, and admits queued requests into the
+  vacated slots through a jitted prefill-into-pages dispatch. Slot count,
+  page-table shape and pool shape never change, so occupancy is dynamic
+  while the program menu stays exactly two entries per prompt bucket.
+  Tokens stream per iteration (``GenerationResult.tokens_iter``), and
+  admission control rejects with ``Backpressure`` when the queue or the
+  free-page watermark says the pool can't absorb more work.
+- ``DynamicBatcher`` (``MXTPU_BATCHER=fixed``) — the PR-5 fallback:
+  timeout-or-full admission into whole-batch ``decode_n`` dispatches; a
+  finished row idles its slot until the batch drains. Kept as the strict
+  per-dispatch-coherent path (one weight version per request) and the
+  baseline the open-loop bench measures against.
 
-Telemetry (``infer/`` family): ``queue_wait_ms`` per request,
-``batch_occupancy`` per dispatch, ``prefill_ms``/``decode_ms_per_token``
-/``tokens_per_sec`` per dispatch, ``requests``/``tokens`` counters.
+Telemetry (``infer/`` family): ``queue_wait_ms``/``ttft_ms`` per request,
+``batch_occupancy``/``pages_in_use``/``page_fragmentation``/
+``admitted_per_iter`` per iteration, ``prefill_ms``/
+``decode_ms_per_token``/``tokens_per_sec`` per dispatch,
+``requests``/``tokens``/``rejected_backpressure``/``preempted`` counters.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import queue
 import threading
@@ -37,14 +43,23 @@ import numpy as _np
 from ..base import MXNetError
 from .. import telemetry as _tel
 from . import faults as _faults
+from . import pages as _pages
 
-__all__ = ["DynamicBatcher", "GenerationResult", "DeadlineExceeded",
-           "batcher_slots", "batcher_timeout_ms"]
+__all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
+           "DeadlineExceeded", "Backpressure", "batcher_slots",
+           "batcher_timeout_ms", "batcher_kind", "iter_tokens_default",
+           "make_batcher"]
 
 
 class DeadlineExceeded(MXNetError):
     """A request's deadline passed while it was still queued (or before
     the router could place it) — it is FAILED, never dispatched late."""
+
+
+class Backpressure(MXNetError):
+    """Admission control rejected the request at submit: the queue or the
+    free-page watermark breached its threshold (``MXTPU_ADMIT_*``).
+    Retriable — the router resubmits to a less-loaded replica."""
 
 
 def batcher_slots(default: int = 8) -> int:
@@ -66,15 +81,60 @@ def batcher_timeout_ms(default: float = 10.0) -> float:
         return default
 
 
+def batcher_kind(default: str = "continuous") -> str:
+    """``MXTPU_BATCHER``: which scheduler fronts the serving engine —
+    ``continuous`` (iteration-level, paged KV; the default) or ``fixed``
+    (the PR-5 whole-batch ``DynamicBatcher``). ``off``/``direct`` makes
+    ``model.generate`` bypass batching entirely (raw ``decode_n``)."""
+    v = os.environ.get("MXTPU_BATCHER", "").strip().lower()
+    return v if v in ("continuous", "fixed", "off", "direct") else default
+
+
+def iter_tokens_default(default: int = 4) -> int:
+    """``MXTPU_ITER_TOKENS``: decode tokens per scheduler iteration
+    (dispatch granularity). 1 = pure per-token Orca scheduling (finest
+    retirement/streaming granularity); larger bursts amortize dispatch
+    overhead at the cost of up to ``iter_tokens - 1`` wasted steps per
+    retiring row."""
+    v = os.environ.get("MXTPU_ITER_TOKENS", "").strip()
+    try:
+        return max(int(v), 1) if v else default
+    except ValueError:
+        return default
+
+
+def make_batcher(engine, bucket_keys, **kwargs):
+    """Build the process-default batcher over ``engine``:
+    ``ContinuousBatcher`` unless ``MXTPU_BATCHER=fixed`` (or the net
+    lacks the paged protocol), then ``DynamicBatcher``. Kwargs the chosen
+    class doesn't take are dropped."""
+    if batcher_kind() != "fixed" and getattr(engine, "supports_paged",
+                                             False):
+        kwargs.pop("timeout_ms", None)
+        return ContinuousBatcher(engine, bucket_keys, **kwargs)
+    for k in ("page_size", "num_pages", "iter_tokens"):
+        kwargs.pop(k, None)
+    return DynamicBatcher(engine, bucket_keys, **kwargs)
+
+
 class GenerationResult:
-    """Future for one submitted request. ``result(timeout)`` blocks until
-    the request's decode finished and returns the generated token list
-    (trimmed at EOS); ``exception()`` surfaces a dispatch failure.
-    ``weights_version`` tags which param set served the request (hot
-    weight swap) and ``replica`` which engine replica ran it (router)."""
+    """Future for one submitted request.
+
+    ``result(timeout)`` blocks until the request finished and returns the
+    full generated token list (trimmed at EOS); ``exception()`` surfaces
+    a failure. ``tokens_iter(timeout)`` STREAMS instead: it yields token
+    chunks as the scheduler emits them (per decode iteration under
+    ``ContinuousBatcher``; one final chunk under ``DynamicBatcher``) and
+    ends when the request resolves. ``weights_version`` tags the param
+    set that served the request (hot weight swap; under continuous
+    batching, the version of its final iteration) and ``replica`` which
+    engine replica ran it (router). ``first_token_at`` is the
+    ``perf_counter`` instant of the first streamed token (TTFT =
+    ``first_token_at - enqueued_at``)."""
 
     __slots__ = ("_event", "_tokens", "_error", "enqueued_at",
-                 "queue_wait_ms", "weights_version", "replica")
+                 "queue_wait_ms", "weights_version", "replica",
+                 "_cond", "_stream", "first_token_at")
 
     def __init__(self):
         self._event = threading.Event()
@@ -84,14 +144,44 @@ class GenerationResult:
         self.queue_wait_ms = None
         self.weights_version = None
         self.replica = None
+        self._cond = threading.Condition()
+        self._stream = []
+        self.first_token_at = None
+
+    def _stream_tokens(self, tokens):
+        """Append newly emitted tokens to the live stream (scheduler
+        thread). First call stamps ``first_token_at`` (TTFT)."""
+        if not tokens:
+            return
+        with self._cond:
+            if self.first_token_at is None:
+                self.first_token_at = time.perf_counter()
+            self._stream.extend(tokens)
+            self._cond.notify_all()
+
+    def _stream_reset(self):
+        """Preemption (pool exhaustion): the request restarts from its
+        prompt, so the stream restarts too. ``result()`` is unaffected —
+        only live ``tokens_iter`` consumers observe the re-emission."""
+        with self._cond:
+            self._stream = []
+            self._cond.notify_all()
 
     def _resolve(self, tokens):
-        self._tokens = tokens
-        self._event.set()
+        with self._cond:
+            self._tokens = tokens
+            if not self._stream and tokens:
+                if self.first_token_at is None:
+                    self.first_token_at = time.perf_counter()
+                self._stream = list(tokens)
+            self._event.set()
+            self._cond.notify_all()
 
     def _fail(self, err):
-        self._error = err
-        self._event.set()
+        with self._cond:
+            self._error = err
+            self._event.set()
+            self._cond.notify_all()
 
     def done(self) -> bool:
         return self._event.is_set()
@@ -106,6 +196,29 @@ class GenerationResult:
             raise self._error
         return self._tokens
 
+    def tokens_iter(self, timeout: Optional[float] = None):
+        """Yield generated-token chunks (lists) as they stream in; ends
+        when the request resolves (raising its error if it failed).
+        ``timeout`` bounds each wait for the NEXT chunk. After a pool
+        preemption the stream restarts from the first token."""
+        i = 0
+        while True:
+            with self._cond:
+                if i > len(self._stream):
+                    i = 0  # stream was reset by a preemption
+                while len(self._stream) <= i and not self._event.is_set():
+                    if not self._cond.wait(timeout):
+                        raise TimeoutError("no token within timeout")
+                chunk = list(self._stream[i:])
+                done = self._event.is_set()
+            if chunk:
+                i += len(chunk)
+                yield chunk
+            if done and i >= len(self._stream):
+                if self._error is not None:
+                    raise self._error
+                return
+
 
 class _Request:
     __slots__ = ("prompt", "max_new", "future", "deadline")
@@ -117,9 +230,203 @@ class _Request:
         self.deadline = deadline  # absolute perf_counter instant or None
 
 
-class DynamicBatcher:
+class _BatcherBase:
+    """Shared admission/lifecycle spine for both schedulers: request
+    validation, queueing, deadline expiry, dispatcher-thread health and
+    teardown. Subclasses implement ``_run_loop`` (the scheduling policy)
+    and dispatching."""
+
+    def __init__(self, engine, bucket_keys: Sequence[int],
+                 slots: Optional[int] = None,
+                 max_new_tokens: int = 32, sampling: Optional[dict] = None,
+                 pad_id: Optional[int] = None, start: bool = True,
+                 name: Optional[str] = None, watchdog=None):
+        if not getattr(engine, "supports_decode", False):
+            raise MXNetError(
+                f"{type(self).__name__} needs a decode-capable InferStep "
+                "(net with prefill/decode_step)")
+        self._engine = engine
+        self.bucket_keys = sorted(int(k) for k in bucket_keys)
+        if not self.bucket_keys:
+            raise MXNetError("bucket_keys must be non-empty")
+        self.slots = int(slots) if slots is not None else batcher_slots()
+        self.max_new = int(max_new_tokens)
+        self._sampling = dict(sampling or {})
+        self._pad = int(pad_id) if pad_id is not None else engine._pad
+        self.name = name
+        self._watchdog = watchdog
+        self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._stop = threading.Event()
+        self._thread = None
+        if start:
+            self.start()
+
+    def _label(self) -> str:
+        return f"{type(self).__name__}" + (f" {self.name!r}"
+                                           if self.name else "")
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-batcher", daemon=True)
+        self._thread.start()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        """Stop the dispatcher; with ``drain`` (default) outstanding
+        requests are dispatched first. Anything still queued when the
+        thread is down is FAILED (a stopped batcher must never hold an
+        unresolvable future)."""
+        if drain and self.healthy:
+            deadline = time.perf_counter() + timeout
+            while not self._drained() and time.perf_counter() < deadline:
+                time.sleep(0.005)
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None
+        self.cancel_pending()
+
+    def _drained(self) -> bool:
+        return self._queue.empty()
+
+    @property
+    def healthy(self) -> bool:
+        """True while the dispatcher thread is alive and accepting — the
+        router's per-replica liveness poll. Goes false on ``stop()`` and
+        when the thread died (a crash outside the dispatch try)."""
+        t = self._thread
+        return t is not None and t.is_alive() and not self._stop.is_set()
+
+    def cancel_pending(self, error: Optional[BaseException] = None) -> int:
+        """Drain the queue, failing every undispatched request's future
+        (default error: RuntimeError naming the batcher). The router uses
+        this when evicting an unhealthy replica — the failed futures are
+        its signal to resubmit those requests elsewhere. Returns how many
+        requests were cancelled."""
+        n = 0
+        while True:
+            try:
+                r = self._queue.get_nowait()
+            except queue.Empty:
+                return n
+            r.future._fail(error if error is not None else RuntimeError(
+                f"{self._label()} stopped with this request still queued"))
+            n += 1
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    # ------------------------------------------------------------- requests
+    def _admission_check(self, fut) -> bool:
+        """Subclass hook: return False (after failing ``fut``) to reject
+        the request at submit time (backpressure)."""
+        return True
+
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenerationResult:
+        """Enqueue one prompt (1-D int sequence). Returns a future whose
+        ``result()`` is the generated token list, trimmed at EOS and at
+        the request's ``max_new_tokens`` (<= the batcher's).
+
+        ``deadline_ms`` bounds the request's total latency from NOW: a
+        request still queued (or, under continuous batching, still
+        decoding) when its deadline passes is failed with
+        ``DeadlineExceeded`` instead of being served late.
+
+        Submitting to a stopped (or crashed) batcher fails the future
+        immediately with a RuntimeError — a request must never enqueue
+        behind a dispatcher that will not run again."""
+        prompt = _np.asarray(prompt_ids, dtype=_np.int32).reshape(-1)
+        if prompt.shape[0] > self.bucket_keys[-1]:
+            raise MXNetError(
+                f"prompt length {prompt.shape[0]} exceeds the largest "
+                f"bucket key {self.bucket_keys[-1]}")
+        max_new = self.max_new if max_new_tokens is None \
+            else int(max_new_tokens)
+        if max_new > self.max_new:
+            raise MXNetError(
+                f"request max_new_tokens {max_new} > batcher "
+                f"max_new_tokens {self.max_new}")
+        fut = GenerationResult()
+        if not self.healthy:
+            fut._fail(RuntimeError(
+                f"{self._label()} is not accepting requests (stopped, or "
+                "its dispatcher thread died) — the request would never "
+                "resolve"))
+            return fut
+        if not self._admission_check(fut):
+            return fut
+        deadline = None if deadline_ms is None \
+            else time.perf_counter() + float(deadline_ms) / 1e3
+        self._queue.put(_Request(prompt, max_new, fut, deadline))
+        return fut
+
+    def _expire(self, reqs):
+        """Fail (never dispatch) requests whose deadline passed while
+        they were queued. Runs BEFORE batch assembly, so expired rows
+        don't occupy slots and the occupancy/queue-wait telemetry of the
+        dispatched batch is unaffected."""
+        now = time.perf_counter()
+        live = []
+        for r in reqs:
+            if r.deadline is not None and now > r.deadline:
+                _tel.registry().counter("serve/deadline_exceeded").inc()
+                r.future._fail(DeadlineExceeded(
+                    f"request deadline passed after "
+                    f"{(now - r.future.enqueued_at) * 1e3:.0f} ms in "
+                    "queue — not dispatched"))
+            else:
+                live.append(r)
+        return live
+
+    def _bucket_for(self, max_len):
+        for k in self.bucket_keys:
+            if max_len <= k:
+                return k
+        raise MXNetError(
+            f"prompt length {max_len} > largest bucket key "
+            f"{self.bucket_keys[-1]}")
+
+    # ------------------------------------------------------------ dispatcher
+    def _run(self):
+        try:
+            self._run_loop()
+        except BaseException as e:
+            # the thread is dying (a crash outside the dispatch try, e.g.
+            # the `batcher.thread` fault point): fail whatever is queued
+            # so no future is left unresolvable, then let it die —
+            # `healthy` flips false and the router (if any) takes over
+            self._fail_inflight(RuntimeError(
+                f"{self._label()} dispatcher thread died"))
+            self.cancel_pending(RuntimeError(
+                f"{self._label()} dispatcher thread died"))
+            # injected deaths exit quietly (the crash is the test's
+            # point); real crashes re-raise for the interpreter's
+            # thread-exception hook
+            if not isinstance(e, _faults.FaultInjected):
+                raise
+
+    def _fail_inflight(self, error):
+        """Subclass hook: fail requests the scheduler already pulled off
+        the queue (slots, partial batches) when the thread dies."""
+
+    def _run_loop(self):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+class DynamicBatcher(_BatcherBase):
     """Admit concurrent generation requests into fixed (batch, bucket)
-    engine dispatches.
+    engine dispatches — the PR-5 whole-batch scheduler, kept as the
+    ``MXTPU_BATCHER=fixed`` fallback and the strict one-weight-version-
+    per-request path.
 
     Parameters
     ----------
@@ -150,143 +457,17 @@ class DynamicBatcher:
                  pad_id: Optional[int] = None, warmup: bool = False,
                  start: bool = True, name: Optional[str] = None,
                  watchdog=None):
-        if not getattr(engine, "supports_decode", False):
-            raise MXNetError(
-                "DynamicBatcher needs a decode-capable InferStep "
-                "(net with prefill/decode_step)")
-        self._engine = engine
-        self.bucket_keys = sorted(int(k) for k in bucket_keys)
-        if not self.bucket_keys:
-            raise MXNetError("bucket_keys must be non-empty")
-        self.slots = int(slots) if slots is not None else batcher_slots()
+        super().__init__(engine, bucket_keys, slots=slots,
+                         max_new_tokens=max_new_tokens, sampling=sampling,
+                         pad_id=pad_id, start=False, name=name,
+                         watchdog=watchdog)
         self.timeout_s = (timeout_ms if timeout_ms is not None
                           else batcher_timeout_ms()) / 1e3
-        self.max_new = int(max_new_tokens)
-        self._sampling = dict(sampling or {})
-        self._pad = int(pad_id) if pad_id is not None else engine._pad
-        self.name = name
-        self._watchdog = watchdog
-        self._queue: "queue.Queue[_Request]" = queue.Queue()
-        self._stop = threading.Event()
-        self._thread = None
         if warmup:
             engine.warmup([(self.slots, k) for k in self.bucket_keys],
                           max_new_tokens=self.max_new, **self._sampling)
         if start:
             self.start()
-
-    # ------------------------------------------------------------ lifecycle
-    def start(self):
-        if self._thread is not None and self._thread.is_alive():
-            return
-        self._stop.clear()
-        self._thread = threading.Thread(
-            target=self._run, name="mxtpu-batcher", daemon=True)
-        self._thread.start()
-
-    def stop(self, drain: bool = True, timeout: float = 30.0):
-        """Stop the dispatcher; with ``drain`` (default) outstanding
-        requests are dispatched first. Anything still queued when the
-        thread is down is FAILED (a stopped batcher must never hold an
-        unresolvable future)."""
-        if drain and self.healthy:
-            deadline = time.perf_counter() + timeout
-            while not self._queue.empty() and \
-                    time.perf_counter() < deadline:
-                time.sleep(0.005)
-        self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
-            self._thread = None
-        self.cancel_pending()
-
-    @property
-    def healthy(self) -> bool:
-        """True while the dispatcher thread is alive and accepting — the
-        router's per-replica liveness poll. Goes false on ``stop()`` and
-        when the thread died (a crash outside the dispatch try)."""
-        t = self._thread
-        return t is not None and t.is_alive() and not self._stop.is_set()
-
-    def cancel_pending(self, error: Optional[BaseException] = None) -> int:
-        """Drain the queue, failing every undispatched request's future
-        (default error: RuntimeError naming the batcher). The router uses
-        this when evicting an unhealthy replica — the failed futures are
-        its signal to resubmit those requests elsewhere. Returns how many
-        requests were cancelled."""
-        n = 0
-        while True:
-            try:
-                r = self._queue.get_nowait()
-            except queue.Empty:
-                return n
-            r.future._fail(error if error is not None else RuntimeError(
-                f"DynamicBatcher{f' {self.name!r}' if self.name else ''} "
-                "stopped with this request still queued"))
-            n += 1
-
-    def __enter__(self):
-        self.start()
-        return self
-
-    def __exit__(self, *exc):
-        self.stop()
-        return False
-
-    # ------------------------------------------------------------- requests
-    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> GenerationResult:
-        """Enqueue one prompt (1-D int sequence). Returns a future whose
-        ``result()`` is the generated token list, trimmed at EOS and at
-        the request's ``max_new_tokens`` (<= the batcher's).
-
-        ``deadline_ms`` bounds the request's total latency from NOW: a
-        request still queued when its deadline passes is failed with
-        ``DeadlineExceeded`` instead of being dispatched late.
-
-        Submitting to a stopped (or crashed) batcher fails the future
-        immediately with a RuntimeError — a request must never enqueue
-        behind a dispatcher that will not run again."""
-        prompt = _np.asarray(prompt_ids, dtype=_np.int32).reshape(-1)
-        if prompt.shape[0] > self.bucket_keys[-1]:
-            raise MXNetError(
-                f"prompt length {prompt.shape[0]} exceeds the largest "
-                f"bucket key {self.bucket_keys[-1]}")
-        max_new = self.max_new if max_new_tokens is None \
-            else int(max_new_tokens)
-        if max_new > self.max_new:
-            raise MXNetError(
-                f"request max_new_tokens {max_new} > batcher "
-                f"max_new_tokens {self.max_new}")
-        fut = GenerationResult()
-        if not self.healthy:
-            fut._fail(RuntimeError(
-                f"DynamicBatcher{f' {self.name!r}' if self.name else ''} "
-                "is not accepting requests (stopped, or its dispatcher "
-                "thread died) — the request would never resolve"))
-            return fut
-        deadline = None if deadline_ms is None \
-            else time.perf_counter() + float(deadline_ms) / 1e3
-        self._queue.put(_Request(prompt, max_new, fut, deadline))
-        return fut
-
-    # ------------------------------------------------------------ dispatcher
-    def _run(self):
-        try:
-            self._run_loop()
-        except BaseException as e:
-            # the thread is dying (a crash outside the dispatch try, e.g.
-            # the `batcher.thread` fault point): fail whatever is queued
-            # so no future is left unresolvable, then let it die —
-            # `healthy` flips false and the router (if any) takes over
-            self.cancel_pending(RuntimeError(
-                f"DynamicBatcher{f' {self.name!r}' if self.name else ''} "
-                "dispatcher thread died"))
-            # injected deaths exit quietly (the crash is the test's
-            # point); real crashes re-raise for the interpreter's
-            # thread-exception hook
-            if not isinstance(e, _faults.FaultInjected):
-                raise
 
     def _run_loop(self):
         while not self._stop.is_set():
@@ -318,32 +499,6 @@ class DynamicBatcher:
                     r.future._fail(e)
                 continue
             self._resolve(reqs, out, t0)
-
-    def _expire(self, reqs):
-        """Fail (never dispatch) requests whose deadline passed while
-        they were queued. Runs BEFORE batch assembly, so expired rows
-        don't occupy slots and the occupancy/queue-wait telemetry of the
-        dispatched batch is unaffected."""
-        now = time.perf_counter()
-        live = []
-        for r in reqs:
-            if r.deadline is not None and now > r.deadline:
-                _tel.registry().counter("serve/deadline_exceeded").inc()
-                r.future._fail(DeadlineExceeded(
-                    f"request deadline passed after "
-                    f"{(now - r.future.enqueued_at) * 1e3:.0f} ms in "
-                    "queue — not dispatched"))
-            else:
-                live.append(r)
-        return live
-
-    def _bucket_for(self, max_len):
-        for k in self.bucket_keys:
-            if max_len <= k:
-                return k
-        raise MXNetError(
-            f"prompt length {max_len} > largest bucket key "
-            f"{self.bucket_keys[-1]}")
 
     def _dispatch(self, reqs):
         """Assemble one fixed (slots, bucket) batch and fire the engine.
@@ -388,6 +543,9 @@ class DynamicBatcher:
             r.future.weights_version = version
             r.future.replica = self.name
             r.future._resolve(tokens[i, :n].tolist())
+            if r.future.first_token_at is not None:
+                reg.histogram("infer/ttft_ms").observe(
+                    (r.future.first_token_at - r.future.enqueued_at) * 1e3)
         wd = self._watchdog
         if wd is not None:
             wd.notify_step(seconds=dispatch_ms / 1e3)
@@ -400,3 +558,476 @@ class DynamicBatcher:
                 dispatch_ms / emitted)
             reg.gauge("infer/tokens_per_sec").set(
                 emitted / (dispatch_ms / 1e3))
+
+
+class _Slot:
+    """Host-side record of one OCCUPIED decode slot."""
+
+    __slots__ = ("req", "carry", "length", "emitted", "finished",
+                 "admitted_seq", "version")
+
+    def __init__(self, req, admitted_seq):
+        self.req = req
+        self.carry = None        # last sampled token, not yet KV-cached
+        self.length = 0          # KV entries cached in this slot's pages
+        self.emitted = []        # generated tokens streamed so far
+        self.finished = False
+        self.admitted_seq = admitted_seq
+        self.version = None
+
+
+class ContinuousBatcher(_BatcherBase):
+    """Iteration-level scheduler over a paged KV cache — the tentpole.
+
+    Between every decode iteration the scheduler retires finished rows
+    (EOS, per-request ``max_new_tokens``, deadline), returns their pages
+    to the pool, and admits queued requests into the vacated slots via a
+    jitted prefill-into-pages dispatch — the decode batch stays full
+    under load without a single retrace.
+
+    Parameters
+    ----------
+    engine : paged-protocol ``InferStep`` (``supports_paged``).
+    bucket_keys : ascending prompt-length menu; the LARGEST key is also
+        the static cross-attention memory width every slot carries.
+    slots : decode-batch rows (``MXTPU_BATCHER_SLOTS``).
+    max_new_tokens : per-request generation cap (requests may ask less).
+    page_size / num_pages : KV pool geometry (``MXTPU_PAGE_SIZE`` /
+        ``MXTPU_PAGES``; default pool fully provisions every slot).
+    iter_tokens : decode tokens per iteration (``MXTPU_ITER_TOKENS``);
+        1 = pure Orca-style per-token scheduling.
+    admit_free_pages / admit_max_queue / admit_max_wait_ms : backpressure
+        thresholds (``MXTPU_ADMIT_*``): keep N pages free, bound the
+        queue depth, reject while rolling queue-wait p50 breaches.
+    warmup : compile the admission-prefill program per bucket plus the
+        decode-iteration program at construction (inert rows — the pools
+        only ever see trash-page writes).
+    sampling : ``method``/``top_k``/``temperature`` shared by every
+        iteration. NOTE the key schedule is per-iteration, so sampled
+        runs are reproducible per batcher, not vs ``decode_n``.
+    """
+
+    def __init__(self, engine, bucket_keys: Sequence[int],
+                 slots: Optional[int] = None, max_new_tokens: int = 32,
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 iter_tokens: Optional[int] = None,
+                 sampling: Optional[dict] = None,
+                 pad_id: Optional[int] = None,
+                 admit_free_pages: Optional[int] = None,
+                 admit_max_queue: Optional[int] = None,
+                 admit_max_wait_ms: Optional[float] = None,
+                 warmup: bool = False, start: bool = True,
+                 name: Optional[str] = None, watchdog=None):
+        super().__init__(engine, bucket_keys, slots=slots,
+                         max_new_tokens=max_new_tokens, sampling=sampling,
+                         pad_id=pad_id, start=False, name=name,
+                         watchdog=watchdog)
+        if not getattr(engine, "supports_paged", False):
+            raise MXNetError(
+                "ContinuousBatcher needs a paged-protocol InferStep "
+                "(net with prefill_paged/decode_step_paged); use "
+                "DynamicBatcher (MXTPU_BATCHER=fixed) otherwise")
+        self._sampling.pop("seed", None)  # per-iteration key schedule
+        self.page_size = int(page_size) if page_size is not None \
+            else _pages.page_size_default()
+        self.pages_per_slot = _pages.pages_for(1 + self.max_new,
+                                               self.page_size)
+        self.num_pages = int(num_pages) if num_pages is not None \
+            else _pages.num_pages_default(self.slots, self.pages_per_slot)
+        if self.pages_per_slot > self.num_pages:
+            raise MXNetError(
+                f"one request needs {self.pages_per_slot} pages for "
+                f"max_new_tokens={self.max_new} but the pool has only "
+                f"{self.num_pages} (MXTPU_PAGES / MXTPU_PAGE_SIZE)")
+        self.iter_tokens = int(iter_tokens) if iter_tokens is not None \
+            else iter_tokens_default()
+        self.mem_len = self.bucket_keys[-1]
+        self._admit_free_pages = admit_free_pages \
+            if admit_free_pages is not None else _pages.admit_free_pages()
+        self._admit_max_queue = admit_max_queue \
+            if admit_max_queue is not None else _pages.admit_max_queue()
+        self._admit_max_wait_ms = admit_max_wait_ms \
+            if admit_max_wait_ms is not None else _pages.admit_max_wait_ms()
+        self._recent_waits = collections.deque(maxlen=64)
+        self.pool = _pages.PagePool(self.num_pages, self.page_size,
+                                    self.slots, self.pages_per_slot)
+        self._state = engine.init_paged_state(
+            self.slots, self.num_pages, self.page_size, self.mem_len)
+        self._slots = [None] * self.slots
+        self._pending = collections.deque()
+        self._seq = 0
+        self._iter = 0
+        self.stats = {"iterations": 0, "occupancy_sum": 0.0,
+                      "admitted": 0, "retired": 0, "preempted": 0,
+                      "rejected": 0, "tokens": 0}
+        if warmup:
+            self._warmup()
+        if start:
+            self.start()
+
+    # --------------------------------------------------------------- warmup
+    def _warmup(self):
+        """Compile every program the scheduler can dispatch — one
+        admission prefill per bucket + the decode-iteration program —
+        with fully inert rows (no slot ids, trash pages only), then mark
+        the guard steady."""
+        import jax
+
+        eng = self._engine
+        reg = _tel.registry()
+        before = eng.compile_guard.signatures
+        rows_menu = []
+        rows = 1
+        while rows < self.slots:
+            rows_menu.append(rows)
+            rows *= 2
+        rows_menu.append(self.slots)
+        for bucket in self.bucket_keys:
+            for rows in rows_menu:
+                src = _np.zeros((rows, bucket), _np.int32)
+                vl = _np.full((rows,), bucket, _np.int32)
+                inert = _np.full((rows,), self.slots, _np.int32)  # OOB
+                tok0, self._state = eng.prefill_paged(
+                    self._state, src, vl, inert,
+                    _np.zeros((rows,), _np.int32),
+                    _np.zeros((rows,), bool), **self._sampling)
+                jax.block_until_ready(tok0.data)
+        zeros = _np.zeros((self.slots,), _np.int32)
+        buf, self._state = eng.decode_iter(
+            self._state, self.pool.table, zeros, zeros,
+            _np.zeros((self.slots,), bool), steps=self.iter_tokens,
+            **self._sampling)
+        jax.block_until_ready(buf.data)
+        reg.counter("compile/warmup_compiles").inc(
+            eng.compile_guard.signatures - before)
+        eng.compile_guard.mark_steady()
+
+    # ---------------------------------------------------------- admission
+    def _admission_check(self, fut) -> bool:
+        """Reject-with-backpressure at submit: queue depth beyond
+        ``MXTPU_ADMIT_MAX_QUEUE``, or rolling queue-wait p50 beyond
+        ``MXTPU_ADMIT_MAX_WAIT_MS``, or free pages below the watermark
+        with nothing about to retire — the caller (router) reroutes."""
+        reason = None
+        if self._queue.qsize() + len(self._pending) >= self._admit_max_queue:
+            reason = (f"queue depth {self._queue.qsize()} >= "
+                      f"{self._admit_max_queue} (MXTPU_ADMIT_MAX_QUEUE)")
+        elif self._admit_max_wait_ms > 0 and len(self._recent_waits) >= 8:
+            waits = sorted(self._recent_waits)
+            p50 = waits[len(waits) // 2]
+            if p50 > self._admit_max_wait_ms:
+                reason = (f"queue wait p50 {p50:.0f} ms > "
+                          f"{self._admit_max_wait_ms:.0f} ms "
+                          "(MXTPU_ADMIT_MAX_WAIT_MS)")
+        if reason is not None:
+            self.stats["rejected"] += 1
+            _tel.registry().counter("infer/rejected_backpressure").inc()
+            fut._fail(Backpressure(
+                f"{self._label()} rejected the request: {reason}"))
+            return False
+        return True
+
+    def _drained(self) -> bool:
+        return self._queue.empty() and not self._pending and \
+            not any(self._slots)
+
+    def _fail_inflight(self, error):
+        for i, s in enumerate(self._slots):
+            if s is not None and not s.req.future.done():
+                s.req.future._fail(error)
+            self._slots[i] = None
+        for r in self._pending:
+            if not r.future.done():
+                r.future._fail(error)
+        self._pending.clear()
+        self.pool.reset()
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        super().stop(drain=drain, timeout=timeout)
+        self._fail_inflight(RuntimeError(
+            f"{self._label()} stopped with this request in flight"))
+
+    # ------------------------------------------------------------ scheduler
+    def _run_loop(self):
+        while not self._stop.is_set():
+            _faults.fire("batcher.thread", tag=self.name)
+            if not self._step_once():
+                # idle: block briefly for an arrival
+                try:
+                    self._pending.append(self._queue.get(timeout=0.05))
+                except queue.Empty:
+                    continue
+
+    def _step_once(self) -> bool:
+        """One scheduler iteration: retire -> admit -> decode -> collect.
+        Returns False when there was nothing to do (idle)."""
+        while True:
+            try:
+                self._pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if self._pending:
+            self._pending = collections.deque(
+                self._expire(list(self._pending)))
+        self._retire()
+        admitted = self._admit()
+        live = [i for i, s in enumerate(self._slots)
+                if s is not None and not s.finished]
+        if not live:
+            return admitted > 0
+        self._ensure_capacity(live)
+        live = [i for i, s in enumerate(self._slots)
+                if s is not None and not s.finished]
+        if not live:
+            return True
+        t0 = time.perf_counter()
+        try:
+            out = self._dispatch(live)
+        except Exception as e:  # noqa: BLE001 - fail the slots, not the thread
+            self._poison(e)
+            return True
+        self._collect(live, out, t0)
+        return True
+
+    def _retire(self):
+        """Resolve finished/expired slots and free their pages — the
+        between-dispatches safe point."""
+        now = time.perf_counter()
+        reg = _tel.registry()
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            r = s.req
+            if not s.finished and r.deadline is not None \
+                    and now > r.deadline:
+                reg.counter("serve/deadline_exceeded").inc()
+                r.future._fail(DeadlineExceeded(
+                    f"request deadline passed after {len(s.emitted)} of "
+                    f"{r.max_new} tokens — retired mid-decode"))
+                s.finished = True
+            if not s.finished:
+                continue
+            self.pool.release(i)
+            self._slots[i] = None
+            if not r.future.done():
+                r.future.weights_version = s.version
+                r.future.replica = self.name
+                r.future._resolve(list(s.emitted))
+            self.stats["retired"] += 1
+            reg.counter("infer/requests").inc()
+            reg.counter("infer/tokens").inc(len(s.emitted))
+
+    def _admit(self) -> int:
+        """Fill vacated slots from the waiting line through ONE padded
+        (slots, bucket) prefill-into-pages dispatch; stream each admitted
+        row's first token. Respects the free-page watermark."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free or not self._pending:
+            return 0
+        picked = []
+        while free and self._pending:
+            if self.pool.free_pages - len(picked) <= self._admit_free_pages \
+                    and self.pool.pages_in_use > 0:
+                break  # keep headroom for the requests already decoding
+            r = self._pending.popleft()
+            slot = free.pop(0)
+            if not self.pool.alloc(slot, 1):
+                self._pending.appendleft(r)
+                free.insert(0, slot)
+                break
+            picked.append((slot, r))
+        reg = _tel.registry()
+        reg.histogram("infer/admitted_per_iter").observe(len(picked))
+        if not picked:
+            return 0
+        bucket = self._bucket_for(
+            max(r.prompt.shape[0] for _, r in picked))
+        # admission sub-batch menu: the prefill dispatch shape is the
+        # smallest power-of-two row count covering the admitted set, so a
+        # single-request admission costs a (1, bucket) forward, not a
+        # full (slots, bucket) one — admission-heavy (short-response)
+        # loads would otherwise spend more on prefill than on decode
+        rows = 1
+        while rows < len(picked):
+            rows *= 2
+        rows = min(rows, self.slots)
+        src = _np.full((rows, bucket), self._pad, _np.int32)
+        vl = _np.full((rows,), bucket, _np.int32)
+        slot_ids = _np.full((rows,), self.slots, _np.int32)  # OOB = inert
+        first_pages = _np.zeros((rows,), _np.int32)
+        active = _np.zeros((rows,), bool)
+        for i, (slot, r) in enumerate(picked):
+            n = r.prompt.shape[0]
+            src[i, :n] = r.prompt
+            vl[i] = n
+            slot_ids[i] = slot
+            first_pages[i] = self.pool.table[slot, 0]
+            active[i] = True
+        t0 = time.perf_counter()
+        version = getattr(self._engine, "weights_version", None)
+        try:
+            _faults.fire("batcher.dispatch", tag=self.name)
+            tok0, self._state = self._engine.prefill_paged(
+                self._state, src, vl, slot_ids, first_pages, active,
+                seed=self._iter, **self._sampling)
+            tok0 = tok0.asnumpy()
+        except Exception as e:  # noqa: BLE001 - fail the futures, not the thread
+            for slot, r in picked:
+                if not r.future.done():
+                    r.future._fail(e)
+            self._poison(e)
+            return 0
+        prefill_ms = (time.perf_counter() - t0) * 1e3
+        reg.histogram("infer/prefill_ms").observe(prefill_ms)
+        for i, (slot, r) in enumerate(picked):
+            s = _Slot(r, self._seq)
+            self._seq += 1
+            s.length = 1  # the BOS prime sits in the slot's first page
+            s.carry = int(tok0[i])
+            s.version = version
+            s.emitted.append(s.carry)
+            self._slots[slot] = s
+            r.future.queue_wait_ms = (t0 - r.future.enqueued_at) * 1e3
+            self._recent_waits.append(r.future.queue_wait_ms)
+            reg.histogram("infer/queue_wait_ms").observe(
+                max(r.future.queue_wait_ms, 0.0))
+            r.future._stream_tokens([s.carry])
+            reg.histogram("infer/ttft_ms").observe(
+                (r.future.first_token_at - r.future.enqueued_at) * 1e3)
+            if s.carry == self._engine._eos or len(s.emitted) >= r.max_new:
+                s.finished = True
+        self.stats["admitted"] += len(picked)
+        return len(picked)
+
+    def _ensure_capacity(self, live):
+        """Grow page allocations so every live row can cache
+        ``iter_tokens`` more entries; on pool exhaustion PREEMPT the
+        youngest row (free its pages, restart it from its prompt at the
+        queue head) rather than stalling the whole batch."""
+        for i in list(live):
+            s = self._slots[i]
+            if s is None or s.finished:
+                continue  # preempted/bounced by an earlier row's fight
+            # a row near its max_new needs less than a full burst; beyond
+            # its allocation the device's surplus burst steps land in the
+            # trash page, so the cap is safe
+            upto = min(s.length + self.iter_tokens, 1 + s.req.max_new)
+            while not self.pool.ensure(i, upto):
+                victims = [j for j in range(self.slots)
+                           if self._slots[j] is not None
+                           and not self._slots[j].finished and j != i]
+                if not victims:
+                    # nothing left to preempt: this request cannot make
+                    # progress right now — bounce it back to the caller
+                    self.stats["rejected"] += 1
+                    _tel.registry().counter(
+                        "infer/rejected_backpressure").inc()
+                    s.req.future._fail(Backpressure(
+                        f"{self._label()}: page pool exhausted "
+                        f"({self.pool.free_pages} free) with nothing to "
+                        "preempt"))
+                    self.pool.release(i)
+                    self._slots[i] = None
+                    break
+                j = max(victims,
+                        key=lambda x: self._slots[x].admitted_seq)
+                self._preempt(j)
+
+    def _preempt(self, slot):
+        """Recompute-style preemption: free the slot's pages and restart
+        the request from its prompt at the head of the line (greedy
+        decoding regenerates the identical tokens)."""
+        s = self._slots[slot]
+        self.pool.release(slot)
+        self._slots[slot] = None
+        s.req.future._stream_reset()
+        self._pending.appendleft(s.req)
+        self.stats["preempted"] += 1
+        _tel.registry().counter("infer/preempted").inc()
+
+    def _dispatch(self, live):
+        """One decode-iteration dispatch over the slot batch: pure
+        staging + the jitted ``InferStep.decode_iter`` call — linted
+        sync-free (``tools/check_no_sync_in_step.py``); the host reads
+        happen in ``_collect`` after the device work is in flight."""
+        _faults.fire("batcher.hang", tag=self.name)
+        _faults.fire("batcher.dispatch", tag=self.name)
+        tokens = _np.zeros((self.slots,), _np.int32)
+        lengths = _np.zeros((self.slots,), _np.int32)
+        active = _np.zeros((self.slots,), bool)
+        for i in live:
+            s = self._slots[i]
+            tokens[i] = s.carry
+            lengths[i] = s.length
+            active[i] = True
+        version = getattr(self._engine, "weights_version", None)
+        self._iter += 1
+        buf, self._state = self._engine.decode_iter(
+            self._state, self.pool.table, tokens, lengths, active,
+            steps=self.iter_tokens, seed=self._iter, **self._sampling)
+        return buf, version
+
+    def _collect(self, live, out, t0):
+        """Read back the iteration's token block — the scheduler's ONE
+        sync point — then stream, account lengths, and mark retirements
+        for the next iteration's safe point."""
+        buf, version = out
+        toks = buf.asnumpy()
+        iter_ms = (time.perf_counter() - t0) * 1e3
+        reg = _tel.registry()
+        emitted_total = 0
+        eos = self._engine._eos
+        for i in live:
+            s = self._slots[i]
+            fresh = []
+            for j in range(self.iter_tokens):
+                tok = int(toks[i, j])
+                s.length += 1  # this step cached the previous carry
+                s.carry = tok
+                fresh.append(tok)
+                if tok == eos or len(s.emitted) + len(fresh) \
+                        >= s.req.max_new:
+                    s.finished = True
+                    break
+            s.emitted.extend(fresh)
+            s.version = version
+            emitted_total += len(fresh)
+            s.req.future._stream_tokens(fresh)
+        occupancy = len(live) / self.slots
+        self.stats["iterations"] += 1
+        self.stats["occupancy_sum"] += occupancy
+        self.stats["tokens"] += emitted_total
+        reg.gauge("infer/batch_occupancy").set(occupancy)
+        reg.gauge("infer/pages_in_use").set(self.pool.pages_in_use)
+        reg.gauge("infer/page_fragmentation").set(self.pool.fragmentation(
+            [s.length if s is not None else 0 for s in self._slots]))
+        if emitted_total:
+            reg.histogram("infer/decode_ms_per_token").observe(
+                iter_ms / emitted_total)
+            reg.gauge("infer/tokens_per_sec").set(
+                emitted_total / (iter_ms / 1e3))
+        wd = self._watchdog
+        if wd is not None:
+            wd.notify_step(seconds=iter_ms / 1e3)
+
+    def _poison(self, err):
+        """A decode dispatch failed: the donated pool state is gone, so
+        fail every in-flight request, rebuild the pools, and keep the
+        thread alive for fresh work (mirrors DynamicBatcher's
+        fail-the-futures-not-the-thread contract)."""
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                if not s.req.future.done():
+                    s.req.future._fail(err)
+                self._slots[i] = None
+        self.pool.reset()
+        self._state = self._engine.init_paged_state(
+            self.slots, self.num_pages, self.page_size, self.mem_len)
+
+    @property
+    def sustained_occupancy(self) -> float:
+        """Mean decode-batch occupancy across every iteration so far —
+        the open-loop bench's headline gate (>= 0.9 under load)."""
+        n = self.stats["iterations"]
+        return self.stats["occupancy_sum"] / n if n else 0.0

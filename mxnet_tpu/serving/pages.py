@@ -1,0 +1,224 @@
+"""Host-side accounting for the paged KV cache: free list, page tables,
+admission watermarks.
+
+The device side (``gluon.nn.attention`` pools + the jitted
+``InferStep.prefill_paged``/``decode_iter`` programs) only ever sees
+fixed-shape arrays: ``(num_pages, page_size, H, D)`` pools and a
+``(slots, pages_per_slot)`` int32 page table. THIS module owns what those
+arrays mean — which pages are free, which slot owns which pages, and
+whether admitting another request would starve the ones already decoding:
+
+- **Page 0 is the trash page**, never allocated: inactive slots and
+  finished rows scatter their writes there, and every unallocated table
+  entry points at it, so the device programs need no masking branches and
+  a stale table entry can never alias a live request's pages.
+- ``alloc``/``release`` are LIFO over the free list — a retired request's
+  pages are handed to the next admission, keeping the working set hot.
+- ``ensure(slot, upto)`` grows a slot's allocation on demand, one page at
+  a time, as its decode length crosses page boundaries — the whole point
+  of paging: a request that stops at 3 tokens holds 1 page, not
+  ``ceil(max_len / page_size)``.
+- ``fragmentation(lengths)`` is INTERNAL fragmentation: the fraction of
+  allocated page capacity not yet holding tokens (the only waste mode
+  left once dense per-request slabs are gone).
+
+Env knobs (read by ``ContinuousBatcher`` at construction):
+``MXTPU_PAGE_SIZE`` (tokens per page, default 16), ``MXTPU_PAGES`` (pool
+pages; default = full provisioning ``slots * pages_per_slot + 1`` so
+backpressure/preemption only engage when the operator deliberately
+undersizes the pool), ``MXTPU_ADMIT_FREE_PAGES`` (admission watermark:
+keep at least this many pages free AFTER admitting, default 0),
+``MXTPU_ADMIT_MAX_QUEUE`` (queue-depth rejection threshold, default
+1024), ``MXTPU_ADMIT_MAX_WAIT_MS`` (reject when the rolling queue-wait
+p50 breaches this, default off).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import numpy as np
+
+from ..base import MXNetError
+
+__all__ = ["PagePool", "page_size_default", "num_pages_default",
+           "admit_free_pages", "admit_max_queue", "admit_max_wait_ms"]
+
+TRASH_PAGE = 0
+
+
+def _env_int(name: str, default: int) -> int:
+    v = os.environ.get(name, "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    v = os.environ.get(name, "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def page_size_default(default: int = 16) -> int:
+    """``MXTPU_PAGE_SIZE``: tokens per KV page."""
+    return max(_env_int("MXTPU_PAGE_SIZE", default), 1)
+
+
+def num_pages_default(slots: int, pages_per_slot: int) -> int:
+    """``MXTPU_PAGES``: pool size in pages (excluding the trash page).
+    Default fully provisions every slot — paging then saves nothing but
+    costs nothing; undersize it (e.g. ``slots * pages_per_slot // 2``) to
+    actually oversubscribe memory and let admission control earn its
+    keep."""
+    return max(_env_int("MXTPU_PAGES", slots * pages_per_slot), 1)
+
+
+def admit_free_pages(default: int = 0) -> int:
+    """``MXTPU_ADMIT_FREE_PAGES``: admission keeps at least this many
+    pages free for the requests already decoding (free-page watermark)."""
+    return max(_env_int("MXTPU_ADMIT_FREE_PAGES", default), 0)
+
+
+def admit_max_queue(default: int = 1024) -> int:
+    """``MXTPU_ADMIT_MAX_QUEUE``: submits beyond this queue depth are
+    rejected with ``Backpressure``."""
+    return max(_env_int("MXTPU_ADMIT_MAX_QUEUE", default), 1)
+
+
+def admit_max_wait_ms(default: float = 0.0) -> float:
+    """``MXTPU_ADMIT_MAX_WAIT_MS``: reject new submits while the rolling
+    queue-wait p50 exceeds this (0 = disabled)."""
+    return max(_env_float("MXTPU_ADMIT_MAX_WAIT_MS", default), 0.0)
+
+
+class PagePool:
+    """Free-list + page-table bookkeeping for one paged decode batch.
+
+    Parameters
+    ----------
+    num_pages : allocatable pages (page 0, the trash page, is extra — the
+        device pools are ``num_pages + 1`` rows).
+    page_size : tokens per page.
+    slots : decode-batch rows.
+    pages_per_slot : page-table width P; a slot's logical capacity is
+        ``P * page_size`` tokens.
+    """
+
+    def __init__(self, num_pages: int, page_size: int, slots: int,
+                 pages_per_slot: int):
+        if num_pages < 1:
+            raise MXNetError("PagePool needs at least one allocatable page")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.slots = int(slots)
+        self.pages_per_slot = int(pages_per_slot)
+        # ids 1..num_pages; LIFO so freshly freed pages are reused first
+        self._free: List[int] = list(range(self.num_pages, 0, -1))
+        self._owned: List[List[int]] = [[] for _ in range(self.slots)]
+        self.table = np.full((self.slots, self.pages_per_slot), TRASH_PAGE,
+                             np.int32)
+
+    # ------------------------------------------------------------- queries
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def pages_in_use(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def owned(self, slot: int) -> tuple:
+        return tuple(self._owned[slot])
+
+    def capacity(self, slot: int) -> int:
+        """Tokens slot ``slot`` can hold with its current pages."""
+        return len(self._owned[slot]) * self.page_size
+
+    def fragmentation(self, lengths) -> float:
+        """Internal fragmentation: allocated-but-empty token capacity as a
+        fraction of allocated capacity (0.0 when nothing is allocated).
+        ``lengths[slot]`` = tokens cached per slot (0 for empty slots)."""
+        cap = self.pages_in_use * self.page_size
+        if cap <= 0:
+            return 0.0
+        used = int(sum(int(x) for x in lengths))
+        return max(0.0, 1.0 - used / cap)
+
+    # ----------------------------------------------------------- lifecycle
+    def alloc(self, slot: int, n: int = 1) -> bool:
+        """Give ``slot`` ``n`` more pages; False (state unchanged) when
+        the free list or the slot's table row can't cover it."""
+        owned = self._owned[slot]
+        if len(self._free) < n or len(owned) + n > self.pages_per_slot:
+            return False
+        for _ in range(n):
+            p = self._free.pop()
+            self.table[slot, len(owned)] = p
+            owned.append(p)
+        return True
+
+    def ensure(self, slot: int, upto: int) -> bool:
+        """Grow ``slot``'s allocation to hold ``upto`` tokens; False when
+        the pool can't (the scheduler then preempts or backpressures)."""
+        need = -(-int(upto) // self.page_size)  # ceil
+        have = len(self._owned[slot])
+        if need <= have:
+            return True
+        return self.alloc(slot, need - have)
+
+    def release(self, slot: int) -> int:
+        """Return every page ``slot`` owns to the free list and point its
+        table row back at the trash page. Returns how many were freed."""
+        owned = self._owned[slot]
+        n = len(owned)
+        while owned:
+            self._free.append(owned.pop())
+        self.table[slot, :] = TRASH_PAGE
+        return n
+
+    def reset(self):
+        for s in range(self.slots):
+            self.release(s)
+
+    def check_invariants(self, live_slots=None):
+        """Exactness audit (tests + debugging, not the hot path): free
+        list + owned pages partition [1, num_pages] with no page owned by
+        two slots, and the table mirrors ownership."""
+        seen = {}
+        for s, owned in enumerate(self._owned):
+            for j, p in enumerate(owned):
+                if p in seen:
+                    raise MXNetError(
+                        f"page {p} aliased by slots {seen[p]} and {s}")
+                if p == TRASH_PAGE:
+                    raise MXNetError(f"slot {s} owns the trash page")
+                if int(self.table[s, j]) != p:
+                    raise MXNetError(
+                        f"table[{s},{j}]={self.table[s, j]} != owned {p}")
+                seen[p] = s
+        free = set(self._free)
+        if len(free) != len(self._free):
+            raise MXNetError("free list holds duplicate pages")
+        universe = set(range(1, self.num_pages + 1))
+        if free | set(seen) != universe or free & set(seen):
+            raise MXNetError(
+                f"free ({len(free)}) + owned ({len(seen)}) pages do not "
+                f"partition the pool of {self.num_pages}")
+        if live_slots is not None:
+            for s in range(self.slots):
+                if s not in live_slots and self._owned[s]:
+                    raise MXNetError(f"retired slot {s} still owns pages")
+
+
+def pages_for(tokens: int, page_size: int) -> int:
+    """Pages needed for ``tokens`` cache entries."""
+    return -(-int(tokens) // int(page_size))
+
+
+__all__.append("pages_for")
+__all__.append("TRASH_PAGE")

@@ -368,6 +368,20 @@ def report() -> dict:
         "infer_queue_wait_ms_p95": _hp(snap, "infer/queue_wait_ms", "p95"),
         "infer_requests": snap["counters"].get("infer/requests", 0),
         "infer_tokens": snap["counters"].get("infer/tokens", 0),
+        # continuous batching + paged KV (serving.ContinuousBatcher /
+        # serving.pages): time-to-first-token, pool pressure, per-
+        # iteration admission and the backpressure/preemption self-
+        # protection counters
+        "infer_ttft_ms_p50": _hp(snap, "infer/ttft_ms", "p50"),
+        "infer_ttft_ms_p95": _hp(snap, "infer/ttft_ms", "p95"),
+        "infer_pages_in_use": snap["gauges"].get("infer/pages_in_use"),
+        "infer_page_fragmentation": snap["gauges"].get(
+            "infer/page_fragmentation"),
+        "infer_admitted_per_iter_p50": _hp(
+            snap, "infer/admitted_per_iter", "p50"),
+        "infer_rejected_backpressure": snap["counters"].get(
+            "infer/rejected_backpressure", 0),
+        "infer_preempted": snap["counters"].get("infer/preempted", 0),
         # self-healing serving (serving.router/.watcher/.faults): which
         # weights are live and how often the plane healed itself — hot
         # swaps, replica evictions (failovers), transparent retries, and

@@ -33,6 +33,19 @@ def test_targets_cover_inference_engine():
     assert "_dispatch" in covered[("batcher.py", "DynamicBatcher")]
 
 
+def test_targets_cover_continuous_batching():
+    """ISSUE 8: the iteration-level scheduling hot path — the paged
+    decode/prefill dispatches and the ContinuousBatcher scheduler loop —
+    must stay under the lint."""
+    covered = {(os.path.basename(p), cls): set(funcs)
+               for p, cls, funcs in check_no_sync_in_step.TARGETS}
+    assert "decode_iter" in covered[("infer.py", "InferStep")]
+    assert "prefill_paged" in covered[("infer.py", "InferStep")]
+    cont = covered[("batcher.py", "ContinuousBatcher")]
+    assert "_dispatch" in cont
+    assert "_step_once" in cont  # the scheduler loop body
+
+
 def test_lint_catches_a_violation(tmp_path):
     """The lint itself must actually detect a blocking call (guards
     against the checker rotting into a no-op when step.py is refactored)."""
@@ -66,3 +79,46 @@ def test_lint_catches_decode_violation(tmp_path):
         str(bad), "InferStep", ("decode_n",))
     assert len(violations) == 1
     assert "block_until_ready" in violations[0][1]
+
+
+def test_lint_catches_decode_iter_violation(tmp_path):
+    """A host read smuggled into the paged iteration dispatch (the
+    continuous-batching hot path) must be flagged — per-token host syncs
+    there serialize every scheduler iteration against the device."""
+    bad = tmp_path / "infer_bad_paged.py"
+    bad.write_text(
+        "class InferStep:\n"
+        "    def decode_iter(self, state, tables, tokens):\n"
+        "        buf, state = self._fn(state, tables, tokens)\n"
+        "        return buf.asnumpy(), state\n"
+        "    def prefill_paged(self, state, src):\n"
+        "        tok0, state = self._fn(state, src)\n"
+        "        return int(tok0[0]), state\n"
+    )
+    violations = check_no_sync_in_step.find_violations(
+        str(bad), "InferStep", ("decode_iter", "prefill_paged"))
+    assert len(violations) == 2
+    assert any("asnumpy" in m for _, m in violations)
+    assert any("int" in m for _, m in violations)
+
+
+def test_lint_catches_scheduler_loop_violation(tmp_path):
+    """The ContinuousBatcher scheduler loop body must keep its syncs in
+    the designated collect/admit phases — an inline sleep or device read
+    in _step_once/_dispatch is a violation."""
+    bad = tmp_path / "batcher_bad.py"
+    bad.write_text(
+        "import time\n"
+        "class ContinuousBatcher:\n"
+        "    def _step_once(self):\n"
+        "        time.sleep(0.01)\n"
+        "        return True\n"
+        "    def _dispatch(self, live):\n"
+        "        out = self._engine.decode_iter(live)\n"
+        "        return out[0].tolist()\n"
+    )
+    violations = check_no_sync_in_step.find_violations(
+        str(bad), "ContinuousBatcher", ("_step_once", "_dispatch"))
+    assert len(violations) == 2
+    assert any("sleep" in m for _, m in violations)
+    assert any("tolist" in m for _, m in violations)

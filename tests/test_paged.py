@@ -1,0 +1,523 @@
+"""Continuous batching over a paged KV cache (ISSUE 8 tentpole).
+
+Contracts under test:
+
+- **Page-pool invariants**: alloc/free round-trips leave the free list
+  EXACT (free + owned partition the pool), no page is ever aliased by two
+  live requests, the trash page is never allocated.
+- **Paged read parity**: at equal logical capacity the gather-through-
+  the-table attention read is BIT-identical to the dense
+  ``(max_len, B, H, D)`` path at fp32 — layer level and end-to-end
+  (``ContinuousBatcher`` greedy tokens == ``InferStep.decode_n``).
+- **Iteration-level scheduling**: retired rows free their slots/pages
+  mid-stream, the warmed program menu holds zero steady-state
+  recompiles, tokens stream per iteration, deadlines retire rows
+  mid-decode, pool exhaustion preempts (and restarts) rather than
+  wedging, admission control rejects with ``Backpressure``.
+- **Self-healing interop**: a replica crash with paged requests in
+  flight frees its pages and fails over through the Router (chaos
+  marker); a hot weight swap lands between iterations with zero lost
+  requests.
+"""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+from mxnet_tpu.gluon.nn import MultiHeadAttention
+from mxnet_tpu.parallel import InferStep
+from mxnet_tpu.serving import (Backpressure, ContinuousBatcher,
+                               DeadlineExceeded, DynamicBatcher, PagePool,
+                               Replica, Router, faults, make_batcher)
+from mxnet_tpu.serving import pages as pages_mod
+
+
+def _make_transformer(V=61, units=16, layers=2, seed=0, **kw):
+    np.random.seed(seed)
+    net = TransformerModel(src_vocab=V, tgt_vocab=V, units=units,
+                           hidden_size=2 * units, num_layers=layers,
+                           num_heads=2, max_length=64, dropout=0.0, **kw)
+    net.initialize(mx.initializer.Xavier())
+    net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                      nd.zeros((2, 8), dtype="int32"))
+    return net
+
+
+@pytest.fixture(scope="module")
+def tmodel():
+    return _make_transformer()
+
+
+# ------------------------------------------------------------- page pool
+class TestPagePool:
+    def test_alloc_free_round_trip_exact(self):
+        pool = PagePool(num_pages=8, page_size=4, slots=3,
+                        pages_per_slot=3)
+        assert pool.free_pages == 8 and pool.pages_in_use == 0
+        assert pool.alloc(0, 2) and pool.alloc(1, 3) and pool.alloc(2, 1)
+        assert pool.pages_in_use == 6 and pool.free_pages == 2
+        pool.check_invariants({0, 1, 2})
+        assert pool.release(1) == 3
+        assert pool.free_pages == 5
+        pool.check_invariants({0, 2})
+        assert pool.release(0) == 2 and pool.release(2) == 1
+        assert pool.free_pages == 8 and pool.pages_in_use == 0
+        pool.check_invariants(set())
+        # table fully pointed back at trash
+        assert (pool.table == pages_mod.TRASH_PAGE).all()
+
+    def test_no_page_aliased_by_two_slots(self):
+        pool = PagePool(num_pages=6, page_size=2, slots=3,
+                        pages_per_slot=3)
+        pool.alloc(0, 3)
+        pool.alloc(1, 3)
+        owned = set(pool.owned(0)) | set(pool.owned(1))
+        assert len(owned) == 6  # disjoint
+        assert pages_mod.TRASH_PAGE not in owned
+        assert not pool.alloc(2, 1)  # exhausted: state unchanged
+        assert pool.owned(2) == ()
+        pool.check_invariants({0, 1})
+        # freed pages are reusable, still exclusive
+        pool.release(0)
+        assert pool.alloc(2, 2)
+        assert not set(pool.owned(2)) & set(pool.owned(1))
+        pool.check_invariants({1, 2})
+
+    def test_ensure_grows_on_demand(self):
+        pool = PagePool(num_pages=4, page_size=4, slots=1,
+                        pages_per_slot=4)
+        pool.alloc(0, 1)
+        assert pool.ensure(0, 4)  # fits the first page
+        assert pool.pages_in_use == 1
+        assert pool.ensure(0, 5)  # crosses the boundary
+        assert pool.pages_in_use == 2
+        assert not pool.ensure(0, 17)  # table row can hold only 4 pages
+        pool.check_invariants({0})
+
+    def test_fragmentation(self):
+        pool = PagePool(num_pages=4, page_size=8, slots=2,
+                        pages_per_slot=2)
+        assert pool.fragmentation([0, 0]) == 0.0
+        pool.alloc(0, 1)
+        assert pool.fragmentation([2, 0]) == pytest.approx(0.75)
+
+    def test_env_defaults(self, monkeypatch):
+        monkeypatch.setenv("MXTPU_PAGE_SIZE", "32")
+        monkeypatch.setenv("MXTPU_PAGES", "7")
+        monkeypatch.setenv("MXTPU_ADMIT_MAX_QUEUE", "5")
+        assert pages_mod.page_size_default() == 32
+        assert pages_mod.num_pages_default(4, 10) == 7
+        assert pages_mod.admit_max_queue() == 5
+        monkeypatch.delenv("MXTPU_PAGES")
+        assert pages_mod.num_pages_default(4, 10) == 40  # full provision
+
+
+# ------------------------------------------------------- bit-parity reads
+class TestPagedParity:
+    def test_paged_step_bitwise_vs_dense_step(self):
+        """Layer level: gather-through-table attention == the dense
+        (max_len, B, H, D) cache path, bit for bit, at equal capacity."""
+        mha = MultiHeadAttention(16, 2, dropout=0.0, causal=True)
+        mha.initialize()
+        B, S, cap = 2, 8, 8  # capacity 8 = 2 pages x 4
+        x = nd.array(np.random.RandomState(1).randn(B, S, 16)
+                     .astype(np.float32))
+        _, k, v = mha.prefill(x[:, :1])
+        kc, vc = mha.init_cache(B, cap)
+        kc = jax.lax.dynamic_update_slice(kc, jnp.swapaxes(k, 0, 1),
+                                          (0, 0, 0, 0))
+        vc = jax.lax.dynamic_update_slice(vc, jnp.swapaxes(v, 0, 1),
+                                          (0, 0, 0, 0))
+        kp, vp = mha.init_page_pool(5, 4)
+        table = jnp.asarray(np.array([[1, 2], [3, 4]], np.int32))
+        kp = kp.at[table[:, 0], 0].set(k[:, 0])
+        vp = vp.at[table[:, 0], 0].set(v[:, 0])
+        for p in range(1, S):
+            od, kc, vc = mha.step(x[:, p:p + 1], kc, vc, jnp.int32(p))
+            op, kp, vp = mha.paged_step(
+                x[:, p:p + 1], kp, vp, table,
+                jnp.full((B,), p, jnp.int32), jnp.ones((B,), bool))
+            np.testing.assert_array_equal(od.asnumpy(), op.asnumpy(),
+                                          err_msg=f"position {p}")
+        # dense cache contents == gathered view, bit for bit
+        np.testing.assert_array_equal(
+            np.asarray(jnp.swapaxes(kc, 0, 1)),
+            np.asarray(kp[table].reshape(B, cap, 2, 8)))
+
+    def test_inactive_rows_write_trash_only(self):
+        """A masked (inactive) row must never touch an allocated page —
+        its write lands in the reserved trash page 0."""
+        mha = MultiHeadAttention(16, 2, dropout=0.0, causal=True)
+        mha.initialize()
+        kp, vp = mha.init_page_pool(3, 4)
+        table = jnp.asarray(np.array([[1], [2]], np.int32))
+        x = nd.array(np.random.RandomState(0).randn(2, 1, 16)
+                     .astype(np.float32))
+        before_k = np.asarray(kp[1:])
+        _, kp2, _ = mha.paged_step(x, kp, vp, table,
+                                   jnp.zeros((2,), jnp.int32),
+                                   jnp.zeros((2,), bool))
+        np.testing.assert_array_equal(before_k, np.asarray(kp2[1:]))
+        assert np.abs(np.asarray(kp2[0])).sum() > 0  # trash took the write
+
+    def test_continuous_greedy_bitwise_vs_decode_n(self, tmodel):
+        """End to end: every request's greedy tokens through the paged
+        scheduler == the PR-5 dense engine, per request (single-bucket
+        menu => identical program shapes => bitwise logits)."""
+        eng = InferStep(tmodel, max_len=24)
+        rng = np.random.RandomState(3)
+        B, Ls, T = 3, 8, 6
+        src = rng.randint(3, 61, (B, Ls)).astype(np.int32)
+        vl = np.array([4, 7, 8], np.int32)
+        toks_d, lens_d = eng.decode_n(src, vl, max_new_tokens=T)
+        toks_d, lens_d = toks_d.asnumpy(), lens_d.asnumpy()
+        bat = ContinuousBatcher(eng, bucket_keys=(Ls,), slots=2,
+                                max_new_tokens=T, page_size=4,
+                                iter_tokens=2, warmup=True)
+        try:
+            futs = [bat.submit(src[i, :vl[i]]) for i in range(B)]
+            got = [f.result(timeout=120) for f in futs]
+        finally:
+            bat.stop()
+        for i in range(B):
+            assert got[i] == toks_d[i, :int(lens_d[i])].tolist(), f"row {i}"
+        assert eng.compile_guard.steady_state_recompiles == 0
+        # every page returned: free list exact after full drain
+        assert bat.pool.free_pages == bat.pool.num_pages
+        bat.pool.check_invariants(set())
+
+
+# ------------------------------------------------- scheduler behaviour
+class TestContinuousBatcher:
+    def _batcher(self, tmodel, **kw):
+        eng = InferStep(tmodel, max_len=24)
+        cfg = dict(bucket_keys=(8,), slots=2, max_new_tokens=6,
+                   page_size=4, iter_tokens=2, warmup=True)
+        cfg.update(kw)
+        return ContinuousBatcher(eng, **cfg), eng
+
+    def test_requires_paged_protocol(self):
+        from mxnet_tpu.gluon.model_zoo.bert import BERTModel
+
+        bert = BERTModel(vocab_size=31, units=16, hidden_size=32,
+                         num_layers=1, num_heads=2, max_length=32,
+                         dropout=0.0)
+        bert.initialize()
+        bert._probe_shapes(nd.zeros((2, 8), dtype="int32"))
+        with pytest.raises(MXNetError):
+            ContinuousBatcher(InferStep(bert), bucket_keys=(8,))
+
+    def test_pool_too_small_for_one_request_raises(self, tmodel):
+        eng = InferStep(tmodel, max_len=24)
+        with pytest.raises(MXNetError, match="pages"):
+            ContinuousBatcher(eng, bucket_keys=(8,), slots=1,
+                              max_new_tokens=32, page_size=2, num_pages=3)
+
+    def test_streaming_tokens_iter(self, tmodel):
+        bat, _ = self._batcher(tmodel)
+        try:
+            fut = bat.submit(np.array([5, 6, 7], np.int32))
+            chunks = list(fut.tokens_iter(timeout=60))
+        finally:
+            bat.stop()
+        flat = [t for c in chunks for t in c]
+        assert flat == fut.result()
+        # per-iteration granularity: more than one chunk for 6 tokens at
+        # iter_tokens=2 (first from admission, the rest per iteration)
+        assert len(chunks) >= 2
+        assert fut.first_token_at is not None
+        assert fut.first_token_at >= fut.enqueued_at
+
+    def test_slot_reuse_keeps_occupancy(self, tmodel):
+        """More requests than slots: retired rows hand their slots to
+        queued requests mid-stream (iterations << what a fixed batcher
+        would need) and the pool ends exact."""
+        bat, eng = self._batcher(tmodel, slots=2)
+        rng = np.random.RandomState(0)
+        try:
+            futs = [bat.submit(rng.randint(3, 61, (5,)).astype(np.int32),
+                               max_new_tokens=2 + (i % 5))
+                    for i in range(8)]
+            for f in futs:
+                f.result(timeout=120)
+        finally:
+            bat.stop()
+        assert bat.stats["retired"] == 8
+        assert bat.stats["admitted"] == 8
+        assert bat.pool.free_pages == bat.pool.num_pages
+        assert eng.compile_guard.steady_state_recompiles == 0
+
+    def test_deadline_retires_mid_decode(self, tmodel):
+        """A deadline passing DURING decode retires the row at the next
+        iteration boundary (DeadlineExceeded), frees its pages, and the
+        other slots keep decoding."""
+        bat, _ = self._batcher(tmodel, max_new_tokens=32, page_size=4,
+                               iter_tokens=1)
+        try:
+            doomed = bat.submit([5, 6, 7], deadline_ms=1.0)
+            ok = bat.submit([8, 9, 10], max_new_tokens=4)
+            with pytest.raises(DeadlineExceeded):
+                doomed.result(timeout=60)
+            assert len(ok.result(timeout=60)) <= 4
+        finally:
+            bat.stop()
+        assert bat.pool.free_pages == bat.pool.num_pages
+
+    def test_preemption_restarts_and_completes(self, tmodel):
+        """Pool oversubscription: the youngest row is preempted (pages
+        freed, request restarted) and every request still completes with
+        the full greedy result."""
+        eng = InferStep(tmodel, max_len=24)
+        bat = ContinuousBatcher(eng, bucket_keys=(8,), slots=2,
+                                max_new_tokens=8, page_size=2,
+                                num_pages=5, iter_tokens=2, warmup=True)
+        rng = np.random.RandomState(3)
+        try:
+            futs = [bat.submit(rng.randint(3, 61, (6,)).astype(np.int32),
+                               max_new_tokens=8) for _ in range(3)]
+            got = [f.result(timeout=120) for f in futs]
+        finally:
+            bat.stop()
+        assert all(len(g) == 8 for g in got)
+        assert bat.stats["preempted"] >= 1
+        assert bat.pool.free_pages == bat.pool.num_pages
+        bat.pool.check_invariants(set())
+
+    def test_backpressure_rejects_at_submit(self, tmodel):
+        bat, _ = self._batcher(tmodel, admit_max_queue=0)
+        try:
+            fut = bat.submit([5, 6, 7])
+            assert isinstance(fut.exception(), Backpressure)
+            assert bat.stats["rejected"] == 1
+        finally:
+            bat.stop()
+
+    def test_free_page_watermark_defers_admission(self, tmodel):
+        """With a watermark covering the whole pool, admission defers
+        while pages are in use (the queued request waits its turn instead
+        of fragmenting the pool)."""
+        eng = InferStep(tmodel, max_len=24)
+        bat = ContinuousBatcher(eng, bucket_keys=(8,), slots=2,
+                                max_new_tokens=4, page_size=2,
+                                num_pages=6, iter_tokens=1,
+                                admit_free_pages=3, warmup=True)
+        rng = np.random.RandomState(1)
+        try:
+            futs = [bat.submit(rng.randint(3, 61, (5,)).astype(np.int32))
+                    for _ in range(4)]
+            for f in futs:
+                assert len(f.result(timeout=120)) <= 4
+        finally:
+            bat.stop()
+        assert bat.pool.free_pages == bat.pool.num_pages
+
+    def test_submit_after_stop_fails_fast(self, tmodel):
+        bat, _ = self._batcher(tmodel)
+        bat.stop()
+        fut = bat.submit([3, 4, 5])
+        assert isinstance(fut.exception(), RuntimeError)
+        assert "not accepting" in str(fut.exception())
+        assert bat.pool.free_pages == bat.pool.num_pages
+
+    def test_dispatch_error_fails_slots_not_thread(self, tmodel):
+        """An engine error mid-iteration fails the in-flight futures,
+        rebuilds the pools, and the scheduler keeps serving."""
+        bat, _ = self._batcher(tmodel)
+        try:
+            faults.inject("batcher.dispatch", times=1, after=1)
+            fut = bat.submit([3, 4, 5], max_new_tokens=6)
+            with pytest.raises(faults.FaultInjected):
+                fut.result(timeout=60)
+            assert bat.healthy
+            ok = bat.submit([6, 7, 8], max_new_tokens=2)
+            assert len(ok.result(timeout=60)) <= 2
+        finally:
+            faults.clear()
+            bat.stop()
+        assert bat.pool.free_pages == bat.pool.num_pages
+
+    def test_telemetry_fields(self, tmodel):
+        mx.telemetry.reset()
+        mx.telemetry.enable()
+        try:
+            bat, _ = self._batcher(tmodel)
+            fut = bat.submit([5, 6, 7])
+            fut.result(timeout=60)
+            bat.stop()
+            rep = mx.telemetry.report()
+            assert rep["infer_ttft_ms_p50"] is not None
+            assert rep["infer_pages_in_use"] is not None
+            assert rep["infer_page_fragmentation"] is not None
+            assert rep["infer_admitted_per_iter_p50"] is not None
+            assert rep["infer_rejected_backpressure"] == 0
+            assert rep["infer_requests"] >= 1
+        finally:
+            mx.telemetry.reset()
+
+    def test_sustained_occupancy_stat(self, tmodel):
+        bat, _ = self._batcher(tmodel)
+        rng = np.random.RandomState(5)
+        try:
+            futs = [bat.submit(rng.randint(3, 61, (5,)).astype(np.int32))
+                    for _ in range(6)]
+            for f in futs:
+                f.result(timeout=120)
+        finally:
+            bat.stop()
+        assert 0.0 < bat.sustained_occupancy <= 1.0
+        assert bat.stats["iterations"] > 0
+
+
+# ------------------------------------------------------- API routing
+class TestRouting:
+    def test_make_batcher_default_and_fixed(self, tmodel, monkeypatch):
+        eng = InferStep(tmodel, max_len=24)
+        bat = make_batcher(eng, bucket_keys=(8,), slots=2,
+                           max_new_tokens=4, start=False)
+        assert isinstance(bat, ContinuousBatcher)
+        monkeypatch.setenv("MXTPU_BATCHER", "fixed")
+        bat2 = make_batcher(eng, bucket_keys=(8,), slots=2,
+                            max_new_tokens=4, start=False)
+        assert type(bat2) is DynamicBatcher
+
+    def test_generate_routes_through_continuous(self, tmodel, monkeypatch):
+        src = np.random.RandomState(2).randint(3, 61, (2, 7)) \
+            .astype(np.int32)
+        toks_c, lens_c = tmodel.generate(src, max_new_tokens=4, max_len=24)
+        assert getattr(tmodel, "_batchers", None), \
+            "greedy generate must route through the ContinuousBatcher"
+        monkeypatch.setenv("MXTPU_BATCHER", "fixed")
+        toks_d, lens_d = tmodel.generate(src, max_new_tokens=4, max_len=24)
+        np.testing.assert_array_equal(toks_c.asnumpy(), toks_d.asnumpy())
+        np.testing.assert_array_equal(lens_c.asnumpy(), lens_d.asnumpy())
+
+    def test_generate_sampling_seed_stays_direct(self, tmodel):
+        src = np.random.RandomState(2).randint(3, 61, (2, 7)) \
+            .astype(np.int32)
+        before = dict(getattr(tmodel, "_batchers", {}) or {})
+        a, _ = tmodel.generate(src, max_new_tokens=3, max_len=24,
+                               method="top_k", top_k=4, seed=9)
+        b, _ = tmodel.generate(src, max_new_tokens=3, max_len=24,
+                               method="top_k", top_k=4, seed=9)
+        np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())
+        after = dict(getattr(tmodel, "_batchers", {}) or {})
+        assert before == after  # no batcher built for seeded sampling
+
+    def test_estimator_predict_through_batcher(self, tmodel):
+        from mxnet_tpu.gluon.contrib.estimator import Estimator
+        from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
+
+        eng = InferStep(tmodel, max_len=24)
+        bat = ContinuousBatcher(eng, bucket_keys=(8,), slots=2,
+                                max_new_tokens=4, page_size=4,
+                                iter_tokens=2, warmup=True)
+        rng = np.random.RandomState(3)
+        src = rng.randint(3, 61, (2, 7)).astype(np.int32)
+        vl = np.array([5, 7], np.int32)
+        est = Estimator(tmodel, SoftmaxCrossEntropyLoss())
+        try:
+            outs = est.predict([(src, vl)], engine=bat)
+        finally:
+            bat.stop()
+        assert len(outs) == 1
+        toks, lengths = outs[0]
+        assert toks.shape == (2, 4) and lengths.shape == (2,)
+        ref_t, ref_l = eng.decode_n(src, vl, max_new_tokens=4)
+        np.testing.assert_array_equal(toks.asnumpy(), ref_t.asnumpy())
+
+
+# --------------------------------------------------- self-healing interop
+class TestPagedResilience:
+    @pytest.mark.chaos
+    def test_replica_crash_frees_pages_and_fails_over(self, tmodel):
+        """Kill one replica's scheduler mid-decode: its pages return to
+        the free list, its in-flight/queued requests fail over through
+        the Router, and every future still resolves."""
+
+        def make_replica(name):
+            eng = InferStep(tmodel, max_len=24)
+            bat = ContinuousBatcher(eng, bucket_keys=(8,), slots=2,
+                                    max_new_tokens=8, page_size=4,
+                                    iter_tokens=1, warmup=True, name=name)
+            return Replica(name, bat)
+
+        mx.telemetry.reset()
+        r0, r1 = make_replica("pg-r0"), make_replica("pg-r1")
+        router = Router([r0, r1], retry_backoff_s=0.01,
+                        health_interval_s=0.02)
+        rng = np.random.RandomState(7)
+        # let r1 run a couple of scheduler iterations, then die mid-decode
+        faults.inject("batcher.thread", times=1, after=3, match="pg-r1")
+        try:
+            futs = [router.submit(rng.randint(3, 61, (6,))
+                                  .astype(np.int32), max_new_tokens=8)
+                    for _ in range(10)]
+            results = [f.result(timeout=120) for f in futs]
+        finally:
+            faults.clear()
+            router.stop()
+        assert all(len(r) == 8 for r in results)
+        reg = mx.telemetry.registry()
+        assert reg.counter("serve/failovers").value >= 1
+        # the dead replica's pool is exact again: eviction freed its pages
+        for rep in (r0, r1):
+            assert rep.batcher.pool.free_pages == rep.batcher.pool.num_pages
+            rep.batcher.pool.check_invariants(set())
+        mx.telemetry.reset()
+
+    def test_hot_swap_with_paged_requests_in_flight(self, tmodel):
+        """A weight swap between iterations: zero lost requests and both
+        versions appear in the served stream."""
+        other = _make_transformer(seed=11, prefix=tmodel.prefix)
+        eng = InferStep(tmodel, max_len=24)
+        staged = eng.stage_params(
+            {n: p._data.data for n, p in other.collect_params().items()})
+        bat = ContinuousBatcher(eng, bucket_keys=(8,), slots=2,
+                                max_new_tokens=6, page_size=4,
+                                iter_tokens=1, warmup=True)
+        rng = np.random.RandomState(9)
+        futs = []
+        try:
+            for i in range(12):
+                futs.append(bat.submit(
+                    rng.randint(3, 61, (6,)).astype(np.int32)))
+                if i == 5:
+                    # guarantee at least one pre-swap completion, then
+                    # flip between iterations with requests in flight
+                    futs[0].result(timeout=60)
+                    eng.swap_params(staged=staged, version="v-next")
+                time.sleep(0.002)
+            results = [f.result(timeout=120) for f in futs]
+        finally:
+            bat.stop()
+        assert all(len(r) >= 1 for r in results)
+        versions = {f.weights_version for f in futs}
+        assert "v-next" in versions and len(versions) >= 2
+        assert bat.pool.free_pages == bat.pool.num_pages
+
+
+# ------------------------------------------------------------ no regress
+def test_dynamic_batcher_still_fixed_path(tmodel):
+    """The fallback engine path survives the base-class refactor: same
+    construction surface, same whole-batch semantics."""
+    eng = InferStep(tmodel, max_len=24)
+    bat = DynamicBatcher(eng, bucket_keys=(8, 12), slots=2,
+                         timeout_ms=40.0, max_new_tokens=4)
+    try:
+        fut = bat.submit([7, 8, 9, 10], max_new_tokens=2)
+        out = fut.result(timeout=60)
+        assert len(out) <= 2
+        if out:
+            # streaming degenerates to one final chunk
+            assert list(fut.tokens_iter(timeout=10)) == [out]
+    finally:
+        bat.stop()

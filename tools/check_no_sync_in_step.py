@@ -44,13 +44,19 @@ BATCHER_PY = os.path.join(_ROOT, "mxnet_tpu", "serving", "batcher.py")
 FAST_PATH_FUNCS = ("__call__", "_dispatch")
 
 # every linted (file, class, methods) hot path. The inference engine's
-# decode_n is the whole generation dispatch; the batcher's _dispatch
-# assembles and fires a batch (its _resolve is the designated sync
-# point and stays unlinted).
+# decode_n is the whole generation dispatch and decode_iter/prefill_paged
+# are the continuous-batching iteration dispatches; the batchers'
+# _dispatch methods assemble and fire batches (DynamicBatcher._resolve /
+# ContinuousBatcher._collect+_admit are the designated sync points and
+# stay unlinted). ContinuousBatcher._step_once — the scheduler loop body
+# — is linted too: its syncs must stay delegated to those named phases,
+# never inlined next to a dispatch.
 TARGETS = (
     (STEP_PY, "TrainStep", FAST_PATH_FUNCS),
-    (INFER_PY, "InferStep", ("__call__", "_dispatch", "decode_n")),
+    (INFER_PY, "InferStep", ("__call__", "_dispatch", "decode_n",
+                             "decode_iter", "prefill_paged")),
     (BATCHER_PY, "DynamicBatcher", ("_dispatch",)),
+    (BATCHER_PY, "ContinuousBatcher", ("_dispatch", "_step_once")),
 )
 
 # method attributes that force a device->host readback / host sync

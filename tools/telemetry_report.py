@@ -166,6 +166,16 @@ def _print_infer_family(report_path):
         h = hists[k]
         print(f"  {k:<38} p50={h.get('p50')} p95={h.get('p95')} "
               f"n={h.get('count')}")
+    rejected = counters.get("infer/rejected_backpressure", 0)
+    if rejected:
+        print(f"  WARNING: {rejected} request(s) rejected by admission "
+              "control — raise MXTPU_PAGES or relax MXTPU_ADMIT_* "
+              "thresholds if the pool is undersized")
+    preempted = counters.get("infer/preempted", 0)
+    if preempted:
+        print(f"  WARNING: {preempted} mid-decode preemption(s) — the "
+              "page pool oversubscribes more than the workload tolerates "
+              "(MXTPU_PAGES / MXTPU_ADMIT_FREE_PAGES)")
 
 
 def _print_serve_family(report_path):
