@@ -1,0 +1,29 @@
+"""mxlint: the unified static-analysis framework.
+
+One pass registry over the two IRs this repo already lints — Python AST
+for host code and jaxpr for the jitted programs — replacing the three
+ad-hoc checkers (no-sync, AMP purity, sharding placement) that grew one
+per PR. Every checker is an ``AnalysisPass`` producing ``Finding``\\ s
+with stable fingerprints; pre-existing violations live in a committed
+baseline file with a reason each, so the suite runs green at HEAD while
+new violations fail CI.
+
+Entry points:
+
+- ``python tools/mxlint.py [--json]`` — the CLI (all passes, baseline
+  applied, JSON for CI);
+- ``tests/test_mxlint.py`` — the tier-1 wiring (full suite green +
+  violation self-tests per pass);
+- ``mxnet_tpu.analysis.run_passes()`` — programmatic.
+
+See docs/ARCHITECTURE.md "Static analysis" for the pass list and how to
+add a pass.
+"""
+
+from .core import (  # noqa: F401
+    AnalysisPass, Baseline, Context, Finding, Severity, all_passes,
+    get_pass, register, run_passes,
+)
+
+__all__ = ["AnalysisPass", "Baseline", "Context", "Finding", "Severity",
+           "all_passes", "get_pass", "register", "run_passes"]

@@ -1,0 +1,235 @@
+"""AST module walker with symbol resolution — the host-code IR driver.
+
+Provides cached parsing plus the resolution primitives every AST pass
+shares:
+
+- ``AstIndex`` — repo-relative module cache (``index.module("mxnet_tpu/
+  serving/batcher.py")``), class table with base-class resolution across
+  a module set (``classes_in``), and source access for messages;
+- ``dotted(expr)`` — best-effort dotted name of an expression
+  (``self._engine.decode_iter``, ``time.sleep``) so rule sets can match
+  call shapes without chasing objects;
+- ``FunctionModel`` — per-function facts passes keep re-deriving: the
+  ordered statement walk, call sites, ``self.X`` loads/stores, and the
+  ``with self.<lock>`` structure.
+
+Resolution is deliberately *intra-module + declared bases*: precise
+enough for the package's code shapes, cheap enough to run in tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+from .core import REPO_ROOT
+
+
+def dotted(expr) -> Optional[str]:
+    """Dotted name of a Name/Attribute chain; None for anything fancier
+    (subscripts, calls) — callers treat None as 'unknown receiver'."""
+    parts = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def self_attr(expr) -> Optional[str]:
+    """'X' when ``expr`` is exactly ``self.X``, else None."""
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and expr.value.id == "self":
+        return expr.attr
+    return None
+
+
+def walk_statements(body: Sequence[ast.stmt]) -> Iterator[ast.stmt]:
+    """Yield statements in source order, recursing into compound bodies
+    (the linear over-approximation the dataflow passes use)."""
+    for stmt in body:
+        yield stmt
+        for field in ("body", "orelse", "finalbody"):
+            inner = getattr(stmt, field, None)
+            if inner:
+                yield from walk_statements(inner)
+        for handler in getattr(stmt, "handlers", []) or []:
+            yield from walk_statements(handler.body)
+
+
+class Module:
+    def __init__(self, path: str, tree: ast.Module, source: str):
+        self.path = path
+        self.tree = tree
+        self.source = source
+        self.classes: Dict[str, ast.ClassDef] = {
+            n.name: n for n in tree.body if isinstance(n, ast.ClassDef)}
+        self.functions: Dict[str, ast.FunctionDef] = {
+            n.name: n for n in tree.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+
+class ClassModel:
+    """A class with inheritance flattened over the analyzed module set:
+    ``methods`` maps name -> (FunctionDef, defining Module)."""
+
+    def __init__(self, name: str, module: Module):
+        self.name = name
+        self.module = module
+        self.methods: Dict[str, Tuple[ast.FunctionDef, Module]] = {}
+        self.node = module.classes[name]
+
+    def method(self, name: str) -> Optional[ast.FunctionDef]:
+        entry = self.methods.get(name)
+        return entry[0] if entry else None
+
+
+class AstIndex:
+    """Parse-once module cache keyed by repo-relative path."""
+
+    def __init__(self, repo_root: str = REPO_ROOT):
+        self.repo_root = repo_root
+        self._cache: Dict[str, Module] = {}
+
+    def module(self, rel_path: str) -> Module:
+        rel_path = rel_path.replace(os.sep, "/")
+        m = self._cache.get(rel_path)
+        if m is None:
+            path = os.path.join(self.repo_root, rel_path)
+            with open(path) as f:
+                source = f.read()
+            m = Module(rel_path, ast.parse(source, filename=path), source)
+            self._cache[rel_path] = m
+        return m
+
+    def package_files(self, *subdirs: str) -> List[str]:
+        """Every .py under the given repo-relative directories."""
+        out = []
+        for sub in subdirs:
+            root = os.path.join(self.repo_root, sub)
+            for dirpath, dirnames, filenames in os.walk(root):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        rel = os.path.relpath(os.path.join(dirpath, fn),
+                                              self.repo_root)
+                        out.append(rel.replace(os.sep, "/"))
+        return out
+
+    def classes_in(self, rel_paths: Sequence[str]) -> Dict[str, ClassModel]:
+        """Class table over a module set with single-inheritance
+        flattening: a subclass's method table is its bases' (resolved by
+        bare name anywhere in the set) overlaid with its own."""
+        modules = [self.module(p) for p in rel_paths]
+        by_name: Dict[str, Tuple[ast.ClassDef, Module]] = {}
+        for m in modules:
+            for cname, cnode in m.classes.items():
+                by_name[cname] = (cnode, m)
+        models: Dict[str, ClassModel] = {}
+
+        def build(cname: str) -> Optional[ClassModel]:
+            if cname in models:
+                return models[cname]
+            if cname not in by_name:
+                return None
+            cnode, m = by_name[cname]
+            model = ClassModel(cname, m)
+            models[cname] = model  # break cycles defensively
+            for base in cnode.bases:
+                bname = base.id if isinstance(base, ast.Name) else None
+                if bname:
+                    bmodel = build(bname)
+                    if bmodel:
+                        model.methods.update(bmodel.methods)
+            for n in cnode.body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    model.methods[n.name] = (n, m)
+            return model
+
+        for cname in list(by_name):
+            build(cname)
+        return models
+
+
+class FunctionModel:
+    """Pre-digested per-function facts for the concurrency passes."""
+
+    def __init__(self, fn: ast.FunctionDef, module: Module):
+        self.fn = fn
+        self.module = module
+        self.calls: List[ast.Call] = [
+            n for n in ast.walk(fn) if isinstance(n, ast.Call)]
+        self.self_calls: List[str] = []
+        for c in self.calls:
+            attr = self_attr(c.func)
+            if attr is not None:
+                self.self_calls.append(attr)
+
+    def self_stores(self) -> List[Tuple[str, int, str]]:
+        """(attr, lineno, kind) for every write to ``self.X`` state:
+        plain/aug assignment, subscript stores (``self.X[k] = v``,
+        ``self.X[k] += v``) and known in-place container mutations
+        (``self.X.append(...)``...)."""
+        out = []
+        mutators = {"append", "appendleft", "extend", "pop", "popleft",
+                    "clear", "insert", "remove", "update", "add",
+                    "setdefault", "sort", "reverse"}
+        def flat_targets(t):
+            if isinstance(t, (ast.Tuple, ast.List)):
+                for e in t.elts:
+                    yield from flat_targets(e)
+            elif isinstance(t, ast.Starred):
+                yield from flat_targets(t.value)
+            else:
+                yield t
+
+        for node in ast.walk(self.fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for t0 in targets:
+                for t in flat_targets(t0):
+                    base = t
+                    kind = "assign"
+                    if isinstance(t, ast.Subscript):
+                        base = t.value
+                        kind = "subscript"
+                    attr = self_attr(base)
+                    if attr is not None:
+                        out.append((attr, node.lineno, kind))
+            if isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in mutators:
+                attr = self_attr(node.func.value)
+                if attr is not None:
+                    out.append((attr, node.lineno, "mutate"))
+        return out
+
+    def self_loads(self) -> List[Tuple[str, int, bool]]:
+        """(attr, lineno, iterated) for reads of ``self.X``; ``iterated``
+        marks reads that traverse the value (for-loops, ``sorted``/
+        ``list``/``max``/comprehension iterables) — the reads a
+        concurrent mutation actually corrupts."""
+        iterating_fns = {"sorted", "list", "tuple", "set", "max", "min",
+                         "sum", "any", "all", "len"}
+        out = []
+        iter_nodes = set()
+        for node in ast.walk(self.fn):
+            if isinstance(node, (ast.For, ast.comprehension)):
+                iter_nodes.add(id(node.iter))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Name) and \
+                    node.func.id in iterating_fns and node.args:
+                if not (node.func.id == "len"):
+                    iter_nodes.add(id(node.args[0]))
+        for node in ast.walk(self.fn):
+            attr = self_attr(node)
+            if attr is not None and isinstance(node.ctx, ast.Load):
+                out.append((attr, node.lineno, id(node) in iter_nodes))
+        return out

@@ -1,0 +1,193 @@
+"""Pass registry, finding model and baseline workflow for mxlint.
+
+Design (in the spirit of compositional analyses like RacerD): each
+checker is a small ``AnalysisPass`` that walks one IR (Python AST or
+jaxpr) through a shared ``Context`` of cached parsed modules and lowered
+programs, and reports ``Finding``\\ s. A finding's ``fingerprint`` is
+stable across unrelated edits (no line numbers), so a committed baseline
+file can grandfather a known violation *with a reason* while any NEW
+violation still fails CI.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, Iterable, List, Optional
+
+REPO_ROOT = os.path.normpath(os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), os.pardir, os.pardir))
+
+
+class Severity:
+    ERROR = "error"      # breaks the CLI / tier-1 test unless baselined
+    WARNING = "warning"  # reported, never fails the run
+
+
+class Finding:
+    """One violation.
+
+    ``key`` is the stable identity component: pass+rule+path+key make the
+    fingerprint, deliberately excluding line numbers and message wording
+    so a baseline entry survives reformatting. Passes should choose keys
+    that name the program point (``ClassName.method:what``)."""
+
+    __slots__ = ("pass_name", "rule", "path", "line", "key", "message",
+                 "severity")
+
+    def __init__(self, pass_name: str, rule: str, path: str, line: int,
+                 key: str, message: str,
+                 severity: str = Severity.ERROR):
+        self.pass_name = pass_name
+        self.rule = rule
+        self.path = os.path.relpath(path, REPO_ROOT) \
+            if os.path.isabs(path) else path
+        self.line = int(line)
+        self.key = key
+        self.message = message
+        self.severity = severity
+
+    @property
+    def fingerprint(self) -> str:
+        return f"{self.pass_name}.{self.rule}:{self.path}:{self.key}"
+
+    def to_dict(self) -> dict:
+        return {"pass": self.pass_name, "rule": self.rule,
+                "path": self.path, "line": self.line, "key": self.key,
+                "severity": self.severity, "message": self.message,
+                "fingerprint": self.fingerprint}
+
+    def __repr__(self):
+        return (f"{self.path}:{self.line}: [{self.pass_name}.{self.rule}] "
+                f"{self.message}")
+
+
+class AnalysisPass:
+    """Base class: subclasses set ``name``/``ir``/``description`` and
+    implement ``run(ctx) -> list[Finding]``. ``ir`` is ``"ast"``,
+    ``"jaxpr"`` or ``"meta"`` (repo-level consistency checks); the CLI
+    groups and orders by it (cheap AST passes first)."""
+
+    name: str = ""
+    ir: str = "ast"
+    description: str = ""
+
+    def run(self, ctx: "Context") -> List[Finding]:  # pragma: no cover
+        raise NotImplementedError
+
+    def finding(self, rule: str, path: str, line: int, key: str,
+                message: str, severity: str = Severity.ERROR) -> Finding:
+        return Finding(self.name, rule, path, line, key, message, severity)
+
+
+_REGISTRY: Dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: add an ``AnalysisPass`` subclass to the global
+    registry (import ``mxnet_tpu.analysis.passes`` to populate it)."""
+    if not cls.name:
+        raise ValueError(f"pass {cls.__name__} needs a name")
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def all_passes() -> Dict[str, type]:
+    from . import passes  # noqa: F401 - registration side effect
+    return dict(_REGISTRY)
+
+
+def get_pass(name: str) -> AnalysisPass:
+    passes = all_passes()
+    if name not in passes:
+        raise KeyError(f"unknown pass {name!r}; have {sorted(passes)}")
+    return passes[name]()
+
+
+class Context:
+    """Shared state across passes: cached ASTs (``ast_driver``) and
+    lowered programs (``jaxpr_driver`` — built lazily, so AST-only runs
+    never import jax or trace a model)."""
+
+    def __init__(self, repo_root: str = REPO_ROOT):
+        self.repo_root = repo_root
+        from . import ast_driver
+        self.ast = ast_driver.AstIndex(repo_root)
+        self._programs = None
+
+    @property
+    def programs(self):
+        """Lazily built ``jaxpr_driver.ProgramIndex`` over the REAL
+        TrainStep/InferStep programs (shared by every jaxpr pass — the
+        expensive trace happens once per run)."""
+        if self._programs is None:
+            from . import jaxpr_driver
+            self._programs = jaxpr_driver.ProgramIndex()
+        return self._programs
+
+
+class Baseline:
+    """Committed grandfather list: fingerprint -> reason.
+
+    A finding whose fingerprint is present is *suppressed* (reported
+    separately, never failing). Entries must carry a non-empty reason —
+    the workflow is "fix it, or explain why it stays"."""
+
+    def __init__(self, entries: Optional[Dict[str, dict]] = None,
+                 path: Optional[str] = None):
+        self.entries = dict(entries or {})
+        self.path = path
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        if not os.path.exists(path):
+            return cls(path=path)
+        with open(path) as f:
+            data = json.load(f)
+        entries = data.get("entries", {})
+        for fp, e in entries.items():
+            if not str(e.get("reason", "")).strip():
+                raise ValueError(
+                    f"baseline entry {fp} has no reason — every "
+                    "grandfathered violation must explain itself")
+        return cls(entries, path=path)
+
+    def reason(self, finding: Finding) -> Optional[str]:
+        e = self.entries.get(finding.fingerprint)
+        return e.get("reason") if e else None
+
+    def save(self, path: Optional[str] = None):
+        path = path or self.path
+        with open(path, "w") as f:
+            json.dump({"entries": self.entries}, f, indent=2,
+                      sort_keys=True)
+            f.write("\n")
+
+
+def run_passes(names: Optional[Iterable[str]] = None,
+               baseline: Optional[Baseline] = None,
+               ctx: Optional[Context] = None,
+               progress: Optional[Callable[[str], None]] = None):
+    """Run the named passes (default: all, AST/meta before jaxpr).
+
+    Returns ``(findings, suppressed)``: unbaselined findings and
+    ``(finding, reason)`` pairs the baseline grandfathered."""
+    registry = all_passes()
+    if names is None:
+        order = {"ast": 0, "meta": 1, "jaxpr": 2}
+        names = sorted(registry, key=lambda n: (order.get(
+            registry[n].ir, 9), n))
+    ctx = ctx or Context()
+    findings: List[Finding] = []
+    suppressed = []
+    for name in names:
+        p = get_pass(name)
+        if progress is not None:
+            progress(name)
+        for f in p.run(ctx):
+            reason = baseline.reason(f) if baseline is not None else None
+            if reason is not None:
+                suppressed.append((f, reason))
+            else:
+                findings.append(f)
+    return findings, suppressed

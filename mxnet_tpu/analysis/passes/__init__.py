@@ -1,0 +1,37 @@
+"""Pass modules: importing this package registers every pass.
+
+Current roster (3 ported + 4 new + 2 consistency):
+
+========================  =====  ==========================================
+pass                      IR     what it guards
+========================  =====  ==========================================
+``no-sync``               ast    jitted hot paths stay free of host syncs
+``amp-purity``            jaxpr  no fp32 master feeds a low-precision dot;
+                                 overflow-skip path sync-free
+``sharding-placement``    jaxpr  declared NamedShardings actually hold
+``lock-order``            ast    serving-plane deadlock cycles, blocking
+                                 calls under locks, unsynchronized shared
+                                 state across threads
+``donation``              both   donate_argnums consumed + aliasable; big
+                                 carried buffers donated; no host
+                                 use-after-donate
+``recompile-hazard``      both   traced-signature hygiene + RecompileGuard
+                                 cross-check (scalar churn, shape branches)
+``collective-placement``  both   no collectives in the decode path; host
+                                 allreduce gated on mesh_spans_processes()
+``env-vars``              meta   every MXTPU_*/MXNET_* read documented in
+                                 docs/ENV_VARS.md (and vice versa)
+``telemetry-names``       meta   every emitted metric family known to
+                                 tools/telemetry_report.py
+========================  =====  ==========================================
+"""
+
+from . import no_sync  # noqa: F401
+from . import amp_purity  # noqa: F401
+from . import sharding_placement  # noqa: F401
+from . import lock_order  # noqa: F401
+from . import donation  # noqa: F401
+from . import recompile  # noqa: F401
+from . import collectives  # noqa: F401
+from . import env_vars  # noqa: F401
+from . import telemetry_names  # noqa: F401

@@ -1,0 +1,125 @@
+"""collective-placement pass: collectives live where the design says.
+
+Two rules:
+
+- **decode-collective** (jaxpr) — the default serving layout (replicated
+  params, meshless engine) must dispatch ZERO collectives in the decode
+  programs (dense ``decode_n`` loop and paged ``decode_iter``): a
+  ``psum``/``all_gather`` smuggled into sampling or attention turns
+  every O(1) decode step into a cross-device barrier. (FSDP serving
+  legitimately gathers — that layout is exercised by the sharding tests;
+  this rule pins the DEFAULT path.)
+- **host-allreduce-guard** (AST) — the host-side gradient allreduce
+  (``Trainer._allreduce_grads`` KVStore loop, ``KVStoreDist`` push) must
+  never be reachable when the process-global mesh spans every worker:
+  in-graph psum owns gradient sync there, and the host loop double-sums
+  on top of it. The ``mesh_spans_processes()`` guard (PR 6) must
+  dominate both sites.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from ..core import AnalysisPass, register
+
+INFER_PY = "mxnet_tpu/parallel/infer.py"
+TRAINER_PY = "mxnet_tpu/gluon/trainer.py"
+KVDIST_PY = "mxnet_tpu/kvstore/kvstore_dist.py"
+
+COLLECTIVE_PRIMITIVES = {
+    "psum", "psum2", "all_gather", "all_reduce", "reduce_scatter",
+    "all_to_all", "ppermute", "pmin", "pmax", "pgather",
+}
+
+# (path, class, method, how) — how = "return-guard" (an
+# `if mesh_spans_processes(...): ... return` must appear before the
+# collective work) or "call-guard" (a guard helper must be called)
+GUARD_SITES = (
+    (TRAINER_PY, "Trainer", "_allreduce_grads", "return-guard"),
+    (KVDIST_PY, "KVStoreDist", "_push_impl", "call-guard"),
+)
+GUARD_NAMES = ("mesh_spans_processes", "_warn_if_mesh_owns_sync")
+
+
+def check_decode_collectives(programs) -> List[str]:
+    from .. import jaxpr_driver as _jd
+
+    msgs = []
+    _, decode_jaxpr, _, _ = programs.decode_programs()
+    _, decode_iter_jaxpr, _, _ = programs.paged_programs()
+    for label, jaxpr in (("decode_n loop", decode_jaxpr),
+                        ("decode_iter", decode_iter_jaxpr)):
+        hit = _jd.primitive_names(jaxpr) & COLLECTIVE_PRIMITIVES
+        if hit:
+            msgs.append(
+                f"InferStep {label}: collective primitive(s) "
+                f"{sorted(hit)} in the default (meshless) decode "
+                "program — every decode step becomes a cross-device "
+                "barrier")
+    return msgs
+
+
+def check_host_allreduce_guard(index, sites=GUARD_SITES) -> List[Tuple]:
+    out = []
+    for path, cls_name, meth, how in sites:
+        mod = index.module(path)
+        cls = mod.classes.get(cls_name)
+        fn = None
+        if cls is not None:
+            for n in cls.body:
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                        and n.name == meth:
+                    fn = n
+        if fn is None:
+            out.append((0, f"{cls_name}.{meth}:missing",
+                        f"{path}: {cls_name}.{meth} not found — update "
+                        "the collective-placement pass if the host "
+                        "allreduce moved"))
+            continue
+        guarded = False
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                name = getattr(node.func, "attr", None) or \
+                    getattr(node.func, "id", None)
+                if name in GUARD_NAMES:
+                    if how == "call-guard":
+                        guarded = True
+            if how == "return-guard" and isinstance(node, ast.If):
+                test_calls = [getattr(c.func, "attr", None)
+                              or getattr(c.func, "id", None)
+                              for c in ast.walk(node.test)
+                              if isinstance(c, ast.Call)]
+                if any(n in GUARD_NAMES for n in test_calls) and any(
+                        isinstance(s, ast.Return)
+                        for s in ast.walk(node)):
+                    guarded = True
+        if not guarded:
+            out.append((
+                fn.lineno, f"{cls_name}.{meth}:unguarded",
+                f"{path}: {cls_name}.{meth} runs the host allreduce "
+                "path without a mesh_spans_processes() guard — when the "
+                "mesh spans every process, in-graph psum already owns "
+                "gradient sync and this double-sums"))
+    return out
+
+
+@register
+class CollectivePlacementPass(AnalysisPass):
+    name = "collective-placement"
+    ir = "jaxpr"
+    description = ("no collectives in the default decode programs; host "
+                   "allreduce gated on mesh_spans_processes()")
+
+    def run(self, ctx):
+        findings = []
+        for ln, key, msg in check_host_allreduce_guard(ctx.ast):
+            findings.append(self.finding(
+                "host-allreduce-guard",
+                msg.split(":")[0], ln, key=key, message=msg))
+        for msg in check_decode_collectives(ctx.programs):
+            findings.append(self.finding(
+                "decode-collective", INFER_PY, 0, key=msg[:80],
+                message=msg))
+        return findings

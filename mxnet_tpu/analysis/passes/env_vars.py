@@ -1,0 +1,158 @@
+"""env-vars pass: the env surface and its documentation cannot drift.
+
+Statically collects every ``MXTPU_*``/``MXNET_*`` environment variable
+the code actually consults — ``os.environ.get/[]``, ``os.getenv``,
+``os.environ.setdefault``, the typed ``base.get_env``/``env_*`` helpers
+— across the package, tools, benchmarks and launch entry points, and
+diffs it against ``docs/ENV_VARS.md``:
+
+- a variable READ in code but absent from the doc is an undocumented
+  knob (operators cannot discover it);
+- a variable documented in a TABLE ROW but never consulted anywhere is
+  dead documentation (the knob silently stopped existing).
+
+Prefix rows like ``MXTPU_FAULT_<POINT>`` match any var starting with the
+prefix; the "n/a by design" prose section is ignored (those names are
+documented AS absent). Env WRITES (``os.environ["X"] = ...``) count as
+uses — a var one process sets for another to read is part of the
+surface.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Set
+
+from ..core import AnalysisPass, REPO_ROOT, register
+from .. import ast_driver as _ad
+
+DOC = "docs/ENV_VARS.md"
+SCAN_DIRS = ("mxnet_tpu", "tools", "benchmarks")
+SCAN_FILES = ("bench.py", "__graft_entry__.py", "tests/conftest.py")
+PREFIXES = ("MXTPU_", "MXNET_")
+
+ENV_HELPER_NAMES = {"get_env", "env_bool", "env_int", "env_str",
+                    "env_float", "getenv"}
+
+
+def _is_env_name(s) -> bool:
+    return isinstance(s, str) and s.startswith(PREFIXES)
+
+
+def collect_code_vars(index: _ad.AstIndex) -> Dict[str, List]:
+    """var -> [(path, lineno)] for every env consultation with a literal
+    MXTPU_/MXNET_ name."""
+    out: Dict[str, List] = {}
+    files = list(index.package_files(*SCAN_DIRS))
+    files += [f for f in SCAN_FILES
+              if os.path.exists(os.path.join(index.repo_root, f))]
+
+    def note(name, path, ln):
+        out.setdefault(name, []).append((path, ln))
+
+    for rel in files:
+        try:
+            mod = index.module(rel)
+        except SyntaxError:
+            continue
+        for node in ast.walk(mod.tree):
+            # os.environ.get("X") / os.environ.setdefault("X", ...) /
+            # os.getenv("X") / get_env("X", ...) / env_int("X", ...)
+            if isinstance(node, ast.Call) and node.args:
+                name = _ad.dotted(node.func) or ""
+                attr = name.rsplit(".", 1)[-1]
+                env_call = name.endswith(("environ.get",
+                                          "environ.setdefault")) or \
+                    attr.lstrip("_") in ENV_HELPER_NAMES
+                if env_call and isinstance(node.args[0], ast.Constant) \
+                        and _is_env_name(node.args[0].value):
+                    note(node.args[0].value, rel, node.lineno)
+            # os.environ["X"] (read or write)
+            if isinstance(node, ast.Subscript):
+                base = _ad.dotted(node.value) or ""
+                sl = node.slice
+                if base.endswith("environ") and \
+                        isinstance(sl, ast.Constant) and \
+                        _is_env_name(sl.value):
+                    note(sl.value, rel, node.lineno)
+            # prefix-style uses: "MXTPU_FAULT_" + point  /
+            # name.startswith("MXTPU_...") — recorded with the trailing
+            # underscore so they match prefix rows in the doc
+            if isinstance(node, ast.BinOp) and \
+                    isinstance(node.op, ast.Add) and \
+                    isinstance(node.left, ast.Constant) and \
+                    _is_env_name(node.left.value) and \
+                    node.left.value.endswith("_"):
+                note(node.left.value, rel, node.lineno)
+            if isinstance(node, ast.Call) and \
+                    getattr(node.func, "attr", None) == "startswith" \
+                    and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    _is_env_name(node.args[0].value):
+                note(node.args[0].value, rel, node.lineno)
+    return out
+
+
+def collect_doc_vars(repo_root: str = REPO_ROOT) -> Dict[str, int]:
+    """Documented vars from ENV_VARS.md TABLE ROWS only (the n/a prose
+    section documents absence, not presence): var -> line. A name ending
+    in ``_<...>`` or ``_*`` is a prefix row."""
+    out: Dict[str, int] = {}
+    with open(os.path.join(repo_root, DOC)) as f:
+        for i, line in enumerate(f, 1):
+            if not line.lstrip().startswith("|"):
+                continue
+            m = re.match(r"\s*\|\s*`([A-Z0-9_*<>]+)`?", line)
+            if not m:
+                continue
+            name = m.group(1)
+            name = re.sub(r"<[A-Z_]*>$", "", name).rstrip("*")
+            if name.startswith(PREFIXES):
+                out.setdefault(name, i)
+    return out
+
+
+def _doc_covers(var: str, doc_vars) -> bool:
+    if var in doc_vars:
+        return True
+    return any(d.endswith("_") and var.startswith(d) for d in doc_vars)
+
+
+def _code_covers(doc_var: str, code_vars: Set[str]) -> bool:
+    if doc_var in code_vars:
+        return True
+    if doc_var.endswith("_"):  # prefix row
+        return any(v.startswith(doc_var) for v in code_vars)
+    return False
+
+
+@register
+class EnvVarsPass(AnalysisPass):
+    name = "env-vars"
+    ir = "meta"
+    description = ("every MXTPU_*/MXNET_* env read is documented in "
+                   "docs/ENV_VARS.md, and nothing documented is dead")
+
+    def run(self, ctx):
+        findings = []
+        code = collect_code_vars(ctx.ast)
+        doc = collect_doc_vars(ctx.repo_root)
+        for var in sorted(code):
+            if not _doc_covers(var, doc):
+                path, ln = code[var][0]
+                findings.append(self.finding(
+                    "undocumented", path, ln, key=var,
+                    message=f"env var {var} is consulted at {path}:{ln} "
+                    f"(+{len(code[var]) - 1} more) but has no row in "
+                    f"{DOC} — operators cannot discover it"))
+        for var, ln in sorted(doc.items()):
+            if not _code_covers(var, set(code)):
+                findings.append(self.finding(
+                    "dead-doc", DOC, ln, key=var,
+                    message=f"env var {var} is documented ({DOC}:{ln}) "
+                    "but nothing in the package/tools consults it — "
+                    "dead documentation (remove the row or restore the "
+                    "knob)"))
+        return findings

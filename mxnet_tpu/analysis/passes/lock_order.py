@@ -1,0 +1,437 @@
+"""lock-order pass: a compositional race/deadlock detector for the
+threaded serving plane (in the spirit of RacerD: per-method summaries,
+no whole-program interleaving exploration).
+
+Scope: ``serving/`` + ``telemetry/watchdog.py`` — the code that runs a
+dispatcher/monitor thread against caller-side ``submit``/``stop`` APIs.
+Three rules:
+
+- **deadlock-cycle** — build the lock-acquisition graph (lock held ->
+  lock acquired, interprocedural through ``self.m()`` calls) across the
+  module set; any cycle is a potential deadlock, including a plain
+  ``Lock`` re-acquired while held (self-deadlock).
+- **blocking-under-lock** — ``future.result()``, ``thread.join()``,
+  ``queue.get()``, ``Event.wait()``, ``time.sleep()``, device syncs
+  (``block_until_ready``/``asnumpy``) or an engine dispatch
+  (``decode_n``/``decode_iter``/``prefill_paged``/``warmup``) while
+  holding a lock stalls every thread contending for it — the exact shape
+  of the hung-replica incidents the router's health scoring exists to
+  catch. ``cond.wait()`` on the *held* condition is legal (it releases).
+- **shared-state** — an attribute written without a lock in one thread
+  domain (worker = reachable from a ``threading.Thread(target=...)``
+  entry; caller = reachable from the public API) while the other domain
+  also writes or *iterates* it. Plain scalar loads are ignored
+  (CPython-atomic); iterating reads (``for``/``sorted``/``list``/...)
+  are flagged because a concurrent ``append`` corrupts them. Thread-safe
+  containers (``queue.Queue``, ``threading.Event``...) are exempt, as is
+  ``__init__``-only setup.
+
+Limitations (documented, deliberate): receiver types are not chased
+across objects — ``rep.batcher.submit(...)`` is matched by attribute
+name only, and per-class analysis does not see writes to *other*
+objects' attributes. Precise enough for this package's code shapes;
+violations the model cannot prove safe (e.g. writes that only happen
+after ``Thread.join``) live in the committed baseline with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisPass, register
+from .. import ast_driver as _ad
+
+MODULES = (
+    "mxnet_tpu/serving/batcher.py",
+    "mxnet_tpu/serving/router.py",
+    "mxnet_tpu/serving/watcher.py",
+    "mxnet_tpu/serving/faults.py",
+    "mxnet_tpu/serving/pages.py",
+    "mxnet_tpu/telemetry/watchdog.py",
+)
+
+LOCK_TYPES = {"threading.Lock", "threading.RLock", "threading.Condition"}
+REENTRANT_TYPES = {"threading.RLock"}
+# objects with internal synchronization: mutating them without an outer
+# lock is safe, so they are exempt from the shared-state rule
+THREADSAFE_TYPES = {
+    "threading.Event", "threading.Thread", "threading.Semaphore",
+    "threading.BoundedSemaphore", "threading.Barrier",
+    "queue.Queue", "queue.SimpleQueue", "queue.LifoQueue",
+    "queue.PriorityQueue",
+} | LOCK_TYPES
+
+# attribute-name matched blocking calls (receiver-agnostic)
+BLOCKING_ATTRS = {"result", "join", "block_until_ready", "asnumpy",
+                  "item", "tolist", "acquire"}
+# engine dispatches: firing (or compiling) a device program while
+# holding a host lock couples every contending thread to device latency
+DISPATCH_ATTRS = {"decode_n", "decode_iter", "prefill_paged", "warmup"}
+QUALIFIED_BLOCKING = {"time.sleep", "jax.block_until_ready"}
+
+PUBLIC_DUNDERS = {"__call__", "__enter__", "__exit__", "__iter__",
+                  "__next__"}
+
+LockId = Tuple[str, str]  # (class name, attr name)
+
+
+class ClassConcurrency:
+    """Per-class summaries: locks, thread domains, per-method lock and
+    blocking facts — the compositional unit of the analysis."""
+
+    def __init__(self, model: _ad.ClassModel):
+        self.model = model
+        self.name = model.name
+        self.locks: Dict[str, str] = {}       # attr -> lock ctor
+        self.threadsafe: Set[str] = set()     # attrs with internal sync
+        self.worker_entries: Set[str] = set()
+        self.edges: List[Tuple[LockId, LockId, str, int]] = []
+        # (method, lineno, message, held) for blocking calls under a lock
+        self.blocking: List[Tuple[str, int, str, Tuple[str, ...]]] = []
+        self.acquires: Dict[str, Set[str]] = {}   # method -> lock attrs
+        # method -> [(lineno, message)] blocking calls ANYWHERE in it
+        self.blocks_in: Dict[str, List[Tuple[int, str]]] = {}
+        self.calls: Dict[str, Set[str]] = {}      # self-call graph
+        self.locked_lines: Dict[str, Set[int]] = {}
+        self.held_at: Dict[str, Dict[int, Tuple[str, ...]]] = {}
+        self._scan_attrs()
+        for mname, (fn, mod) in self.model.methods.items():
+            self.calls[mname] = set()
+            self.acquires[mname] = set()
+            self.blocks_in[mname] = []
+            self.locked_lines[mname] = set()
+            self.held_at[mname] = {}
+            # repo convention: a ``*_locked`` method runs with the
+            # class's lock already held by its caller
+            held0: Tuple[str, ...] = ()
+            if mname.endswith("_locked") and self.locks:
+                held0 = (sorted(self.locks)[0],)
+            for stmt in fn.body:
+                self._visit(mname, mod, stmt, held0)
+        self._find_thread_entries()
+        self.worker_set = self._closure(self.worker_entries)
+        public = {n for n in self.model.methods
+                  if (not n.startswith("_")) or n in PUBLIC_DUNDERS}
+        self.caller_set = self._closure(public)
+        self.setup_set = ({"__init__"} | self._closure({"__init__"})) \
+            - self.worker_set - self.caller_set | {"__init__"}
+
+    # ------------------------------------------------------------ scanning
+    def _scan_attrs(self):
+        for mname, (fn, _mod) in self.model.methods.items():
+            for node in ast.walk(fn):
+                if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+                    continue
+                if not isinstance(node.value, ast.Call):
+                    continue
+                ctor = _ad.dotted(node.value.func)
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    attr = _ad.self_attr(t)
+                    if attr is None:
+                        continue
+                    if ctor in LOCK_TYPES:
+                        self.locks[attr] = ctor
+                    if ctor in THREADSAFE_TYPES:
+                        self.threadsafe.add(attr)
+
+    def _lock_of(self, expr) -> Optional[str]:
+        attr = _ad.self_attr(expr)
+        return attr if attr is not None and attr in self.locks else None
+
+    def _visit(self, mname, mod, node, held: Tuple[str, ...]):
+        """One recursive walk per method tracking the held-lock tuple."""
+        ln = getattr(node, "lineno", None)
+        if ln is not None:
+            if held:
+                self.locked_lines[mname].add(ln)
+            prev = self.held_at[mname].get(ln, ())
+            if len(held) >= len(prev):
+                self.held_at[mname][ln] = held
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new: List[str] = []
+            for item in node.items:
+                self._visit(mname, mod, item.context_expr,
+                            held + tuple(new))
+                lk = self._lock_of(item.context_expr)
+                if lk is not None:
+                    for h in held + tuple(new):
+                        self.edges.append((
+                            (self.name, h), (self.name, lk),
+                            f"{mod.path}:{self.name}.{mname}",
+                            node.lineno))
+                    if lk in held and \
+                            self.locks[lk] not in REENTRANT_TYPES:
+                        self.edges.append((
+                            (self.name, lk), (self.name, lk),
+                            f"{mod.path}:{self.name}.{mname}",
+                            node.lineno))
+                    new.append(lk)
+                    self.acquires[mname].add(lk)
+            for stmt in node.body:
+                self._visit(mname, mod, stmt, held + tuple(new))
+            return
+        if isinstance(node, ast.Call):
+            self._check_call(mname, node, held)
+        for child in ast.iter_child_nodes(node):
+            self._visit(mname, mod, child, held)
+
+    def _check_call(self, mname, call, held):
+        attr = _ad.self_attr(call.func)
+        if attr is not None and attr in self.model.methods:
+            self.calls[mname].add(attr)
+        msg = self._blocking_reason(call, held)
+        if msg is not None:
+            self.blocks_in[mname].append((call.lineno, msg))
+            if held:
+                self.blocking.append((mname, call.lineno, msg,
+                                      tuple(held)))
+
+    def _blocking_reason(self, call, held) -> Optional[str]:
+        f = call.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        name = _ad.dotted(f)
+        if name in QUALIFIED_BLOCKING:
+            return f"{name}(...) stalls the thread"
+        if f.attr == "wait":
+            recv = _ad.self_attr(f.value)
+            if recv is not None and recv in held and \
+                    self.locks.get(recv) == "threading.Condition":
+                return None  # cond.wait on the held condition releases it
+            return f"{name or '.' + f.attr}(...) blocks until signaled"
+        if f.attr == "get":
+            recv = _ad.dotted(f.value) or ""
+            if "queue" in recv.lower():
+                kwargs = {k.arg for k in call.keywords}
+                if "timeout" in kwargs or (not call.args and not kwargs):
+                    return f"{recv}.get(...) blocks on the queue"
+            return None
+        if f.attr in BLOCKING_ATTRS:
+            return f".{f.attr}() blocks (device sync / thread wait)"
+        if f.attr in DISPATCH_ATTRS:
+            return (f".{f.attr}(...) fires a device dispatch — device "
+                    "latency while holding a host lock")
+        return None
+
+    def _find_thread_entries(self):
+        for mname, (fn, _mod) in self.model.methods.items():
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        _ad.dotted(node.func) == "threading.Thread":
+                    for kw in node.keywords:
+                        if kw.arg == "target":
+                            t = _ad.self_attr(kw.value)
+                            if t is not None:
+                                self.worker_entries.add(t)
+
+    # ------------------------------------------------------------ summaries
+    def _closure(self, roots: Set[str]) -> Set[str]:
+        seen: Set[str] = set()
+        stack = [r for r in roots if r in self.model.methods]
+        while stack:
+            m = stack.pop()
+            if m in seen:
+                continue
+            seen.add(m)
+            stack.extend(c for c in self.calls.get(m, ())
+                         if c in self.model.methods and c not in seen)
+        return seen
+
+    def transitive_acquires(self, mname: str) -> Set[str]:
+        out: Set[str] = set()
+        for m in self._closure({mname}):
+            out |= self.acquires.get(m, set())
+        return out
+
+    def transitive_blocking(self, mname: str) -> List[Tuple[int, str]]:
+        out = []
+        for m in self._closure({mname}):
+            out.extend(self.blocks_in.get(m, ()))
+        return out
+
+    def domains_of(self, mname: str) -> Set[str]:
+        out = set()
+        if mname in self.worker_set:
+            out.add("worker")
+        if mname in self.caller_set:
+            out.add("caller")
+        return out
+
+
+def _interprocedural(cc: ClassConcurrency):
+    """Held-lock -> callee-acquired-lock edges and blocking-via-self-call
+    findings, using the per-method summaries."""
+    for mname, (fn, mod) in cc.model.methods.items():
+        if not cc.locked_lines.get(mname):
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = _ad.self_attr(node.func)
+            if callee is None or callee not in cc.model.methods:
+                continue
+            held = cc.held_at[mname].get(node.lineno, ())
+            if not held:
+                continue
+            for lk in cc.transitive_acquires(callee):
+                for h in held:
+                    cc.edges.append((
+                        (cc.name, h), (cc.name, lk),
+                        f"{mod.path}:{cc.name}.{mname} -> "
+                        f"self.{callee}()", node.lineno))
+            for ln, msg in cc.transitive_blocking(callee):
+                cc.blocking.append((
+                    mname, node.lineno,
+                    f"self.{callee}() {msg} (line {ln})", tuple(held)))
+
+
+def _find_cycles(edges):
+    """Cycles in the lock graph: self-loops (non-reentrant re-acquire)
+    plus multi-lock SCCs (Tarjan)."""
+    adj: Dict[LockId, Set[LockId]] = {}
+    where: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
+    for a, b, site, ln in edges:
+        if a == b:
+            # recorded only for deliberate non-reentrant re-acquisition
+            adj.setdefault(a, set()).add(b)
+        else:
+            adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+        where.setdefault((a, b), (site, ln))
+    cycles = []
+    for a in adj:
+        if a in adj[a]:
+            cycles.append(([a, a], [where[(a, a)]]))
+    index: Dict[LockId, int] = {}
+    low: Dict[LockId, int] = {}
+    on: Set[LockId] = set()
+    stack: List[LockId] = []
+    counter = [0]
+    sccs = []
+
+    def strongconnect(v):
+        index[v] = low[v] = counter[0]
+        counter[0] += 1
+        stack.append(v)
+        on.add(v)
+        for w in adj.get(v, ()):
+            if w not in index:
+                strongconnect(w)
+                low[v] = min(low[v], low[w])
+            elif w in on:
+                low[v] = min(low[v], index[w])
+        if low[v] == index[v]:
+            comp = []
+            while True:
+                w = stack.pop()
+                on.discard(w)
+                comp.append(w)
+                if w == v:
+                    break
+            if len(comp) > 1:
+                sccs.append(comp)
+
+    for v in list(adj):
+        if v not in index:
+            strongconnect(v)
+    for comp in sccs:
+        sites = [where[(a, b)] for a in comp for b in adj.get(a, ())
+                 if b in comp and (a, b) in where]
+        cycles.append((comp, sites))
+    return cycles
+
+
+def _shared_state(cc: ClassConcurrency):
+    """The shared-state rule over one class."""
+    if not cc.worker_entries:
+        return []  # no background thread: nothing to race against
+    out = []
+    accesses: Dict[str, list] = {}
+    for mname, (fn, mod) in cc.model.methods.items():
+        domains = cc.domains_of(mname)
+        if not domains or mname in cc.setup_set:
+            continue
+        fm = _ad.FunctionModel(fn, mod)
+        locked = cc.locked_lines.get(mname, set())
+        for attr, ln, kind in fm.self_stores():
+            if attr in cc.locks or attr in cc.threadsafe or \
+                    attr.startswith("__"):
+                continue
+            accesses.setdefault(attr, []).append(
+                (mname, ln, "write", ln in locked, domains))
+        for attr, ln, iterated in fm.self_loads():
+            if not iterated or attr in cc.locks or \
+                    attr in cc.threadsafe or attr.startswith("__"):
+                continue
+            accesses.setdefault(attr, []).append(
+                (mname, ln, "iter-read", ln in locked, domains))
+    for attr, acc in sorted(accesses.items()):
+        domains = set().union(*(a[4] for a in acc))
+        writes = [a for a in acc if a[2] == "write"]
+        unlocked = [a for a in acc if not a[3]]
+        if domains >= {"worker", "caller"} and writes and unlocked:
+            sites = ", ".join(
+                f"{m}:{ln} ({kind}{'' if lk else ' unlocked'} "
+                f"{'/'.join(sorted(doms))})"
+                for m, ln, kind, lk, doms in acc[:6])
+            out.append((cc.model.module.path, unlocked[0][1], cc.name,
+                        attr,
+                        f"{cc.name}.{attr} is accessed from both the "
+                        f"dispatcher thread and callers with at least "
+                        f"one unsynchronized access: {sites}"))
+    return out
+
+
+def analyze(index: _ad.AstIndex, rel_paths=MODULES):
+    """Run the full analysis; returns (cycles, blocking, shared) where
+    blocking = [(path, line, class, method, msg, held)] and shared =
+    [(path, line, class, attr, msg)]."""
+    models = index.classes_in(list(rel_paths))
+    wanted = set(rel_paths)
+    all_edges = []
+    blocking = []
+    shared = []
+    for cname, model in sorted(models.items()):
+        if model.module.path not in wanted:
+            continue
+        cc = ClassConcurrency(model)
+        _interprocedural(cc)
+        all_edges.extend(cc.edges)
+        for mname, ln, msg, held in cc.blocking:
+            blocking.append((cc.model.methods[mname][1].path, ln, cname,
+                             mname, msg, "+".join(sorted(set(held)))))
+        shared.extend(_shared_state(cc))
+    return _find_cycles(all_edges), blocking, shared
+
+
+@register
+class LockOrderPass(AnalysisPass):
+    name = "lock-order"
+    ir = "ast"
+    description = ("serving-plane deadlock cycles, blocking calls under "
+                   "locks, unsynchronized cross-thread state")
+
+    def run(self, ctx):
+        findings = []
+        cycles, blocking, shared = analyze(ctx.ast)
+        for comp, sites in cycles:
+            locks = " -> ".join(f"{c}.{a}" for c, a in comp)
+            site, ln = sites[0] if sites else (MODULES[0], 0)
+            findings.append(self.finding(
+                "deadlock-cycle", site.split(":")[0], ln, key=locks,
+                message=f"lock acquisition cycle {locks} — threads "
+                "taking these locks in different orders can deadlock "
+                f"(first edge at {site}:{ln})"))
+        for path, ln, cname, mname, msg, held in blocking:
+            findings.append(self.finding(
+                "blocking-under-lock", path, ln,
+                key=f"{cname}.{mname}:{msg[:50]}",
+                message=f"{cname}.{mname} holds [{held}] while: {msg}"))
+        for path, ln, cname, attr, msg in shared:
+            findings.append(self.finding(
+                "shared-state", path, ln, key=f"{cname}.{attr}",
+                message=msg))
+        return findings

@@ -1,0 +1,138 @@
+"""no-sync pass: the jitted hot paths must never block on the device.
+
+Port of ``tools/check_no_sync_in_step.py`` (PR 2/5/8) onto the pass
+framework — same rule sets, same targets, same assertions. Any host
+synchronization (``.asnumpy()``, ``float(loss)``, ``np.asarray`` on a
+device array, ``block_until_ready``, ``time.sleep``) inside a dispatch
+path silently serializes the pipeline against the device; this walks the
+AST of the listed (file, class, methods) targets and flags blocking
+calls. The tool remains as a thin CLI shim importing from here.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+from ..core import AnalysisPass, REPO_ROOT, register
+
+STEP_PY = "mxnet_tpu/parallel/step.py"
+INFER_PY = "mxnet_tpu/parallel/infer.py"
+BATCHER_PY = "mxnet_tpu/serving/batcher.py"
+
+# the train-step fast-path bodies: __call__ (DeviceBatch detection +
+# dispatch) and _dispatch (the staged-operand hot dispatch). _stage is
+# deliberately NOT linted — it is the slow path the fast path skips.
+FAST_PATH_FUNCS = ("__call__", "_dispatch")
+
+# every linted (file, class, methods) hot path. The inference engine's
+# decode_n is the whole generation dispatch and decode_iter/prefill_paged
+# are the continuous-batching iteration dispatches; the batchers'
+# _dispatch methods assemble and fire batches (DynamicBatcher._resolve /
+# ContinuousBatcher._collect+_admit are the designated sync points and
+# stay unlinted). ContinuousBatcher._step_once — the scheduler loop body
+# — is linted too: its syncs must stay delegated to those named phases,
+# never inlined next to a dispatch.
+TARGETS = (
+    (STEP_PY, "TrainStep", FAST_PATH_FUNCS),
+    (INFER_PY, "InferStep", ("__call__", "_dispatch", "decode_n",
+                             "decode_iter", "prefill_paged")),
+    (BATCHER_PY, "DynamicBatcher", ("_dispatch",)),
+    (BATCHER_PY, "ContinuousBatcher", ("_dispatch", "_step_once")),
+)
+
+# method attributes that force a device->host readback / host sync
+BLOCKING_ATTRS = {
+    "asnumpy", "asscalar", "item", "tolist", "block_until_ready",
+    "copy_to_host_async",
+}
+# bare builtins that coerce a device scalar on the host
+BLOCKING_BUILTINS = {"float", "int", "bool", "complex", "print"}
+# module.attr calls that materialize device arrays on host (np.asarray on
+# a device array round-trips it) or stall the thread
+BLOCKING_QUALIFIED = {
+    ("np", "asarray"), ("_np", "asarray"), ("numpy", "asarray"),
+    ("np", "array"), ("_np", "array"), ("numpy", "array"),
+    ("jax", "device_get"), ("time", "sleep"), ("_time", "sleep"),
+}
+
+
+def blocking_calls_in(fn: ast.FunctionDef, label: str):
+    """[(lineno, message)] for blocking calls anywhere in ``fn``."""
+    out = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if isinstance(f, ast.Name) and f.id in BLOCKING_BUILTINS:
+            out.append((node.lineno,
+                        f"{label}: host coercion {f.id}(...) blocks on "
+                        "the device value"))
+        elif isinstance(f, ast.Attribute):
+            if f.attr in BLOCKING_ATTRS:
+                out.append((node.lineno,
+                            f"{label}: .{f.attr}() forces a device->host "
+                            "sync"))
+            elif isinstance(f.value, ast.Name) and \
+                    (f.value.id, f.attr) in BLOCKING_QUALIFIED:
+                out.append((node.lineno,
+                            f"{label}: {f.value.id}.{f.attr}(...) "
+                            "materializes/stalls on host"))
+    return out
+
+
+def find_violations(path=None, class_name: str = "TrainStep",
+                    funcs=FAST_PATH_FUNCS):
+    """Return [(lineno, message)] for blocking calls inside the given
+    class's listed method bodies (tool-compatible entry point; ``path``
+    may be absolute or repo-relative)."""
+    if path is None:
+        path = os.path.join(REPO_ROOT, STEP_PY)
+    elif not os.path.isabs(path):
+        path = os.path.join(REPO_ROOT, path)
+    with open(path) as f:
+        tree = ast.parse(f.read(), filename=path)
+    out = []
+    classes = [n for n in tree.body
+               if isinstance(n, ast.ClassDef) and n.name == class_name]
+    if not classes:
+        return [(0, f"{class_name} class not found in {path}")]
+    fns = [n for n in classes[0].body
+           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+           and n.name in funcs]
+    missing = set(funcs) - {f.name for f in fns}
+    if missing:
+        out.append((classes[0].lineno,
+                    f"{class_name} hot-path method(s) {sorted(missing)} "
+                    "not found — update TARGETS if the hot path was "
+                    "renamed"))
+    for fn in fns:
+        out.extend(blocking_calls_in(fn, f"{class_name}.{fn.name}"))
+    return sorted(out)
+
+
+def find_all_violations():
+    """Lint every TARGETS entry; returns [(path, lineno, message)]."""
+    out = []
+    for path, cls, funcs in TARGETS:
+        for lineno, msg in find_violations(path, cls, funcs):
+            out.append((path, lineno, msg))
+    return out
+
+
+@register
+class NoSyncPass(AnalysisPass):
+    name = "no-sync"
+    ir = "ast"
+    description = ("jitted train/inference/serving hot paths stay free "
+                   "of blocking host syncs")
+
+    def run(self, ctx):
+        findings = []
+        for path, cls, funcs in TARGETS:
+            for lineno, msg in find_violations(path, cls, funcs):
+                findings.append(self.finding(
+                    "blocking-call", path, lineno,
+                    key=msg.split(":")[0] + ":" + msg.split(":", 2)[-1][:60],
+                    message=msg))
+        return findings

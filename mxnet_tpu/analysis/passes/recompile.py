@@ -1,0 +1,226 @@
+"""recompile-hazard pass: nothing in the hot paths may churn traced
+signatures.
+
+PR 3 made execution shape-stable (bucketing + AOT warmup + the
+RecompileGuard); this pass guards the invariants that keep it that way:
+
+- **cfg-hygiene** (AST) — the host-side config normalizers
+  (``_decode_cfg``/``_paged_cfg``/any ``*_cfg``) key the jitted-program
+  caches; a ``float(...)`` element (e.g. temperature) would mint a new
+  program per VALUE. Config keys must stay int/str. Temperature & co.
+  belong in TRACED operands (``jnp.float32(x)``), not cache keys.
+- **traced-shape-branch** (AST) — an ``if``/``while`` on ``.shape`` /
+  ``len(...)`` inside a traced closure (the functions built in
+  ``_build``/``_build_forward``/``_get_*_fn``) silently compiles a
+  different program per shape variant; shape policy belongs in the
+  bucketing layer. Host entropy (``time.*``/``random.*``) inside a
+  traced closure is baked in at trace time — also flagged.
+- **guard-accounting** (AST) — every dispatch method that fetches a
+  jitted program (``self._get_*_fn``/``self._fwd_fn``/
+  ``self._step_fn``) must route through ``compile_guard.observe`` first;
+  an unaccounted dispatch is invisible to the recompile alarm.
+- **guard-crosscheck** (runtime) — drive the REAL engine twice with
+  identical shapes but different Python scalar knobs (temperature):
+  the RecompileGuard signature count and the jitted-program cache must
+  not grow — the executable cross-check that the two AST rules stay
+  honest.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Tuple
+
+from ..core import AnalysisPass, register
+from .. import ast_driver as _ad
+
+STEP_PY = "mxnet_tpu/parallel/step.py"
+INFER_PY = "mxnet_tpu/parallel/infer.py"
+
+# builders whose NESTED functions are traced closures
+TRACED_BUILDERS = {
+    STEP_PY: ("_build",),
+    INFER_PY: ("_build_forward", "_get_prefill_fn", "_get_decode_fn",
+               "_get_paged_prefill_fn", "_get_decode_iter_fn"),
+}
+
+# dispatch methods that must account their signatures with the guard
+GUARDED_DISPATCHES = {
+    INFER_PY: ("_dispatch", "decode_n", "prefill_paged", "decode_iter"),
+    STEP_PY: ("_dispatch",),
+}
+
+HOST_ENTROPY_PREFIXES = ("time.", "random.", "np.random.", "_np.random.",
+                         "numpy.random.")
+
+
+def check_cfg_hygiene(module: _ad.Module) -> List[Tuple]:
+    out = []
+    for cls in module.classes.values():
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) or \
+                    not fn.name.endswith("_cfg"):
+                continue
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id == "float":
+                    out.append((
+                        node.lineno,
+                        f"{cls.name}.{fn.name}:float",
+                        f"{cls.name}.{fn.name} coerces a config element "
+                        "with float(...) — a float in a program-cache "
+                        "key compiles a new program per VALUE; pass it "
+                        "as a traced operand (jnp.float32) instead"))
+                if isinstance(node, ast.Return):
+                    for e in ast.walk(node):
+                        if isinstance(e, ast.Constant) and \
+                                isinstance(e.value, float):
+                            out.append((
+                                node.lineno,
+                                f"{cls.name}.{fn.name}:float-literal",
+                                f"{cls.name}.{fn.name} returns a float "
+                                "literal in a config key — non-weak-type "
+                                "literal churn"))
+    return out
+
+
+def check_traced_closures(module: _ad.Module, builders) -> List[Tuple]:
+    out = []
+    for cls in module.classes.values():
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) or \
+                    fn.name not in builders:
+                continue
+            closures = [n for n in ast.walk(fn)
+                        if isinstance(n, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef))
+                        and n is not fn]
+            for c in closures:
+                for node in ast.walk(c):
+                    if isinstance(node, (ast.If, ast.While)):
+                        test = node.test
+                        hazard = any(
+                            (isinstance(s, ast.Attribute)
+                             and s.attr == "shape")
+                            or (isinstance(s, ast.Call)
+                                and isinstance(s.func, ast.Name)
+                                and s.func.id == "len")
+                            for s in ast.walk(test))
+                        if hazard:
+                            out.append((
+                                node.lineno,
+                                f"{fn.name}.{c.name}:shape-branch",
+                                f"traced closure {c.name} (in {fn.name}) "
+                                "branches on .shape/len() — each shape "
+                                "variant silently compiles another "
+                                "program; bucket shapes at the input "
+                                "layer instead"))
+                    if isinstance(node, ast.Call):
+                        name = _ad.dotted(node.func) or ""
+                        if name.startswith(HOST_ENTROPY_PREFIXES):
+                            out.append((
+                                node.lineno,
+                                f"{fn.name}.{c.name}:host-entropy",
+                                f"traced closure {c.name} calls {name} — "
+                                "the value is frozen at trace time, not "
+                                "per step"))
+    return out
+
+
+def check_guard_accounting(module: _ad.Module, dispatches) -> List[Tuple]:
+    out = []
+    for cls in module.classes.values():
+        for fn in cls.body:
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)) or \
+                    fn.name not in dispatches:
+                continue
+            fetches = False
+            observes = False
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = _ad.dotted(node.func) or ""
+                if name.startswith("self._get_") and name.endswith("_fn") \
+                        or name in ("self._fwd_fn", "self._step_fn"):
+                    fetches = True
+                if ".observe" in name and "guard" in name.lower() or \
+                        name.endswith("compile_guard.observe"):
+                    observes = True
+            if fetches and not observes:
+                out.append((
+                    fn.lineno, f"{cls.name}.{fn.name}:unaccounted",
+                    f"{cls.name}.{fn.name} dispatches a jitted program "
+                    "without compile_guard.observe(...) — its signatures "
+                    "are invisible to the recompile alarm"))
+    return out
+
+
+def run_guard_crosscheck(programs) -> List[str]:
+    """Executable cross-check on the real engine: same shapes + changed
+    Python scalar knobs must not grow the signature set or the program
+    cache."""
+    import numpy as np
+
+    msgs = []
+    eng = programs.infer_engine
+    src = np.zeros((2, 8), np.int32)
+    vl = np.full((2,), 8, np.int32)
+    eng.decode_n(src, vl, max_new_tokens=4)
+    sigs = eng.compile_guard.signatures
+    progs = len(eng._decode_fns)
+    eng.decode_n(src, vl, max_new_tokens=4, temperature=0.7)
+    eng.decode_n(src, vl, max_new_tokens=4, temperature=0.31)
+    if eng.compile_guard.signatures != sigs:
+        msgs.append(
+            "InferStep.decode_n: changing temperature at fixed shapes "
+            f"grew the signature set ({sigs} -> "
+            f"{eng.compile_guard.signatures}) — a Python scalar is "
+            "leaking into the traced signature")
+    if len(eng._decode_fns) != progs:
+        msgs.append(
+            "InferStep.decode_n: changing temperature minted "
+            f"{len(eng._decode_fns) - progs} new jitted program(s) — "
+            "temperature must stay out of the program-cache key")
+    # repeating the identical call must be signature-stable too
+    again = eng.compile_guard.signatures
+    eng.decode_n(src, vl, max_new_tokens=4)
+    if eng.compile_guard.signatures != again:
+        msgs.append(
+            "InferStep.decode_n: re-dispatching the identical prompt "
+            "signature grew the RecompileGuard signature set — "
+            "signature accounting is unstable")
+    return msgs
+
+
+@register
+class RecompileHazardPass(AnalysisPass):
+    name = "recompile-hazard"
+    ir = "jaxpr"
+    description = ("config keys stay int/str, traced closures free of "
+                   "shape branches/host entropy, dispatches guard-"
+                   "accounted, runtime guard cross-check")
+
+    def run(self, ctx):
+        findings = []
+        for path in (STEP_PY, INFER_PY):
+            mod = ctx.ast.module(path)
+            for ln, key, msg in check_cfg_hygiene(mod):
+                findings.append(self.finding("cfg-hygiene", path, ln,
+                                             key=key, message=msg))
+            for ln, key, msg in check_traced_closures(
+                    mod, TRACED_BUILDERS[path]):
+                findings.append(self.finding("traced-shape-branch", path,
+                                             ln, key=key, message=msg))
+            for ln, key, msg in check_guard_accounting(
+                    mod, GUARDED_DISPATCHES[path]):
+                findings.append(self.finding("guard-accounting", path,
+                                             ln, key=key, message=msg))
+        for msg in run_guard_crosscheck(ctx.programs):
+            findings.append(self.finding(
+                "guard-crosscheck", INFER_PY, 0, key=msg[:80],
+                message=msg))
+        return findings

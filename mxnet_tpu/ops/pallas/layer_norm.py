@@ -23,6 +23,15 @@ from jax.experimental import pallas as pl
 
 
 def _use_interpret() -> bool:
+    """``MXTPU_FLASH_INTERPRET``: force (``1``) or forbid (``0``) Pallas
+    interpret mode; default ``auto`` interprets off-TPU (CPU testing)."""
+    import os
+
+    v = os.environ.get("MXTPU_FLASH_INTERPRET", "").strip().lower()
+    if v in ("1", "true", "force", "on"):
+        return True
+    if v in ("0", "false", "off"):
+        return False
     return jax.default_backend() != "tpu"
 
 

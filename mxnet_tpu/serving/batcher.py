@@ -658,6 +658,12 @@ class ContinuousBatcher(_BatcherBase):
         self._pending = collections.deque()
         self._seq = 0
         self._iter = 0
+        # stats + the rolling-wait window are written by the scheduler
+        # thread AND by submit-side admission control (caller threads);
+        # every touch goes through this lock — an unsynchronized
+        # sorted() over the deque while the scheduler appends raises
+        # "deque mutated during iteration" (mxlint lock-order pass)
+        self._stats_lock = threading.Lock()
         self.stats = {"iterations": 0, "occupancy_sum": 0.0,
                       "admitted": 0, "retired": 0, "preempted": 0,
                       "rejected": 0, "tokens": 0}
@@ -713,15 +719,18 @@ class ContinuousBatcher(_BatcherBase):
         if self._queue.qsize() + len(self._pending) >= self._admit_max_queue:
             reason = (f"queue depth {self._queue.qsize()} >= "
                       f"{self._admit_max_queue} (MXTPU_ADMIT_MAX_QUEUE)")
-        elif self._admit_max_wait_ms > 0 and len(self._recent_waits) >= 8:
-            waits = sorted(self._recent_waits)
-            p50 = waits[len(waits) // 2]
-            if p50 > self._admit_max_wait_ms:
-                reason = (f"queue wait p50 {p50:.0f} ms > "
-                          f"{self._admit_max_wait_ms:.0f} ms "
-                          "(MXTPU_ADMIT_MAX_WAIT_MS)")
+        elif self._admit_max_wait_ms > 0:
+            with self._stats_lock:
+                waits = sorted(self._recent_waits)
+            if len(waits) >= 8:
+                p50 = waits[len(waits) // 2]
+                if p50 > self._admit_max_wait_ms:
+                    reason = (f"queue wait p50 {p50:.0f} ms > "
+                              f"{self._admit_max_wait_ms:.0f} ms "
+                              "(MXTPU_ADMIT_MAX_WAIT_MS)")
         if reason is not None:
-            self.stats["rejected"] += 1
+            with self._stats_lock:
+                self.stats["rejected"] += 1
             _tel.registry().counter("infer/rejected_backpressure").inc()
             fut._fail(Backpressure(
                 f"{self._label()} rejected the request: {reason}"))
@@ -814,7 +823,8 @@ class ContinuousBatcher(_BatcherBase):
                 r.future.weights_version = s.version
                 r.future.replica = self.name
                 r.future._resolve(list(s.emitted))
-            self.stats["retired"] += 1
+            with self._stats_lock:
+                self.stats["retired"] += 1
             reg.counter("infer/requests").inc()
             reg.counter("infer/tokens").inc(len(s.emitted))
 
@@ -889,7 +899,8 @@ class ContinuousBatcher(_BatcherBase):
             s.emitted.append(s.carry)
             self._slots[slot] = s
             r.future.queue_wait_ms = (t0 - r.future.enqueued_at) * 1e3
-            self._recent_waits.append(r.future.queue_wait_ms)
+            with self._stats_lock:
+                self._recent_waits.append(r.future.queue_wait_ms)
             reg.histogram("infer/queue_wait_ms").observe(
                 max(r.future.queue_wait_ms, 0.0))
             r.future._stream_tokens([s.carry])
@@ -897,7 +908,8 @@ class ContinuousBatcher(_BatcherBase):
                 (r.future.first_token_at - r.future.enqueued_at) * 1e3)
             if s.carry == self._engine._eos or len(s.emitted) >= r.max_new:
                 s.finished = True
-        self.stats["admitted"] += len(picked)
+        with self._stats_lock:
+            self.stats["admitted"] += len(picked)
         return len(picked)
 
     def _ensure_capacity(self, live):
@@ -920,7 +932,8 @@ class ContinuousBatcher(_BatcherBase):
                 if not victims:
                     # nothing left to preempt: this request cannot make
                     # progress right now — bounce it back to the caller
-                    self.stats["rejected"] += 1
+                    with self._stats_lock:
+                        self.stats["rejected"] += 1
                     _tel.registry().counter(
                         "infer/rejected_backpressure").inc()
                     s.req.future._fail(Backpressure(
@@ -943,7 +956,8 @@ class ContinuousBatcher(_BatcherBase):
         self._slots[slot] = None
         s.req.future._stream_reset()
         self._pending.appendleft(s.req)
-        self.stats["preempted"] += 1
+        with self._stats_lock:
+            self.stats["preempted"] += 1
         _tel.registry().counter("infer/preempted").inc()
 
     def _dispatch(self, live):
@@ -995,9 +1009,10 @@ class ContinuousBatcher(_BatcherBase):
             emitted_total += len(fresh)
             s.req.future._stream_tokens(fresh)
         occupancy = len(live) / self.slots
-        self.stats["iterations"] += 1
-        self.stats["occupancy_sum"] += occupancy
-        self.stats["tokens"] += emitted_total
+        with self._stats_lock:
+            self.stats["iterations"] += 1
+            self.stats["occupancy_sum"] += occupancy
+            self.stats["tokens"] += emitted_total
         reg.gauge("infer/batch_occupancy").set(occupancy)
         reg.gauge("infer/pages_in_use").set(self.pool.pages_in_use)
         reg.gauge("infer/page_fragmentation").set(self.pool.fragmentation(
@@ -1029,5 +1044,6 @@ class ContinuousBatcher(_BatcherBase):
     def sustained_occupancy(self) -> float:
         """Mean decode-batch occupancy across every iteration so far —
         the open-loop bench's headline gate (>= 0.9 under load)."""
-        n = self.stats["iterations"]
-        return self.stats["occupancy_sum"] / n if n else 0.0
+        with self._stats_lock:
+            n = self.stats["iterations"]
+            return self.stats["occupancy_sum"] / n if n else 0.0

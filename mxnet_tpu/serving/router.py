@@ -222,7 +222,7 @@ class Router:
             if not r.outer.done():
                 r.outer._fail(RuntimeError("router stopped"))
         if stop_replicas:
-            for rep in self._replicas:
+            for rep in self._replica_snapshot():
                 try:
                     rep.batcher.stop(drain=False, timeout=1.0)
                 except Exception:  # noqa: BLE001 - teardown best-effort
@@ -238,13 +238,14 @@ class Router:
 
     @property
     def replicas(self) -> list:
-        return list(self._replicas)
+        return self._replica_snapshot()
 
     @property
     def engines(self) -> list:
         """Live engines (for ``CheckpointWatcher`` wiring: one watcher
         hot-swaps every replica)."""
-        return [rep.engine for rep in self._replicas if not rep.evicted]
+        return [rep.engine for rep in self._replica_snapshot()
+                if not rep.evicted]
 
     # ------------------------------------------------------------- requests
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
@@ -261,7 +262,7 @@ class Router:
         r = _Routed(prompt_ids, max_new_tokens, deadline, outer)
         _tel.registry().counter("serve/requests").inc()
         with self._lock:
-            if not self._assign_locked(r) and not self._may_recover():
+            if not self._assign_locked(r) and not self._may_recover_locked():
                 outer._fail(RuntimeError(
                     "no healthy replicas and no replica_factory — "
                     "request cannot be placed"))
@@ -269,12 +270,21 @@ class Router:
             self._inflight.append(r)
         return outer
 
-    def _may_recover(self) -> bool:
+    def _may_recover_locked(self) -> bool:
         """Whether waiting could produce a healthy replica: a respawn
         factory exists, or some replica is merely degraded (not
-        evicted) and may come back fresh."""
+        evicted) and may come back fresh. Runs under the router lock
+        (submit holds it)."""
         return self._factory is not None or any(
             not rep.evicted for rep in self._replicas)
+
+    def _replica_snapshot(self) -> list:
+        """Coherent copy of the replica list for lock-free iteration:
+        ``_respawn`` appends from the monitor thread while callers read
+        ``replicas``/``engines`` — iterating the live list unlocked is
+        the torn-read shape the mxlint lock-order pass flags."""
+        with self._lock:
+            return list(self._replicas)
 
     def _assign_locked(self, r: _Routed) -> bool:
         """Place ``r`` on the lightest-loaded healthy replica; False when
@@ -309,13 +319,14 @@ class Router:
             self._request_pass(now)
 
     def _health_pass(self, now):
-        for rep in list(self._replicas):
+        reps = self._replica_snapshot()
+        for rep in reps:
             if rep.evicted:
                 continue
             ok, reason = rep.health()
             if not ok:
                 self._evict(rep, reason)
-        healthy = sum(1 for rep in self._replicas if rep.healthy)
+        healthy = sum(1 for rep in reps if rep.healthy)
         _tel.registry().gauge("serve/replicas_healthy").set(healthy)
         if self._factory is not None and self._respawn_at is not None \
                 and now >= self._respawn_at:
@@ -389,7 +400,8 @@ class Router:
                         "(re)placed on a healthy replica"))
                     done.append(r)
                 elif now - r.created > self.no_replica_timeout_s \
-                        and not any(rep.healthy for rep in self._replicas):
+                        and not any(rep.healthy
+                                    for rep in self._replica_snapshot()):
                     reg.counter("serve/dropped").inc()
                     r.outer._fail(RuntimeError(
                         f"no healthy replica within "

@@ -80,6 +80,11 @@ class CheckpointWatcher:
         self.on_swap = on_swap
         self._seen: Optional[str] = None
         self.last_error: Optional[BaseException] = None
+        # poll_once is both the background thread's body and a public
+        # API (tests/manual swaps drive it directly): the lock keeps two
+        # concurrent polls from double-staging one checkpoint and makes
+        # the _seen/last_error writes coherent (mxlint lock-order pass)
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread = None
         if start:
@@ -124,7 +129,12 @@ class CheckpointWatcher:
         """One poll: find the newest committed checkpoint; if it is new,
         load + stage + flip every engine. Returns the new version tag, or
         None (nothing new, or the swap failed and the old weights keep
-        serving)."""
+        serving). Serialized: a caller-driven poll and the background
+        thread never stage the same checkpoint twice."""
+        with self._lock:
+            return self._poll_once_locked()
+
+    def _poll_once_locked(self) -> Optional[str]:
         found = _cs.latest_committed(self.directory)
         if found is None:
             return None

@@ -1,0 +1,730 @@
+"""Tier-1 wiring for mxlint, the unified static-analysis framework
+(``mxnet_tpu/analysis/`` + ``tools/mxlint.py``).
+
+Absorbs the three pre-framework lint tests — test_no_sync_lint.py,
+test_amp_purity.py, test_sharding_lint.py — keeping their full case
+coverage, and adds the violation self-tests for the four new passes
+(lock-order, donation, recompile-hazard, collective-placement) plus the
+two consistency passes (env-vars, telemetry-names): every pass gets a
+seeded positive control (synthetic deadlock cycle, use-after-donate,
+recompile hazard, unguarded host allreduce...) and a clean negative
+control, and the WHOLE suite must run green at HEAD (modulo the
+committed baseline) inside the runtime budget.
+"""
+
+import json
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from mxnet_tpu.analysis import (  # noqa: E402
+    Baseline, Context, Finding, all_passes, get_pass, run_passes,
+)
+from mxnet_tpu.analysis import ast_driver, jaxpr_driver  # noqa: E402
+from mxnet_tpu.analysis.passes import (  # noqa: E402
+    amp_purity, collectives, donation, env_vars, lock_order, no_sync,
+    recompile, sharding_placement, telemetry_names,
+)
+
+BASELINE_PATH = os.path.join(REPO, "tools", "mxlint_baseline.json")
+
+ALL_PASSES = {"no-sync", "amp-purity", "sharding-placement", "lock-order",
+              "donation", "recompile-hazard", "collective-placement",
+              "env-vars", "telemetry-names"}
+
+
+@pytest.fixture(scope="module")
+def ctx():
+    """One shared Context: the jaxpr passes reuse its cached real
+    TrainStep/InferStep programs (built once per module)."""
+    return Context()
+
+
+@pytest.fixture(scope="module")
+def sharding_setup():
+    return sharding_placement.build_default_setup()
+
+
+def _write_module(tmp_path, source, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(source))
+    return ast_driver.AstIndex(str(tmp_path)), name
+
+
+# ================================================================ framework
+class TestFramework:
+    def test_registry_has_the_full_roster(self):
+        assert set(all_passes()) == ALL_PASSES
+
+    def test_fingerprint_excludes_line_numbers(self):
+        a = Finding("p", "r", "x/y.py", 10, "K", "m1")
+        b = Finding("p", "r", "x/y.py", 99, "K", "reworded")
+        assert a.fingerprint == b.fingerprint
+        assert a.fingerprint != Finding("p", "r", "x/y.py", 10, "K2",
+                                        "m1").fingerprint
+
+    def test_baseline_requires_reasons(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"entries": {"x": {"reason": ""}}}))
+        with pytest.raises(ValueError):
+            Baseline.load(str(p))
+
+    def test_baseline_suppresses_by_fingerprint(self):
+        f = Finding("p", "r", "x.py", 1, "K", "m")
+        b = Baseline({f.fingerprint: {"reason": "known"}})
+        assert b.reason(f) == "known"
+        assert b.reason(Finding("p", "r", "x.py", 1, "other", "m")) is None
+
+    def test_full_suite_green_at_head_within_budget(self, ctx):
+        """THE acceptance gate: all passes, real programs, committed
+        baseline — zero unbaselined findings, well under the 60 s
+        budget."""
+        t0 = time.perf_counter()
+        findings, suppressed = run_passes(
+            baseline=Baseline.load(BASELINE_PATH), ctx=ctx)
+        elapsed = time.perf_counter() - t0
+        assert not findings, "\n".join(repr(f) for f in findings)
+        for f, reason in suppressed:
+            assert reason.strip()
+        assert elapsed < 60.0, f"lint suite took {elapsed:.1f}s"
+
+    def test_cli_json_output(self, capsys):
+        import mxlint
+
+        rc = mxlint.main(["--passes", "no-sync,env-vars,telemetry-names",
+                          "--json"])
+        out = json.loads(capsys.readouterr().out)
+        assert rc == 0 and out["ok"] is True
+        assert out["passes_run"] == ["no-sync", "env-vars",
+                                     "telemetry-names"]
+
+    def test_cli_lists_passes(self, capsys):
+        import mxlint
+
+        assert mxlint.main(["--list"]) == 0
+        listed = capsys.readouterr().out
+        for name in ALL_PASSES:
+            assert name in listed
+
+
+# ============================================== no-sync (ported coverage)
+class TestNoSync:
+    def test_fast_path_is_sync_free(self):
+        violations = no_sync.find_violations()
+        assert not violations, "\n".join(
+            f"step.py:{ln}: {msg}" for ln, msg in violations)
+
+    def test_all_hot_paths_are_sync_free(self):
+        violations = no_sync.find_all_violations()
+        assert not violations, "\n".join(
+            f"{path}:{ln}: {msg}" for path, ln, msg in violations)
+
+    def test_targets_cover_inference_engine(self):
+        covered = {(os.path.basename(p), cls): set(funcs)
+                   for p, cls, funcs in no_sync.TARGETS}
+        assert "decode_n" in covered[("infer.py", "InferStep")]
+        assert "_dispatch" in covered[("batcher.py", "DynamicBatcher")]
+
+    def test_targets_cover_continuous_batching(self):
+        covered = {(os.path.basename(p), cls): set(funcs)
+                   for p, cls, funcs in no_sync.TARGETS}
+        assert "decode_iter" in covered[("infer.py", "InferStep")]
+        assert "prefill_paged" in covered[("infer.py", "InferStep")]
+        cont = covered[("batcher.py", "ContinuousBatcher")]
+        assert "_dispatch" in cont
+        assert "_step_once" in cont  # the scheduler loop body
+
+    def test_lint_catches_a_violation(self, tmp_path):
+        bad = tmp_path / "step_bad.py"
+        bad.write_text(
+            "class TrainStep:\n"
+            "    def __call__(self, x):\n"
+            "        return float(self._dispatch(x))\n"
+            "    def _dispatch(self, x):\n"
+            "        return x.asnumpy()\n"
+        )
+        violations = no_sync.find_violations(str(bad))
+        assert len(violations) == 2
+        assert any("float" in m for _, m in violations)
+        assert any("asnumpy" in m for _, m in violations)
+
+    def test_lint_catches_decode_violation(self, tmp_path):
+        bad = tmp_path / "infer_bad.py"
+        bad.write_text(
+            "class InferStep:\n"
+            "    def decode_n(self, src):\n"
+            "        import jax\n"
+            "        out = self._fn(src)\n"
+            "        jax.block_until_ready(out)\n"
+            "        return out\n"
+        )
+        violations = no_sync.find_violations(
+            str(bad), "InferStep", ("decode_n",))
+        assert len(violations) == 1
+        assert "block_until_ready" in violations[0][1]
+
+    def test_lint_catches_decode_iter_violation(self, tmp_path):
+        bad = tmp_path / "infer_bad_paged.py"
+        bad.write_text(
+            "class InferStep:\n"
+            "    def decode_iter(self, state, tables, tokens):\n"
+            "        buf, state = self._fn(state, tables, tokens)\n"
+            "        return buf.asnumpy(), state\n"
+            "    def prefill_paged(self, state, src):\n"
+            "        tok0, state = self._fn(state, src)\n"
+            "        return int(tok0[0]), state\n"
+        )
+        violations = no_sync.find_violations(
+            str(bad), "InferStep", ("decode_iter", "prefill_paged"))
+        assert len(violations) == 2
+        assert any("asnumpy" in m for _, m in violations)
+        assert any("int" in m for _, m in violations)
+
+    def test_lint_catches_scheduler_loop_violation(self, tmp_path):
+        bad = tmp_path / "batcher_bad.py"
+        bad.write_text(
+            "import time\n"
+            "class ContinuousBatcher:\n"
+            "    def _step_once(self):\n"
+            "        time.sleep(0.01)\n"
+            "        return True\n"
+            "    def _dispatch(self, live):\n"
+            "        out = self._engine.decode_iter(live)\n"
+            "        return out[0].tolist()\n"
+        )
+        violations = no_sync.find_violations(
+            str(bad), "ContinuousBatcher", ("_step_once", "_dispatch"))
+        assert len(violations) == 2
+        assert any("sleep" in m for _, m in violations)
+        assert any("tolist" in m for _, m in violations)
+
+
+# =========================================== amp-purity (ported coverage)
+class TestAmpPurity:
+    def test_amp_step_has_no_mixed_dots(self, ctx):
+        violations = amp_purity.check_step_purity(
+            jaxpr=ctx.programs.train_jaxpr)
+        assert not violations, "\n".join(violations)
+
+    def test_overflow_skip_path_is_sync_free(self):
+        violations = amp_purity.find_overflow_sync_violations()
+        assert not violations, "\n".join(
+            f"step.py:{ln}: {msg}" for ln, msg in violations)
+
+    def test_lint_detects_a_mixed_dot(self):
+        import jax
+        import jax.numpy as jnp
+
+        # mixed dot written deliberately: f32 x bf16
+        def worse(w32, x16):
+            return jax.lax.dot_general(
+                w32, x16, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32).sum()
+
+        jaxpr = jax.make_jaxpr(worse)(
+            jax.ShapeDtypeStruct((4, 8), jnp.float32),
+            jax.ShapeDtypeStruct((8, 4), jnp.bfloat16))
+        assert jaxpr_driver.find_mixed_dots(jaxpr)
+
+    def test_lint_detects_a_sync_in_traced_closure(self, tmp_path):
+        bad = tmp_path / "step_bad.py"
+        bad.write_text(
+            "class TrainStep:\n"
+            "    def _build(self, donate):\n"
+            "        n = float(self._optimizer.wd)  # host-side: legal\n"
+            "        def step(vals):\n"
+            "            return float(vals)  # traced closure: violation\n"
+            "        return step\n"
+        )
+        violations = amp_purity.find_overflow_sync_violations(str(bad))
+        assert len(violations) == 1
+        assert "float" in violations[0][1]
+
+
+# ==================================== sharding-placement (ported coverage)
+class TestShardingPlacement:
+    def test_sharding_lint_passes(self, sharding_setup):
+        violations = sharding_placement.run_checks(*sharding_setup)
+        assert not violations, "\n".join(violations)
+
+    def test_lint_flags_inert_rule(self, sharding_setup):
+        from mxnet_tpu.parallel import sharding as shard
+        from mxnet_tpu.parallel import PartitionSpec as P
+
+        mesh, _, _, _, _, shapes = sharding_setup
+        bad = shard.ShardingRules.fsdp(min_size=32, rules=[
+            (r"matches_nothing$", P("data"))])
+        violations = sharding_placement.check_rules_coverage(
+            bad, shapes, mesh)
+        assert any("matched NO parameter" in v for v in violations)
+
+    def test_lint_flags_indivisible_fsdp(self, sharding_setup):
+        from mxnet_tpu.parallel import sharding as shard
+
+        mesh = sharding_setup[0]
+        rules = shard.ShardingRules.fsdp(min_size=8)
+        violations = sharding_placement.check_rules_coverage(
+            rules, {"odd_weight": (7, 9)}, mesh)
+        assert any("silently fully replicated" in v for v in violations)
+
+    def test_lint_flags_fully_replicated_fsdp(self, sharding_setup):
+        from mxnet_tpu.parallel import sharding as shard
+
+        mesh = sharding_setup[0]
+        rules = shard.ShardingRules.fsdp(min_size=10**9)
+        violations = sharding_placement.check_rules_coverage(
+            rules, {"w": (64, 16)}, mesh)
+        assert any("partitioned NOTHING" in v for v in violations)
+
+    def test_lint_detects_misplacement(self, sharding_setup):
+        import jax
+        from jax.sharding import NamedSharding
+        from mxnet_tpu.parallel import PartitionSpec as P
+
+        mesh, rules, step, eng, batch, shapes = sharding_setup
+        name = next(n for n in step._train_vals
+                    if step._param_sharding(n).spec != P())
+        orig = step._train_vals[name]
+        try:
+            step._train_vals[name] = jax.device_put(
+                jax.numpy.asarray(orig), NamedSharding(mesh, P()))
+            violations = sharding_placement.check_step_placement(step)
+            assert any(name in v for v in violations)
+        finally:
+            step._train_vals[name] = orig
+
+
+# ================================================= lock-order self-tests
+def _analyze(tmp_path, source):
+    index, name = _write_module(tmp_path, source)
+    return lock_order.analyze(index, [name])
+
+
+class TestLockOrder:
+    def test_detects_two_lock_deadlock_cycle(self, tmp_path):
+        """Acceptance: a seeded two-lock cycle in serving-plane shape."""
+        cycles, _, _ = _analyze(tmp_path, """
+            import threading
+            class Router:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._hb_lock = threading.Lock()
+                def submit(self, r):
+                    with self._lock:
+                        with self._hb_lock:
+                            return r
+                def _health_pass(self):
+                    with self._hb_lock:
+                        with self._lock:
+                            return 1
+            """)
+        assert cycles, "two-lock cycle not detected"
+        locks = {f"{c}.{a}" for comp, _ in cycles for c, a in comp}
+        assert {"Router._lock", "Router._hb_lock"} <= locks
+
+    def test_detects_self_deadlock(self, tmp_path):
+        cycles, _, _ = _analyze(tmp_path, """
+            import threading
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def poke(self):
+                    with self._lock:
+                        with self._lock:
+                            pass
+            """)
+        assert any(len(comp) == 2 and comp[0] == comp[1]
+                   for comp, _ in cycles)
+
+    def test_detects_blocking_dispatch_under_lock(self, tmp_path):
+        """Acceptance: a blocking engine dispatch fired under a lock."""
+        _, blocking, _ = _analyze(tmp_path, """
+            import threading
+            class Batcher:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def fire(self, reqs, fut):
+                    with self._lock:
+                        out = self._engine.decode_n(reqs)
+                        return fut.result()
+            """)
+        msgs = [m for _, _, _, _, m, _ in blocking]
+        assert any("decode_n" in m for m in msgs)
+        assert any("result" in m for m in msgs)
+
+    def test_detects_blocking_via_self_call(self, tmp_path):
+        _, blocking, _ = _analyze(tmp_path, """
+            import threading, time
+            class B:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def outer(self):
+                    with self._lock:
+                        self._inner()
+                def _inner(self):
+                    time.sleep(1.0)
+            """)
+        assert any("_inner" in m for _, _, _, _, m, _ in blocking)
+
+    def test_cond_wait_on_held_condition_is_legal(self, tmp_path):
+        _, blocking, _ = _analyze(tmp_path, """
+            import threading
+            class R:
+                def __init__(self):
+                    self._cond = threading.Condition()
+                def wait_tokens(self):
+                    with self._cond:
+                        self._cond.wait(1.0)
+            """)
+        assert not blocking
+
+    def test_detects_unsynchronized_shared_state(self, tmp_path):
+        _, _, shared = _analyze(tmp_path, """
+            import threading
+            class B:
+                def __init__(self):
+                    self.stats = {}
+                    self._thread = threading.Thread(target=self._run)
+                def _run(self):
+                    self.stats["n"] = 1
+                def submit(self):
+                    return sorted(self.stats)
+            """)
+        assert any(attr == "stats" for _, _, _, attr, _ in shared)
+
+    def test_locked_writes_are_clean(self, tmp_path):
+        cycles, blocking, shared = _analyze(tmp_path, """
+            import threading
+            class B:
+                def __init__(self):
+                    self.stats = {}
+                    self._lock = threading.Lock()
+                    self._thread = threading.Thread(target=self._run)
+                def _run(self):
+                    with self._lock:
+                        self.stats["n"] = 1
+                def submit(self):
+                    with self._lock:
+                        return sorted(self.stats)
+            """)
+        assert not cycles and not blocking and not shared
+
+    def test_serving_plane_at_head_only_baselined_findings(self, ctx):
+        findings = get_pass("lock-order").run(ctx)
+        baseline = Baseline.load(BASELINE_PATH)
+        fresh = [f for f in findings if baseline.reason(f) is None]
+        assert not fresh, "\n".join(repr(f) for f in fresh)
+        # the two grandfathered single-writer findings stay visible
+        assert {f.key for f in findings} <= {
+            "ContinuousBatcher._pending", "ContinuousBatcher._slots"}
+
+
+# ================================================== donation self-tests
+class TestDonation:
+    def test_real_modules_satisfy_contract(self, ctx):
+        for path, req in ((donation.STEP_PY, donation.REQUIRED_STEP),
+                          (donation.INFER_PY, donation.REQUIRED_INFER)):
+            out = donation.check_contract(ctx.ast.module(path), req, path)
+            assert not out, out
+
+    def test_contract_catches_missing_donation(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            import jax
+            class TrainStep:
+                def _build(self):
+                    def step(train_vals, opt_state, batch, key, t):
+                        return train_vals, opt_state, key, t
+                    return jax.jit(step, donate_argnums=(0,))
+            """)
+        out = donation.check_contract(
+            index.module(name), donation.REQUIRED_STEP, name)
+        assert any("opt_state" in m for _, _, m in out)
+
+    def test_contract_catches_forbidden_donation(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            import jax
+            class TrainStep:
+                def _build(self):
+                    def step(train_vals, opt_state, batch, key, t):
+                        return train_vals, opt_state, key, t
+                    return jax.jit(step, donate_argnums=(0, 1, 2, 3, 4))
+            """)
+        out = donation.check_contract(
+            index.module(name), donation.REQUIRED_STEP, name)
+        assert any("batch" in m for _, _, m in out)
+
+    def test_catches_host_read_of_donated_pool_after_decode_iter(
+            self, tmp_path):
+        """Acceptance: a seeded host read of a donated pool after
+        decode_iter."""
+        index, name = _write_module(tmp_path, """
+            class Batcher:
+                def _dispatch(self, live):
+                    buf, self._state = self._engine.decode_iter(
+                        self._state, self.tables, live)
+                    return buf
+                def _peek(self):
+                    out = self._engine.decode_iter(self._state, self.t, 1)
+                    pool = self._state["k_pools"]
+                    return out, pool
+            """)
+        out = donation.check_use_after_donate(index.module(name))
+        assert any("use-after" in key for _, key, _ in out)
+        # the rebind-in-same-statement pattern (_dispatch) is NOT flagged
+        assert not any("_dispatch" in key for _, key, _ in out)
+
+    def test_catches_lost_carry(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            class Batcher:
+                def _fire(self, live):
+                    buf = self._engine.decode_iter(self._state, live)
+                    return buf
+            """)
+        out = donation.check_use_after_donate(index.module(name))
+        assert any("lost" in key for _, key, _ in out)
+
+    def test_serving_scheduler_clean_at_head(self, ctx):
+        out = donation.check_use_after_donate(
+            ctx.ast.module(donation.BATCHER_PY))
+        assert not out, out
+
+    def test_real_programs_donations_consumed_and_aliasable(self, ctx):
+        msgs = donation.run_jaxpr_checks(ctx.programs)
+        assert not msgs, "\n".join(msgs)
+
+
+# ========================================== recompile-hazard self-tests
+class TestRecompileHazard:
+    def test_real_modules_clean(self, ctx):
+        for path in (recompile.STEP_PY, recompile.INFER_PY):
+            mod = ctx.ast.module(path)
+            assert not recompile.check_cfg_hygiene(mod)
+            assert not recompile.check_traced_closures(
+                mod, recompile.TRACED_BUILDERS[path])
+            assert not recompile.check_guard_accounting(
+                mod, recompile.GUARDED_DISPATCHES[path])
+
+    def test_catches_float_in_cfg_key(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            class InferStep:
+                def _decode_cfg(self, max_new, method, temperature):
+                    return int(max_new), str(method), float(temperature)
+            """)
+        out = recompile.check_cfg_hygiene(index.module(name))
+        assert any("float" in key for _, key, _ in out)
+
+    def test_catches_shape_branch_in_traced_closure(self, tmp_path):
+        """Acceptance: a seeded recompile hazard."""
+        index, name = _write_module(tmp_path, """
+            class InferStep:
+                def _get_decode_fn(self, cfg):
+                    def decode(values, state, tokens):
+                        if len(tokens) > 4:
+                            return state
+                        return values
+                    return decode
+            """)
+        out = recompile.check_traced_closures(
+            index.module(name), ("_get_decode_fn",))
+        assert any("shape-branch" in key for _, key, _ in out)
+
+    def test_catches_host_entropy_in_traced_closure(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            import time
+            class InferStep:
+                def _get_decode_fn(self, cfg):
+                    def decode(values, tokens):
+                        return values * time.time()
+                    return decode
+            """)
+        out = recompile.check_traced_closures(
+            index.module(name), ("_get_decode_fn",))
+        assert any("host-entropy" in key for _, key, _ in out)
+
+    def test_catches_unaccounted_dispatch(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            class InferStep:
+                def decode_n(self, src):
+                    fn = self._get_decode_fn(4)
+                    return fn(self._values, src)
+            """)
+        out = recompile.check_guard_accounting(
+            index.module(name), ("decode_n",))
+        assert any("unaccounted" in key for _, key, _ in out)
+
+    def test_guard_crosscheck_on_real_engine(self, ctx):
+        msgs = recompile.run_guard_crosscheck(ctx.programs)
+        assert not msgs, "\n".join(msgs)
+
+
+# ===================================== collective-placement self-tests
+class TestCollectivePlacement:
+    def test_decode_programs_dispatch_no_collectives(self, ctx):
+        """Acceptance: no psum/all_gather in the default decode path."""
+        msgs = collectives.check_decode_collectives(ctx.programs)
+        assert not msgs, "\n".join(msgs)
+
+    def test_collective_primitives_are_detectable(self):
+        import jax
+
+        jaxpr = jax.make_jaxpr(
+            lambda x: jax.lax.psum(x, "i"), axis_env=[("i", 2)])(1.0)
+        hit = jaxpr_driver.primitive_names(jaxpr) & \
+            collectives.COLLECTIVE_PRIMITIVES
+        assert "psum" in hit
+
+    def test_host_allreduce_guards_present_at_head(self, ctx):
+        out = collectives.check_host_allreduce_guard(ctx.ast)
+        assert not out, out
+
+    def test_catches_unguarded_host_allreduce(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            class Trainer:
+                def _allreduce_grads(self):
+                    for k in self._grad_keys:
+                        self._kvstore.push(k, self._grads[k])
+                        self._kvstore.pull(k, self._grads[k])
+            """)
+        out = collectives.check_host_allreduce_guard(
+            index, sites=((name, "Trainer", "_allreduce_grads",
+                           "return-guard"),))
+        assert any("unguarded" in key for _, key, _ in out)
+
+
+# ============================================= env-vars / telemetry-names
+class TestConsistencyPasses:
+    def test_env_vars_consistent_at_head(self, ctx):
+        findings = get_pass("env-vars").run(ctx)
+        assert not findings, "\n".join(repr(f) for f in findings)
+
+    def test_detects_undocumented_and_dead_vars(self, tmp_path):
+        (tmp_path / "mxnet_tpu").mkdir()
+        (tmp_path / "mxnet_tpu" / "mod.py").write_text(
+            "import os\n"
+            "A = os.environ.get('MXTPU_SECRET_KNOB', '1')\n")
+        (tmp_path / "docs").mkdir()
+        (tmp_path / "docs" / "ENV_VARS.md").write_text(
+            "| `MXTPU_GHOST_KNOB` | `1` | long gone |\n")
+        index = ast_driver.AstIndex(str(tmp_path))
+        code = env_vars.collect_code_vars(index)
+        doc = env_vars.collect_doc_vars(str(tmp_path))
+        assert "MXTPU_SECRET_KNOB" in code
+        assert not env_vars._doc_covers("MXTPU_SECRET_KNOB", doc)
+        assert not env_vars._code_covers("MXTPU_GHOST_KNOB", set(code))
+
+    def test_prefix_rows_cover_prefix_uses(self):
+        doc = {"MXTPU_FAULT_": 1}
+        assert env_vars._doc_covers("MXTPU_FAULT_BATCHER_HANG", doc)
+        assert env_vars._code_covers("MXTPU_FAULT_",
+                                     {"MXTPU_FAULT_", "MXTPU_X"})
+
+    def test_telemetry_names_consistent_at_head(self, ctx):
+        findings = get_pass("telemetry-names").run(ctx)
+        assert not findings, "\n".join(repr(f) for f in findings)
+
+    def test_report_tool_declares_every_emitted_family(self, ctx):
+        metrics, spans = telemetry_names.collect_emissions(ctx.ast)
+        known_m, known_s, _ = telemetry_names.declared_families(ctx.ast)
+        assert set(metrics) <= known_m
+        assert set(spans) <= known_s
+
+
+# ===================================== regression tests for fixed races
+class TestServingRaceFixes:
+    def test_admission_control_races_scheduler_safely(self, ctx):
+        """PR fix: ContinuousBatcher.stats/_recent_waits are written by
+        the scheduler thread and read by submit-side admission control;
+        unsynchronized, sorted() over the live deque raises 'deque
+        mutated during iteration'. Hammer admission from several caller
+        threads while the scheduler streams decodes."""
+        from mxnet_tpu.serving.batcher import ContinuousBatcher
+
+        eng = ctx.programs.infer_engine
+        b = ContinuousBatcher(eng, bucket_keys=(8,), slots=2,
+                              max_new_tokens=4,
+                              admit_max_wait_ms=10_000.0)
+        errors = []
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(3, 60, (5,)).astype(np.int32)
+                   for _ in range(24)]
+
+        def feed(chunk):
+            try:
+                futs = [b.submit(p) for p in chunk]
+                for f in futs:
+                    try:
+                        f.result(timeout=120)
+                    except Exception:  # noqa: BLE001 - Backpressure ok
+                        pass
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+
+        threads = [threading.Thread(target=feed, args=(prompts[i::4],))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=180)
+        b.stop()
+        assert not errors, errors
+        with b._stats_lock:
+            assert b.stats["retired"] + b.stats["rejected"] >= 1
+
+    def test_watcher_concurrent_polls_swap_once(self, ctx, tmp_path):
+        """PR fix: poll_once is serialized — N concurrent polls of one
+        newly committed checkpoint produce exactly ONE swap (previously
+        both threads could pass the token check and double-stage)."""
+        from mxnet_tpu import checkpoint_sharded as cs
+        from mxnet_tpu.serving import CheckpointWatcher
+
+        eng = ctx.programs.infer_engine
+        cs.save_sharded(
+            str(tmp_path),
+            {n: p._data.data
+             for n, p in eng._net.collect_params().items()})
+        swaps = []
+        w = CheckpointWatcher(eng, str(tmp_path), start=False,
+                              on_swap=lambda v, p: swaps.append(v))
+        results = []
+        barrier = threading.Barrier(4)
+
+        def poll():
+            barrier.wait()
+            results.append(w.poll_once())
+
+        threads = [threading.Thread(target=poll) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert sum(1 for r in results if r is not None) == 1
+        assert len(swaps) == 1
+
+    def test_router_replica_list_reads_are_snapshots(self, ctx):
+        """PR fix: Router._replicas iteration sites read a lock-held
+        snapshot (the lock-order pass verifies statically; this pins
+        the helper's behavior)."""
+        findings = get_pass("lock-order").run(ctx)
+        assert not any(f.key == "Router._replicas" for f in findings)
+
+
+# ==================================================== tool shim compat
+class TestToolShims:
+    def test_shims_reexport_the_framework(self):
+        import check_amp_purity
+        import check_no_sync_in_step
+        import check_sharding
+
+        assert check_no_sync_in_step.find_violations is \
+            no_sync.find_violations
+        assert check_amp_purity.check_step_purity is \
+            amp_purity.check_step_purity
+        assert check_sharding.run_checks is sharding_placement.run_checks

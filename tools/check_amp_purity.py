@@ -1,192 +1,28 @@
 #!/usr/bin/env python
 """AMP purity lint: mixed precision must stay pure end to end.
 
-Two checks, both run by the tier-1 suite (``tests/test_amp_purity.py``):
-
-1. **jaxpr check — no fp32 master feeds a low-precision dot.** Builds a
-   tiny ``TrainStep(amp='bfloat16')`` over a transformer layer and walks
-   the step program's jaxpr (recursing into pjit/scan/cond/remat
-   sub-jaxprs): any ``dot_general`` whose two operands mix float32 with
-   bfloat16/float16 means a master weight (or an un-downcast activation,
-   e.g. a norm output that stopped being dtype-preserving) reached an
-   MXU op without its cast — the exact bug class the reference's
-   cast-insertion pass (``low_precision_pass.cc``) existed to prevent.
-   Uniform-f32 dots are legal (optimizer math, losses); only MIXED dots
-   are flagged.
-
-2. **AST check — no host sync in the overflow-skip path.** The
-   fp16 loss-scaling contract is that overflow steps cost no host
-   round trip: the finite-check, ``lax.cond`` skip, and scale update
-   all live inside ``TrainStep._build``'s traced step. This walks that
-   method's AST and flags blocking calls (``float()``, ``.item()``,
-   ``.asnumpy()``, ``block_until_ready`` — the
-   ``check_no_sync_in_step`` rule set).
-
-Run standalone (nonzero exit on violations)::
-
-    python tools/check_amp_purity.py
+This checker now lives on the unified analysis framework as the
+``amp-purity`` pass (``mxnet_tpu/analysis/passes/amp_purity.py``) — run
+``python tools/mxlint.py`` for the whole suite; this shim keeps the
+historical standalone CLI and import surface.
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-_HERE = os.path.dirname(os.path.abspath(__file__))
-sys.path.insert(0, _HERE)
-sys.path.insert(0, os.path.dirname(_HERE))  # repo root: mxnet_tpu import
-from check_no_sync_in_step import (  # noqa: E402
-    BLOCKING_ATTRS, BLOCKING_BUILTINS, BLOCKING_QUALIFIED, STEP_PY,
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.analysis.jaxpr_driver import (  # noqa: E402,F401
+    find_mixed_dots, iter_jaxprs as _iter_jaxprs,
+    build_train_step as build_tiny_amp_step,
 )
-
-_LOW = ("bfloat16", "float16")
-
-
-# ------------------------------------------------------------- jaxpr check
-def _iter_jaxprs(obj):
-    """Yield every (sub-)jaxpr reachable from a jaxpr/ClosedJaxpr/eqn
-    params value."""
-    if obj is None:
-        return
-    if hasattr(obj, "jaxpr"):  # ClosedJaxpr
-        yield from _iter_jaxprs(obj.jaxpr)
-        return
-    if hasattr(obj, "eqns"):  # Jaxpr
-        yield obj
-        for eqn in obj.eqns:
-            for v in eqn.params.values():
-                yield from _iter_jaxprs(v)
-        return
-    if isinstance(obj, (tuple, list)):
-        for item in obj:
-            yield from _iter_jaxprs(item)
-
-
-def find_mixed_dots(closed_jaxpr):
-    """[(primitive, operand dtypes)] for every dot_general mixing fp32
-    with a low-precision operand anywhere in the program."""
-    out = []
-    for jaxpr in _iter_jaxprs(closed_jaxpr):
-        for eqn in jaxpr.eqns:
-            if eqn.primitive.name != "dot_general":
-                continue
-            dts = [str(v.aval.dtype) for v in eqn.invars[:2]
-                   if hasattr(v.aval, "dtype")]
-            if "float32" in dts and any(d in _LOW for d in dts):
-                out.append((eqn.primitive.name, tuple(dts)))
-    return out
-
-
-def build_tiny_amp_step(amp="bfloat16", remat="dots_saveable"):
-    """A minimal transformer TrainStep exercising the full AMP surface:
-    cast params, fp32-pinned norms, attention + tied-embedding dots."""
-    import numpy as np
-
-    import jax
-    import jax.numpy as jnp
-
-    import mxnet_tpu as mx
-    from mxnet_tpu import gluon, nd, optimizer as opt  # noqa: F401
-    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
-    from mxnet_tpu.ndarray.ndarray import NDArray
-    from mxnet_tpu.parallel import TrainStep
-
-    net = TransformerModel(src_vocab=64, tgt_vocab=64, units=16,
-                           hidden_size=32, num_layers=1, num_heads=2,
-                           max_length=32, dropout=0.0)
-    net.initialize(mx.initializer.Xavier())
-    net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
-                      nd.zeros((2, 8), dtype="int32"))
-
-    class CE:
-        def __call__(self, logits, label):
-            x = logits.data.astype(jnp.float32)
-            logp = jax.nn.log_softmax(x, axis=-1)
-            nll = -jnp.take_along_axis(
-                logp, label.data.astype(jnp.int32)[..., None], axis=-1)
-            return NDArray(nll.mean())
-
-    step = TrainStep(net, CE(), opt.AdamW(learning_rate=1e-4), amp=amp,
-                     remat=remat)
-    rng = np.random.RandomState(0)
-    src = nd.array(rng.randint(0, 64, (2, 8)), dtype="int32")
-    tgt = nd.array(rng.randint(0, 64, (2, 8)), dtype="int32")
-    lab = nd.array(rng.randint(0, 64, (2, 8)), dtype="int32")
-    step(src, tgt, lab)  # populates _last_avals
-    return step
-
-
-def check_step_purity(step=None):
-    """Return violations for check (1); builds the tiny step if none is
-    given. Also asserts the amp program DOES contain low-precision dots
-    at all — an all-f32 program means the cast pass silently stopped
-    engaging, which is its own failure."""
-    import jax
-
-    if step is None:
-        step = build_tiny_amp_step()
-    jaxpr = jax.make_jaxpr(step._step_fn)(*step._last_avals)
-    mixed = [f"dot_general with operands {dts} — fp32 feeds a "
-             f"low-precision dot without a cast" for _, dts in
-             find_mixed_dots(jaxpr)]
-    low_dots = 0
-    for j in _iter_jaxprs(jaxpr):
-        for eqn in j.eqns:
-            if eqn.primitive.name == "dot_general" and any(
-                    str(v.aval.dtype) in _LOW for v in eqn.invars[:2]
-                    if hasattr(v.aval, "dtype")):
-                low_dots += 1
-    if low_dots == 0:
-        mixed.append(
-            "amp step program contains NO low-precision dot_general at "
-            "all — the cast pass is not engaging")
-    return mixed
-
-
-# --------------------------------------------------------------- AST check
-def find_overflow_sync_violations(path: str = STEP_PY):
-    """Blocking host calls inside the TRACED closures of
-    ``TrainStep._build`` (``step_core``/``forward_loss``/... — the step
-    body XLA compiles, including the fp16 overflow-skip path).
-    ``_build``'s own top-level statements run once on host at build time
-    and may legitimately coerce hyperparameters."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = []
-    classes = [n for n in tree.body
-               if isinstance(n, ast.ClassDef) and n.name == "TrainStep"]
-    if not classes:
-        return [(0, f"TrainStep class not found in {path}")]
-    builds = [n for n in classes[0].body
-              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-              and n.name == "_build"]
-    if not builds:
-        return [(classes[0].lineno, "_build method not found — update "
-                 "check_amp_purity if the builder was renamed")]
-    traced = [n for n in ast.walk(builds[0])
-              if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-              and n is not builds[0]]
-    nodes = [node for fn in traced for node in ast.walk(fn)]
-    for node in nodes:
-        if not isinstance(node, ast.Call):
-            continue
-        f = node.func
-        if isinstance(f, ast.Name) and f.id in BLOCKING_BUILTINS:
-            out.append((node.lineno,
-                        f"_build: host coercion {f.id}(...) would sync "
-                        "the overflow-skip path"))
-        elif isinstance(f, ast.Attribute):
-            if f.attr in BLOCKING_ATTRS:
-                out.append((node.lineno,
-                            f"_build: .{f.attr}() forces a device->host "
-                            "sync inside the traced step"))
-            elif isinstance(f.value, ast.Name) and \
-                    (f.value.id, f.attr) in BLOCKING_QUALIFIED:
-                out.append((node.lineno,
-                            f"_build: {f.value.id}.{f.attr}(...) "
-                            "materializes/stalls on host"))
-    return out
+from mxnet_tpu.analysis.passes.amp_purity import (  # noqa: E402,F401
+    check_step_purity, find_overflow_sync_violations,
+)
+from mxnet_tpu.analysis.passes.no_sync import STEP_PY  # noqa: E402,F401
 
 
 def main(argv=None):

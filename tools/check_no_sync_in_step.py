@@ -1,130 +1,26 @@
 #!/usr/bin/env python
 """Lint: the jitted hot paths must never block on the device.
 
-Two pipelines depend on it:
-
-- **Training** — the async device-feed overlap (``gluon.data.prefetch``)
-  only works if ``TrainStep.__call__``'s pre-placed fast path (``__call__``
-  + ``_dispatch``) stays pure dispatch.
-- **Inference/serving** — the decode hot path (``InferStep.__call__`` /
-  ``_dispatch`` / ``decode_n`` and ``DynamicBatcher._dispatch``) must
-  fire prefill + the whole decode loop without a single host sync, or
-  every generation call serializes against the device and the O(1)/token
-  engine degrades back to host-latency-per-token.
-
-Any host synchronization there (``.asnumpy()``, ``float(loss)``,
-``np.asarray`` on a device array, ``block_until_ready``) silently un-does
-the tentpole; this check walks the AST of the listed (file, class,
-methods) targets and flags blocking calls.
-
-Run standalone (nonzero exit on violations)::
-
-    python tools/check_no_sync_in_step.py
-
-or through the tier-1 suite (``tests/test_no_sync_lint.py`` imports
-``find_violations``/``find_all_violations`` and asserts they return
-nothing).
+This checker now lives on the unified analysis framework as the
+``no-sync`` pass (``mxnet_tpu/analysis/passes/no_sync.py``) — run
+``python tools/mxlint.py`` for the whole suite; this shim keeps the
+historical standalone CLI and import surface
+(``find_violations``/``find_all_violations``/``TARGETS``/rule sets).
 """
 
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-_ROOT = os.path.normpath(os.path.join(
-    os.path.dirname(os.path.abspath(__file__)), os.pardir))
-STEP_PY = os.path.join(_ROOT, "mxnet_tpu", "parallel", "step.py")
-INFER_PY = os.path.join(_ROOT, "mxnet_tpu", "parallel", "infer.py")
-BATCHER_PY = os.path.join(_ROOT, "mxnet_tpu", "serving", "batcher.py")
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
-# the train-step fast-path bodies: __call__ (DeviceBatch detection +
-# dispatch) and _dispatch (the staged-operand hot dispatch). _stage is
-# deliberately NOT linted — it is the slow path the fast path skips.
-FAST_PATH_FUNCS = ("__call__", "_dispatch")
-
-# every linted (file, class, methods) hot path. The inference engine's
-# decode_n is the whole generation dispatch and decode_iter/prefill_paged
-# are the continuous-batching iteration dispatches; the batchers'
-# _dispatch methods assemble and fire batches (DynamicBatcher._resolve /
-# ContinuousBatcher._collect+_admit are the designated sync points and
-# stay unlinted). ContinuousBatcher._step_once — the scheduler loop body
-# — is linted too: its syncs must stay delegated to those named phases,
-# never inlined next to a dispatch.
-TARGETS = (
-    (STEP_PY, "TrainStep", FAST_PATH_FUNCS),
-    (INFER_PY, "InferStep", ("__call__", "_dispatch", "decode_n",
-                             "decode_iter", "prefill_paged")),
-    (BATCHER_PY, "DynamicBatcher", ("_dispatch",)),
-    (BATCHER_PY, "ContinuousBatcher", ("_dispatch", "_step_once")),
+from mxnet_tpu.analysis.passes.no_sync import (  # noqa: E402,F401
+    BATCHER_PY, BLOCKING_ATTRS, BLOCKING_BUILTINS, BLOCKING_QUALIFIED,
+    FAST_PATH_FUNCS, INFER_PY, STEP_PY, TARGETS, find_all_violations,
+    find_violations,
 )
-
-# method attributes that force a device->host readback / host sync
-BLOCKING_ATTRS = {
-    "asnumpy", "asscalar", "item", "tolist", "block_until_ready",
-    "copy_to_host_async",
-}
-# bare builtins that coerce a device scalar on the host
-BLOCKING_BUILTINS = {"float", "int", "bool", "complex", "print"}
-# module.attr calls that materialize device arrays on host (np.asarray on
-# a device array round-trips it) or stall the thread
-BLOCKING_QUALIFIED = {
-    ("np", "asarray"), ("_np", "asarray"), ("numpy", "asarray"),
-    ("np", "array"), ("_np", "array"), ("numpy", "array"),
-    ("jax", "device_get"), ("time", "sleep"), ("_time", "sleep"),
-}
-
-
-def find_violations(path: str = STEP_PY, class_name: str = "TrainStep",
-                    funcs=FAST_PATH_FUNCS):
-    """Return [(lineno, message)] for blocking calls inside the given
-    class's listed method bodies."""
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = []
-    classes = [n for n in tree.body
-               if isinstance(n, ast.ClassDef) and n.name == class_name]
-    if not classes:
-        return [(0, f"{class_name} class not found in {path}")]
-    fns = [n for n in classes[0].body
-           if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-           and n.name in funcs]
-    missing = set(funcs) - {f.name for f in fns}
-    if missing:
-        out.append((classes[0].lineno,
-                    f"{class_name} hot-path method(s) {sorted(missing)} "
-                    "not found — update TARGETS if the hot path was "
-                    "renamed"))
-    for fn in fns:
-        for node in ast.walk(fn):
-            if not isinstance(node, ast.Call):
-                continue
-            f = node.func
-            if isinstance(f, ast.Name) and f.id in BLOCKING_BUILTINS:
-                out.append((node.lineno,
-                            f"{class_name}.{fn.name}: host coercion "
-                            f"{f.id}(...) blocks on the device value"))
-            elif isinstance(f, ast.Attribute):
-                if f.attr in BLOCKING_ATTRS:
-                    out.append((node.lineno,
-                                f"{class_name}.{fn.name}: .{f.attr}() "
-                                "forces a device->host sync"))
-                elif isinstance(f.value, ast.Name) and \
-                        (f.value.id, f.attr) in BLOCKING_QUALIFIED:
-                    out.append((node.lineno,
-                                f"{class_name}.{fn.name}: "
-                                f"{f.value.id}.{f.attr}(...) "
-                                "materializes/stalls on host"))
-    return out
-
-
-def find_all_violations():
-    """Lint every TARGETS entry; returns [(path, lineno, message)]."""
-    out = []
-    for path, cls, funcs in TARGETS:
-        for lineno, msg in find_violations(path, cls, funcs):
-            out.append((path, lineno, msg))
-    return out
 
 
 def main(argv=None):

@@ -1,0 +1,146 @@
+#!/usr/bin/env python
+"""mxlint: run the unified static-analysis suite (mxnet_tpu.analysis).
+
+Seven passes over two IRs (Python AST for host code, jaxpr for the real
+jitted programs) plus two repo-consistency passes — the one lint entry
+point CI runs:
+
+    python tools/mxlint.py                 # human output, all passes
+    python tools/mxlint.py --json          # machine output for CI
+    python tools/mxlint.py --passes lock-order,donation
+    python tools/mxlint.py --list          # show the pass roster
+    python tools/mxlint.py --write-baseline --reason "why"  # grandfather
+                                           # current findings
+
+Baseline workflow: findings whose fingerprint appears in
+``tools/mxlint_baseline.json`` (with a mandatory reason) are reported as
+suppressed and do not fail the run; everything else exits 1. jaxpr
+passes trace real TrainStep/InferStep programs — on a bare CPU the
+script simulates a 4-device platform first (same trick as the old
+check_sharding.py).
+
+Exit codes: 0 clean (or fully baselined), 1 findings, 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_ROOT = os.path.dirname(_HERE)
+sys.path.insert(0, _ROOT)
+
+DEFAULT_BASELINE = os.path.join(_HERE, "mxlint_baseline.json")
+
+
+def _ensure_devices():
+    """jaxpr passes need >= 4 devices (sharding-placement); simulate on
+    CPU before jax imports, mirroring tests/conftest.py."""
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=4").strip()
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON document for CI")
+    ap.add_argument("--passes", default=None,
+                    help="comma-separated subset (default: all)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default tools/mxlint_baseline"
+                    ".json); 'none' disables suppression")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="add every CURRENT finding to the baseline "
+                    "with --reason and exit 0")
+    ap.add_argument("--reason", default=None,
+                    help="reason recorded with --write-baseline entries")
+    ap.add_argument("--list", action="store_true",
+                    help="list registered passes and exit")
+    args = ap.parse_args(argv)
+
+    _ensure_devices()
+    from mxnet_tpu.analysis import Baseline, all_passes, run_passes
+
+    registry = all_passes()
+    if args.list:
+        for name in sorted(registry):
+            p = registry[name]
+            print(f"{name:<22} [{p.ir:<5}] {p.description}")
+        return 0
+
+    names = None
+    if args.passes:
+        names = [n.strip() for n in args.passes.split(",") if n.strip()]
+        unknown = [n for n in names if n not in registry]
+        if unknown:
+            print(f"unknown pass(es) {unknown}; have {sorted(registry)}",
+                  file=sys.stderr)
+            return 2
+
+    baseline = None
+    if args.baseline and args.baseline.lower() != "none":
+        baseline = Baseline.load(args.baseline)
+
+    t0 = time.perf_counter()
+    timings = {}
+
+    def progress(name):
+        timings[name] = time.perf_counter()
+        if not args.json:
+            print(f"[mxlint] {name} ...", file=sys.stderr)
+
+    findings, suppressed = run_passes(names, baseline=baseline,
+                                      progress=progress)
+    elapsed = time.perf_counter() - t0
+
+    if args.write_baseline:
+        if not args.reason:
+            print("--write-baseline needs --reason (every grandfathered "
+                  "violation must explain itself)", file=sys.stderr)
+            return 2
+        baseline = baseline or Baseline(path=args.baseline)
+        for f in findings:
+            baseline.entries[f.fingerprint] = {
+                "reason": args.reason, "pass": f.pass_name,
+                "rule": f.rule, "path": f.path,
+            }
+        baseline.save(args.baseline)
+        print(f"baselined {len(findings)} finding(s) into "
+              f"{args.baseline}")
+        return 0
+
+    if args.json:
+        print(json.dumps({
+            "ok": not findings,
+            "elapsed_s": round(elapsed, 3),
+            "passes_run": sorted(registry) if names is None else names,
+            "findings": [f.to_dict() for f in findings],
+            "suppressed": [dict(f.to_dict(), baseline_reason=r)
+                           for f, r in suppressed],
+        }, indent=2))
+    else:
+        for f, r in suppressed:
+            print(f"BASELINED {f}  (reason: {r})")
+        for f in findings:
+            print(f)
+        n = len(findings)
+        print(f"mxlint: {n} finding(s), {len(suppressed)} baselined, "
+              f"{len(registry) if names is None else len(names)} "
+              f"pass(es) in {elapsed:.1f}s")
+        if not findings:
+            print("mxlint: clean")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,6 +20,35 @@ import json
 import os
 import sys
 
+# Every metric family the package emits, and which section of this tool
+# surfaces it. mxlint's telemetry-names pass fails CI when code emits a
+# family missing here (it would silently vanish from every report) or
+# when an entry here is dead. Families mapped to "Host-side training"
+# print through _print_host_family below; the serving-era families have
+# dedicated sections.
+KNOWN_METRIC_FAMILIES = {
+    "compile": "Compile (shape stability)",
+    "infer": "Inference / serving",
+    "serve": "Self-healing serving",
+    "launch": "Self-healing serving",
+    "shard": "SPMD sharding",
+    "trainer": "Host-side training",
+    "kvstore": "Host-side training",
+    "input": "Host-side training",
+    "device": "Host-side training",
+    "watchdog": "Host-side training",
+    "jax": "Compile (shape stability)",
+}
+
+# Span/instant families (Chrome-trace names are dotted); spans aggregate
+# generically in the Spans table, so membership here is the emitted
+# surface the consistency pass checks, not a formatting choice.
+KNOWN_SPAN_FAMILIES = {
+    "checkpoint", "dataloader", "estimator", "imperative", "infer",
+    "input", "kvstore", "launch", "serve", "trainer", "trainstep",
+    "watchdog",
+}
+
 
 def _quantile(sorted_vals, p):
     if not sorted_vals:
@@ -103,6 +132,38 @@ def _print_json_file(path, title):
         return
     print(f"\n== {title} ({path}) ==")
     print(json.dumps(data, indent=2, default=str)[:4000])
+
+
+def _print_host_families(report_path):
+    """Surface the host-side training families (trainer/, kvstore/,
+    input/, device/, watchdog/) from a ``report.json`` registry
+    snapshot — previously only visible in the raw report dump."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    fams = tuple(f + "/" for f, sec in KNOWN_METRIC_FAMILIES.items()
+                 if sec == "Host-side training")
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith(fams)}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k.startswith(fams)}
+    hists = {k: v for k, v in report.get("histograms", {}).items()
+             if k.startswith(fams)}
+    if not counters and not gauges and not hists:
+        return
+    print("\n== Host-side training ==")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    for k in sorted(hists):
+        h = hists[k]
+        print(f"  {k:<38} p50={h.get('p50')} p95={h.get('p95')} "
+              f"n={h.get('count')}")
 
 
 def _print_compile_family(report_path):
@@ -281,6 +342,7 @@ def main(argv=None):
         _print_json_file(os.path.join(directory, "heartbeat.json"),
                          "Heartbeat")
         _print_json_file(os.path.join(directory, "report.json"), "Report")
+        _print_host_families(os.path.join(directory, "report.json"))
         _print_compile_family(os.path.join(directory, "report.json"))
         _print_infer_family(os.path.join(directory, "report.json"))
         _print_shard_family(os.path.join(directory, "report.json"))
