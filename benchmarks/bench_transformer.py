@@ -525,8 +525,11 @@ def serve_chaos_main(args):
         return Replica(name, bat)
 
     replicas = [make_replica("r0"), make_replica("r1")]
+    # shedding off: this mode measures failover/swap under a backlog
+    # that deliberately outruns the CPU rig's service rate (the shed
+    # policy is --procs mode's phase 3)
     router = Router(replicas, retry_backoff_s=0.01,
-                    health_interval_s=0.02)
+                    health_interval_s=0.02, shed_queue_depth=10 ** 6)
     watcher = CheckpointWatcher(router.engines, ckpt_root, start=False)
 
     n_requests = args.samples
@@ -586,6 +589,209 @@ def serve_chaos_main(args):
         print("FAIL: swap+failover under load must lose zero requests, "
               "serve both weight versions, evict the killed replica and "
               "never recompile", file=sys.stderr)
+    return 0 if ok else 1
+
+
+# ------------------------------------------------- serve-chaos, real procs
+def serve_chaos_procs_main(args):
+    """Cross-process chaos (``--serve-chaos --procs N``): N REAL
+    ``serving.worker`` processes behind ``RemoteReplica``s, under
+    open-loop load, through the full failure matrix —
+
+    1. a coordinated hot swap lands mid-stream (two-phase stage/flip
+       over the control channel; every process ends on ONE version tag),
+    2. one worker is SIGKILL'd mid-decode (dead socket + stale
+       heartbeat → eviction → transparent resubmission → the factory
+       respawns a REAL process which rejoins at the swapped version),
+    3. a deadline flood hits the now-degraded fleet and the router
+       SHEDS at admission (``serve/shed_*``) with the backlog bounded
+       by construction.
+
+    Acceptance: zero lost requests through swap+SIGKILL, >= 1 failover,
+    one coherent post-swap version across every live process, every
+    flood request resolved (served or shed — none hanging), observed
+    router backlog <= MXTPU_SHED_MAX_QUEUE, zero steady recompiles in
+    this process (remote engines warm in their own)."""
+    import os
+    import shutil
+    import tempfile
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import checkpoint_sharded as cs
+    from mxnet_tpu.serving import (Backpressure, CheckpointWatcher,
+                                   RemoteReplica, Router)
+    from mxnet_tpu.serving.worker import make_transformer_net, spawn_worker
+
+    V, B, T = args.vocab, args.batch_size, args.decode_tokens
+    bucket = args.max_len
+    n_procs = args.procs
+    rng = np.random.RandomState(args.seed)
+    root = tempfile.mkdtemp(prefix="mxtpu_serve_chaos_procs_")
+    ckpt_root = os.path.join(root, "ckpt")
+    model = dict(vocab=V, units=args.units, layers=args.layers,
+                 heads=2, seed=args.seed, max_length=bucket + T + 8)
+    wkw = dict(model=model, max_len=bucket + T + 4, bucket_keys=(bucket,),
+               slots=B, max_new=T, ckpt_dir=ckpt_root)
+
+    handles = [spawn_worker(os.path.join(root, f"w{i}"), name=f"w{i}",
+                            **wkw) for i in range(n_procs)]
+    spawned = [len(handles)]
+
+    def factory():
+        i = spawned[0]
+        spawned[0] += 1
+        h = spawn_worker(os.path.join(root, f"w{i}"), name=f"w{i}", **wkw)
+        handles.append(h)
+        return RemoteReplica.spawning(h, heartbeat_stale_s=2.0)
+
+    print(f"spawning {n_procs} worker processes ...", file=sys.stderr)
+    replicas = [RemoteReplica(h.name, address=h.address,
+                              heartbeat_path=h.heartbeat_path,
+                              heartbeat_stale_s=2.0) for h in handles]
+    router = Router(replicas, retry_backoff_s=0.01, health_interval_s=0.05,
+                    replica_factory=factory, respawn_backoff_s=0.05,
+                    no_replica_timeout_s=60.0,
+                    shed_queue_depth=10 ** 6)  # phase 3 tightens this
+    trained = make_transformer_net(**dict(model, seed=args.seed + 1))
+    cs.save_sharded(
+        os.path.join(ckpt_root, "step_1"),
+        {n: p._data.data for n, p in trained.collect_params().items()})
+    watcher = CheckpointWatcher(router.engines, ckpt_root, start=False)
+
+    # ---- phase 1+2: open-loop load through swap + SIGKILL
+    n_requests = args.samples
+    futs, lat = [], []
+    swap_version = None
+    t0 = time.perf_counter()
+    for i in range(n_requests):
+        n = rng.randint(args.min_len, bucket + 1)
+        futs.append(router.submit(rng.randint(3, V, (n,)).astype("int32"),
+                                  max_new_tokens=T))
+        if i == n_requests // 3:
+            swap_version = watcher.poll_once()
+            assert swap_version is not None, "swap did not land"
+        if i == n_requests // 2:
+            print(f"SIGKILL {handles[1].name} (pid {handles[1].pid})",
+                  file=sys.stderr)
+            handles[1].kill()
+        time.sleep(0.002)
+    errors = 0
+    for f in futs:
+        try:
+            f.result(timeout=240)
+            lat.append((time.perf_counter() - f.enqueued_at) * 1e3)
+        except Exception:  # noqa: BLE001 - counted as lost
+            errors += 1
+    wall_s = time.perf_counter() - t0
+    versions = sorted({f.weights_version for f in futs
+                       if f.weights_version is not None})
+
+    # the respawned process must rejoin and report the swapped version
+    deadline = time.perf_counter() + 120
+    live = []
+    while time.perf_counter() < deadline:
+        live = [r for r in router.replicas if not r.evicted and r.healthy]
+        if len(live) >= n_procs:
+            break
+        time.sleep(0.2)
+    live_versions = sorted({r.weights_version for r in live})
+
+    # ---- phase 3: shed flood against a deliberately degraded fleet
+    router.shed_queue_depth = 2
+    router.shed_max_queue = max(2 * B, 8)
+    flood = []
+    max_backlog = 0
+    for _ in range(4 * router.shed_max_queue):
+        flood.append(router.submit(
+            rng.randint(3, V, (rng.randint(args.min_len, bucket + 1),))
+            .astype("int32"), max_new_tokens=T, deadline_ms=10_000.0))
+        max_backlog = max(max_backlog, len(router._inflight))
+    shed = served = flood_lost = 0
+    flood_waits = []
+    for f in flood:
+        try:
+            f.result(timeout=240)
+            served += 1
+            if f.queue_wait_ms is not None:
+                flood_waits.append(f.queue_wait_ms)
+        except Backpressure:
+            shed += 1
+        except Exception:  # noqa: BLE001 - deadline/drop = lost
+            flood_lost += 1
+    router.stop()
+    reg = mx.telemetry.registry()
+    shed_counted = sum(
+        reg.counter(f"serve/shed_{k}").value
+        for k in ("queue_full", "deadline"))
+
+    # ---- graceful teardown: SIGTERM drains, exit 0
+    rcs = []
+    for h in handles:
+        if h.alive():
+            h.terminate()
+    for h in handles:
+        try:
+            rcs.append(h.wait(timeout=60))
+        except Exception:  # noqa: BLE001
+            h.kill()
+            rcs.append(-9)
+    rcs = [rc for rc in rcs if rc != -9]  # the SIGKILL'd one
+
+    lat.sort()
+    flood_waits.sort()
+    local_recompiles = 0  # remote engines warm in their own processes
+    row = {
+        "metric": "transformer_serve_chaos_procs_requests_per_sec",
+        "value": round(len(lat) / wall_s, 1),
+        "unit": "requests/sec",
+        "procs": n_procs,
+        "requests": n_requests,
+        "errors": errors,
+        "latency_ms_p50": round(_q(lat, 50), 1) if lat else None,
+        "latency_ms_p99": round(_q(lat, 99), 1) if lat else None,
+        "weights_versions": versions,
+        "live_versions": live_versions,
+        "serve_swaps": reg.counter("serve/swaps").value,
+        "serve_failovers": reg.counter("serve/failovers").value,
+        "serve_retries": reg.counter("serve/retries").value,
+        "serve_dropped": reg.counter("serve/dropped").value,
+        "serve_replica_restarts":
+            reg.counter("serve/replica_restarts").value,
+        "transport_reconnects":
+            reg.counter("transport/reconnects").value,
+        "transport_errors": reg.counter("transport/errors").value,
+        "shed": shed, "shed_counted": shed_counted,
+        "flood_served": served, "flood_lost": flood_lost,
+        "flood_wait_ms_p95": round(_q(flood_waits, 95), 1)
+            if flood_waits else None,
+        "max_router_backlog": max_backlog,
+        "shed_max_queue": router.shed_max_queue,
+        "drain_exit_codes": rcs,
+        "steady_state_recompiles": local_recompiles,
+        "batch": B, "prompt_bucket": bucket, "decode_tokens": T,
+    }
+    print(json.dumps(row))
+    print(f"{n_requests} requests through cross-process swap+SIGKILL: "
+          f"{errors} lost, versions {versions}, "
+          f"{row['serve_failovers']} failover(s), "
+          f"{row['serve_replica_restarts']} respawn(s), live fleet on "
+          f"{live_versions}; flood: {served} served / {shed} shed "
+          f"({shed_counted} counted), backlog max {max_backlog} <= "
+          f"{router.shed_max_queue}, drain rcs {rcs}")
+    ok = (errors == 0 and len(versions) >= 2
+          and row["serve_failovers"] >= 1
+          and live_versions == [swap_version]
+          and flood_lost == 0
+          and shed >= 1 and shed_counted >= shed
+          and max_backlog <= router.shed_max_queue
+          and all(rc == 0 for rc in rcs))
+    shutil.rmtree(root, ignore_errors=True)
+    if not ok:
+        print("FAIL: cross-process chaos must lose zero requests, "
+              "evict+respawn the killed worker, converge every process "
+              "on one swapped version, shed (with accounting) under a "
+              "degraded fleet with bounded backlog, and drain cleanly "
+              "on SIGTERM", file=sys.stderr)
     return 0 if ok else 1
 
 
@@ -736,6 +942,13 @@ def main(argv=None):
     ap.add_argument("--serve-chaos", action="store_true",
                     help="self-healing serving ablation: hot weight swap "
                          "+ replica kill under sustained router load")
+    ap.add_argument("--procs", type=int, default=0,
+                    help="with --serve-chaos: spawn N REAL serving "
+                         "worker processes (serving.worker) behind "
+                         "RemoteReplicas — the kill becomes SIGKILL of "
+                         "a process, the swap a cross-process two-phase "
+                         "flip, plus a shed flood against the degraded "
+                         "fleet (0 = in-process replicas, the PR-7 mode)")
     ap.add_argument("--max-batch", type=int, default=1024)
     ap.add_argument("--buckets", type=int, default=4)
     ap.add_argument("--batch-size", type=int, default=8)
@@ -751,6 +964,8 @@ def main(argv=None):
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
     if args.serve_chaos:
+        if args.procs >= 2:
+            return serve_chaos_procs_main(args)
         return serve_chaos_main(args)
     if args.open_loop is not None:
         return open_loop_main(args)

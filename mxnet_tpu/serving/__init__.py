@@ -26,7 +26,18 @@ and replica failures:
 - ``Router`` fronts N replicas behind one ``submit()``: health scoring
   from the watchdog heartbeat + per-replica backlog, eviction with
   transparent resubmission (bounded retries, exponential backoff,
-  per-request deadlines), respawn via a replica factory.
+  per-request deadlines), respawn via a replica factory, and
+  load-shedding admission (``Backpressure`` at submit, ``serve/shed_*``
+  accounting) once EVERY replica is degraded.
+- ``transport``/``worker``/``remote`` cross the process boundary:
+  replicas run as real worker processes (``python -m
+  mxnet_tpu.serving.worker``) behind a length-prefixed socket RPC
+  (submit/stream/health/stage/swap/drain verbs, no pickle);
+  ``RemoteReplica`` gives the router process-level failover (SIGKILL'd
+  worker → dead socket/stale heartbeat → eviction + transparent
+  resubmission → factory respawns a REAL process) and the
+  ``CheckpointWatcher`` drives the same stage-all-then-flip-all hot
+  swap over the control channel so every process flips coherently.
 - ``faults`` plants deterministic failure points in all of the above
   (``MXTPU_FAULT_*``), so the failure paths are testable in tier-1.
 
@@ -39,8 +50,11 @@ Env knobs: ``MXTPU_BATCHER`` (scheduler kind, default ``continuous``),
 ``MXTPU_DECODE_MAX_LEN`` (engine cache capacity — see ``parallel.infer``),
 ``MXTPU_SWAP_POLL_S`` (checkpoint poll period), ``MXTPU_RETRY_MAX``
 (router resubmissions per request), ``MXTPU_RESTART_BACKOFF_S`` (restart
-backoff base, shared with ``tools/launch.py``), ``MXTPU_FAULT_*``
-(fault-injection specs — see ``serving.faults``).
+backoff base, shared with ``tools/launch.py``), ``MXTPU_SERVE_PORT`` /
+``MXTPU_RPC_TIMEOUT_S`` / ``MXTPU_RPC_CONNECT_S`` (worker transport),
+``MXTPU_WORKER_DRAIN_S`` (SIGTERM drain budget), ``MXTPU_SHED_*``
+(router load-shedding thresholds), ``MXTPU_FAULT_*`` (fault-injection
+specs — see ``serving.faults``).
 """
 
 from . import faults
@@ -50,12 +64,19 @@ from .batcher import Backpressure, ContinuousBatcher, DeadlineExceeded, \
     batcher_timeout_ms, iter_tokens_default, make_batcher
 from .pages import PagePool
 from .router import Replica, ReplicaUnavailable, Router, restart_backoff_s, \
-    retry_max
-from .watcher import CheckpointWatcher, swap_poll_s
+    retry_max, shed_max_queue, shed_queue_depth, shed_wait_ms
+from .remote import RemoteEngineHandle, RemoteReplica
+from .transport import RpcClient, RpcServer, TransportError, \
+    rpc_connect_s, rpc_timeout_s, serve_port
+from .watcher import CheckpointWatcher, swap_poll_s, version_for
 
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
            "DeadlineExceeded", "Backpressure", "PagePool", "pages",
            "Router", "Replica", "ReplicaUnavailable", "CheckpointWatcher",
-           "faults", "batcher_slots", "batcher_timeout_ms", "batcher_kind",
-           "iter_tokens_default", "make_batcher", "swap_poll_s",
-           "retry_max", "restart_backoff_s"]
+           "RemoteReplica", "RemoteEngineHandle", "RpcClient", "RpcServer",
+           "TransportError", "faults", "batcher_slots",
+           "batcher_timeout_ms", "batcher_kind", "iter_tokens_default",
+           "make_batcher", "swap_poll_s", "version_for", "retry_max",
+           "restart_backoff_s", "shed_queue_depth", "shed_wait_ms",
+           "shed_max_queue", "rpc_timeout_s", "rpc_connect_s",
+           "serve_port"]

@@ -22,8 +22,18 @@ Failure points wired in this package:
                       wedged engine (watchdog heartbeat goes stale).
 ``watchdog.heartbeat`` suppresses heartbeat writes — a stale heartbeat
                       with the process otherwise alive.
-``ckpt.load``         raises inside ``CheckpointWatcher``'s load — a torn
-                      / unreadable checkpoint mid-swap.
+``ckpt.load``         raises inside ``CheckpointWatcher``'s load (and the
+                      worker's ``stage`` verb) — a torn / unreadable
+                      checkpoint mid-swap.
+``transport.send``    fires before a frame write (client or server side
+                      of the cross-process RPC): raise-mode drops the
+                      connection, delay-mode is a slow link; armed
+                      ``times=None`` on send AND recv = a partition.
+``transport.recv``    the receive half of the same — fires before a
+                      frame read; tags are the client/server name.
+``worker.exit``       hard-kills a serving worker process from inside
+                      its main loop (``os._exit``) — sudden process
+                      death on a deterministic schedule.
 ==================== ====================================================
 
 Env spec grammar (one var per point, ``.`` becomes ``_``)::

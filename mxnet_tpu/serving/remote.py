@@ -1,0 +1,239 @@
+"""Remote replicas: worker processes behind the ``Replica`` protocol.
+
+``RemoteReplica`` plugs a ``serving.worker`` process into the existing
+``Router`` unchanged — same health/load/submit surface, but the failure
+modes are now real: a SIGKILL'd worker is a dead socket plus a stale
+heartbeat file, eviction is process-level failover, and a respawn
+factory spawns an actual fresh process.
+
+- **submit** rides ``transport.RpcClient.submit``: the inner future is
+  local, fed by the worker's token stream; a dead connection fails it
+  with ``ReplicaUnavailable`` so the router replays it elsewhere
+  without charging the retry budget.
+- **health** is a cached RPC probe (``health`` verb, refreshed at most
+  every ``probe_ttl_s`` — the router's submit path may ask under its
+  lock and must not block on the wire) combined with the worker's
+  heartbeat FILE: a wedged worker whose socket still answers is caught
+  by heartbeat staleness, a dead one by the dead socket. The probe also
+  feeds ``load()`` (remote queue depth + occupied slots) and the
+  ``serve/worker_heartbeat_lag_ms`` gauge.
+- **engine** is a ``RemoteEngineHandle`` speaking the two-phase swap
+  protocol (``stage_checkpoint``/``swap_staged``) — the
+  ``CheckpointWatcher`` drives it over the control channel so every
+  process flips at a dispatch boundary under one coherent version tag.
+- A replica built from a just-spawned ``WorkerHandle``
+  (``RemoteReplica.spawning``) connects on a background thread:
+  ``starting`` stays True (the router neither places on it nor evicts
+  it) until the worker announces and the socket opens.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from .. import telemetry as _tel
+from ..telemetry.watchdog import read_heartbeat
+from .batcher import GenerationResult
+from .router import Replica, ReplicaUnavailable
+from .transport import RpcClient, TransportError
+
+__all__ = ["RemoteReplica", "RemoteEngineHandle"]
+
+
+class RemoteEngineHandle:
+    """``CheckpointWatcher``-facing proxy for one worker's engine: the
+    worker loads checkpoints host-side (arrays never cross the socket),
+    this handle only carries the control verbs."""
+
+    def __init__(self, client: RpcClient, name: str):
+        self._client = client
+        self.name = name
+        self.weights_version: Optional[str] = None
+
+    def stage_checkpoint(self, path: str) -> None:
+        """Phase 1: the worker loads ``path`` and stages it standby."""
+        self._client.call("stage", {"path": path})
+
+    def swap_staged(self, version: str) -> str:
+        """Phase 2: flip the staged buffer live under ``version``."""
+        out = self._client.call("swap", {"version": version})
+        self.weights_version = out.get("version", version)
+        return self.weights_version
+
+
+class _RemoteBatcher:
+    """The slice of the batcher surface the ``Router`` touches, mapped
+    onto the transport. ``cancel_pending`` fails the LOCAL inner futures
+    (a remote queue cannot be reached once the worker is gone — its
+    zombie completions are discarded by the router)."""
+
+    def __init__(self, client: RpcClient, name: str,
+                 engine: RemoteEngineHandle):
+        self._client = client
+        self.name = name
+        self._engine = engine
+
+    @property
+    def healthy(self) -> bool:
+        return self._client.dead is None
+
+    def submit(self, prompt_ids, max_new_tokens=None,
+               deadline_ms=None) -> GenerationResult:
+        return self._client.submit(prompt_ids, max_new_tokens,
+                                   deadline_ms=deadline_ms)
+
+    def cancel_pending(self, error=None) -> int:
+        err = error if error is not None else ReplicaUnavailable(
+            f"remote replica {self.name} cancelled")
+        self._client._shutdown(err)
+        return 0
+
+    def stop(self, drain: bool = True, timeout: float = 30.0):
+        if drain and self._client.dead is None:
+            try:
+                self._client.call("drain", timeout_s=timeout)
+            except Exception:  # noqa: BLE001 - teardown best-effort
+                pass
+        self._client.close()
+
+
+class RemoteReplica(Replica):
+    """One worker process behind the router.
+
+    Parameters
+    ----------
+    name : replica tag (fault ``match``, telemetry, routing).
+    address : ``host:port`` of a READY worker; or None with ``worker``.
+    worker : a ``serving.worker.WorkerHandle`` still booting — the
+        replica resolves its address and connects on a background
+        thread (``starting`` until then).
+    heartbeat_path / heartbeat_stale_s : the worker's watchdog
+        ``heartbeat.json`` (defaults to the handle's); staleness or a
+        ``stalled``/``hard_hang`` status fails health even while the
+        socket answers.
+    probe_ttl_s : max age of the cached health probe (the router's
+        monitor refreshes it every ``health_interval_s`` anyway).
+    """
+
+    def __init__(self, name: str, address=None, worker=None,
+                 heartbeat_path: Optional[str] = None,
+                 heartbeat_stale_s: float = 10.0,
+                 rpc_timeout_s: Optional[float] = None,
+                 probe_ttl_s: float = 0.05,
+                 connect_budget_s: Optional[float] = None):
+        if address is None and worker is None:
+            raise ValueError("RemoteReplica needs address= or worker=")
+        self.worker = worker
+        if heartbeat_path is None and worker is not None:
+            heartbeat_path = worker.heartbeat_path
+        self.probe_ttl_s = float(probe_ttl_s)
+        self._connect_budget_s = connect_budget_s
+        self._rpc_timeout_s = rpc_timeout_s
+        self._probe = None      # cached (healthy, reason)
+        self._probe_at = 0.0
+        self._probe_info: dict = {}
+        self._client = RpcClient(address if address is not None
+                                 else ("127.0.0.1", 0),
+                                 timeout_s=rpc_timeout_s, name=name,
+                                 dead_error=ReplicaUnavailable)
+        self._engine_handle = RemoteEngineHandle(self._client, name)
+        self._starting = True
+        self._start_error: Optional[BaseException] = None
+        super().__init__(name, _RemoteBatcher(self._client, name,
+                                              self._engine_handle),
+                         heartbeat_path=heartbeat_path,
+                         heartbeat_stale_s=heartbeat_stale_s)
+        if address is not None and worker is None:
+            self._connect_now()
+        else:
+            threading.Thread(target=self._connect_bg,
+                             name=f"mxtpu-replica-connect-{name}",
+                             daemon=True).start()
+
+    # ---------------------------------------------------------- connection
+    def _connect_now(self):
+        self._client.connect(budget_s=self._connect_budget_s)
+        self._starting = False
+
+    def _connect_bg(self):
+        """Resolve a booting worker's address and connect — off the
+        router's threads, so a slow spawn never stalls placement or
+        resubmission for the healthy replicas."""
+        try:
+            info = self.worker.wait_ready(
+                timeout=self._connect_budget_s or 120.0)
+            self._client.address = (info["host"], info["port"])
+            self._connect_now()
+        except BaseException as e:  # noqa: BLE001 - health() surfaces it
+            self._start_error = e
+            self._starting = False
+
+    @property
+    def starting(self) -> bool:
+        """True while the worker is still booting/connecting: unhealthy
+        for placement, but the router must not evict it yet."""
+        return self._starting
+
+    @property
+    def client(self) -> RpcClient:
+        return self._client
+
+    # -------------------------------------------------------------- health
+    def health(self) -> tuple:
+        if self.evicted:
+            return False, "evicted"
+        if self._starting:
+            return False, "starting (worker booting)"
+        if self._start_error is not None:
+            return False, f"spawn failed: {self._start_error}"
+        now = time.monotonic()
+        if self._probe is not None and \
+                now - self._probe_at < self.probe_ttl_s:
+            return self._probe
+        result = self._probe_once()
+        self._probe = result
+        self._probe_at = now
+        return result
+
+    def _probe_once(self) -> tuple:
+        dead = self._client.dead
+        if dead is not None:
+            return False, f"transport down: {dead}"
+        try:
+            info = self._client.call("health",
+                                     timeout_s=self._rpc_timeout_s)
+        except Exception as e:  # noqa: BLE001 - a failed probe IS the answer
+            return False, f"health rpc failed: {e}"
+        self._probe_info = info
+        self._engine_handle.weights_version = info.get("weights_version")
+        if not info.get("healthy", False):
+            return False, f"worker reports {info.get('status', '?')}"
+        if self.heartbeat_path is not None:
+            hb = read_heartbeat(self.heartbeat_path)
+            if hb is not None:
+                if hb.get("status") in ("stalled", "hard_hang"):
+                    return False, f"heartbeat status {hb['status']}"
+                age = time.time() - float(hb.get("time", 0.0))
+                _tel.registry().gauge(
+                    "serve/worker_heartbeat_lag_ms").set(age * 1e3)
+                if age > self.heartbeat_stale_s:
+                    return False, f"heartbeat stale ({age:.1f}s)"
+        return True, "ok"
+
+    def load(self) -> int:
+        """Router-tracked in-flight plus the worker's last-reported
+        backlog (queued + occupied slots, from the health probe)."""
+        return self.inflight + int(self._probe_info.get("queue_depth", 0))
+
+    @property
+    def weights_version(self) -> Optional[str]:
+        return self._probe_info.get("weights_version")
+
+    # ------------------------------------------------------------- factory
+    @classmethod
+    def spawning(cls, worker, name: Optional[str] = None, **kwargs):
+        """Wrap a just-spawned ``WorkerHandle`` without blocking on its
+        boot — the respawn-factory shape."""
+        return cls(name or worker.name, worker=worker, **kwargs)

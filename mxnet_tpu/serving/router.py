@@ -21,16 +21,31 @@ owns the request lifecycle end to end:
 - **Replacement**: with a ``replica_factory``, evictions trigger
   respawn attempts under the same capped exponential backoff
   (``MXTPU_RESTART_BACKOFF_S``) that ``tools/launch.py`` uses for
-  whole-job elastic restarts.
+  whole-job elastic restarts. A factory-returned replica may report
+  ``starting`` (a worker process booting): it is skipped for placement
+  but not evicted until it either comes up or fails.
+- **Load shedding**: when EVERY replica is degraded — unhealthy,
+  backlogged past ``MXTPU_SHED_QUEUE_DEPTH``, or the router's rolling
+  completed-request queue-wait p50 past ``MXTPU_SHED_WAIT_MS`` — new
+  submits are shed at admission with ``Backpressure`` instead of
+  queueing behind work that cannot finish in time: a request whose
+  deadline is infeasible under the current p50 wait is shed
+  immediately (``serve/shed_deadline``), and once the router backlog
+  reaches ``MXTPU_SHED_MAX_QUEUE`` everything is
+  (``serve/shed_queue_full``) — queue growth is bounded by
+  construction, rather than by deadlines expiring inside the queue.
 
 Telemetry (``serve/`` family): ``requests``/``completed`` counters,
 ``failovers`` (evictions), ``retries`` (resubmissions), ``dropped``
 (failed after retries exhausted), ``deadline_exceeded``,
-``replica_restarts``, ``replicas_healthy`` gauge.
+``shed_deadline``/``shed_queue_full`` (admission sheds),
+``replica_restarts``, ``replicas_healthy`` +
+``shed_degraded_replicas`` gauges.
 """
 
 from __future__ import annotations
 
+import collections
 import os
 import random
 import threading
@@ -40,10 +55,12 @@ from typing import Callable, Optional, Sequence
 from ..base import MXNetError
 from .. import telemetry as _tel
 from ..telemetry.watchdog import read_heartbeat
-from .batcher import DeadlineExceeded, DynamicBatcher, GenerationResult
+from .batcher import Backpressure, DeadlineExceeded, DynamicBatcher, \
+    GenerationResult
 
 __all__ = ["Router", "Replica", "ReplicaUnavailable", "retry_max",
-           "restart_backoff_s"]
+           "restart_backoff_s", "shed_queue_depth", "shed_wait_ms",
+           "shed_max_queue"]
 
 
 class ReplicaUnavailable(MXNetError):
@@ -68,6 +85,39 @@ def restart_backoff_s(default: float = 1.0) -> float:
     v = os.environ.get("MXTPU_RESTART_BACKOFF_S", "").strip()
     try:
         return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def shed_queue_depth(default: int = 16) -> int:
+    """``MXTPU_SHED_QUEUE_DEPTH``: a replica whose load (router-assigned
+    in-flight + its own backlog) reaches this counts as DEGRADED for the
+    all-replicas-degraded shedding gate."""
+    v = os.environ.get("MXTPU_SHED_QUEUE_DEPTH", "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def shed_wait_ms(default: float = 0.0) -> float:
+    """``MXTPU_SHED_WAIT_MS``: rolling completed-request queue-wait p50
+    beyond which the fleet counts as degraded (0/unset disables the
+    wait-based gate; queue depth and health still apply)."""
+    v = os.environ.get("MXTPU_SHED_WAIT_MS", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def shed_max_queue(default: int = 128) -> int:
+    """``MXTPU_SHED_MAX_QUEUE``: hard bound on the router's in-flight
+    backlog while all replicas are degraded — admission beyond it sheds
+    with ``Backpressure`` (bounded queue growth by construction)."""
+    v = os.environ.get("MXTPU_SHED_MAX_QUEUE", "").strip()
+    try:
+        return int(v) if v else default
     except ValueError:
         return default
 
@@ -129,6 +179,13 @@ class Replica:
     def healthy(self) -> bool:
         return self.health()[0]
 
+    @property
+    def starting(self) -> bool:
+        """True while the replica is still coming up (a spawning worker
+        process): unhealthy for placement, exempt from eviction. In-
+        process replicas are ready at construction."""
+        return False
+
     def load(self) -> int:
         """Placement score: requests the router has in flight here plus
         the batcher's queued backlog (infer/ telemetry's queue_wait is
@@ -179,7 +236,12 @@ class Router:
                  replica_factory: Optional[Callable[[], Replica]] = None,
                  respawn_backoff_s: Optional[float] = None,
                  no_replica_timeout_s: float = 5.0,
+                 shed_queue_depth: Optional[int] = None,
+                 shed_wait_ms: Optional[float] = None,
+                 shed_max_queue: Optional[int] = None,
                  start: bool = True):
+        from . import router as _self  # module fns shadowed by kwargs
+
         self._replicas = list(replicas)
         if not self._replicas:
             raise MXNetError("Router needs at least one replica")
@@ -192,6 +254,13 @@ class Router:
         self._respawn_base = respawn_backoff_s if respawn_backoff_s \
             is not None else restart_backoff_s()
         self.no_replica_timeout_s = float(no_replica_timeout_s)
+        self.shed_queue_depth = shed_queue_depth \
+            if shed_queue_depth is not None else _self.shed_queue_depth()
+        self.shed_wait_ms = shed_wait_ms \
+            if shed_wait_ms is not None else _self.shed_wait_ms()
+        self.shed_max_queue = shed_max_queue \
+            if shed_max_queue is not None else _self.shed_max_queue()
+        self._recent_waits = collections.deque(maxlen=64)
         self._lock = threading.Lock()
         self._inflight: list = []
         self._respawn_at = None  # next respawn attempt instant
@@ -262,13 +331,77 @@ class Router:
         r = _Routed(prompt_ids, max_new_tokens, deadline, outer)
         _tel.registry().counter("serve/requests").inc()
         with self._lock:
-            if not self._assign_locked(r) and not self._may_recover_locked():
+            shed = self._shed_reason_locked(r)
+            if shed is not None:
+                kind, parts = shed
+            elif not self._assign_locked(r) \
+                    and not self._may_recover_locked():
                 outer._fail(RuntimeError(
                     "no healthy replicas and no replica_factory — "
                     "request cannot be placed"))
                 return outer
-            self._inflight.append(r)
+            else:
+                self._inflight.append(r)
+                return outer
+        msg = "; ".join(parts)  # formatted OUTSIDE the router lock
+        reg = _tel.registry()
+        reg.counter(f"serve/shed_{kind}").inc()
+        _tel.instant("serve.shed", {"kind": kind, "reason": msg})
+        outer._fail(Backpressure(f"router shed the request: {msg}"))
         return outer
+
+    # ------------------------------------------------------------- shedding
+    def _degraded_locked(self) -> Optional[list]:
+        """Per-replica degradation reasons when EVERY replica is
+        degraded — not healthy, or backlogged past ``shed_queue_depth``
+        — plus the fleet-wide rolling-wait gate; None while any replica
+        is in good shape (admission stays open). Returns reason PARTS
+        (callers format outside the lock)."""
+        reasons = []
+        for rep in self._replicas:
+            if rep.evicted:
+                continue
+            if rep.starting or not rep.healthy:
+                reasons.append(f"{rep.name}: unhealthy")
+            elif rep.load() >= self.shed_queue_depth:
+                reasons.append(f"{rep.name}: backlog {rep.load()} >= "
+                               f"{self.shed_queue_depth}")
+            else:
+                return None  # a replica in good shape: no shedding
+        if reasons:
+            return reasons
+        if self.shed_wait_ms > 0:
+            waits = sorted(self._recent_waits)
+            if len(waits) >= 8:
+                p50 = waits[len(waits) // 2]
+                if p50 > self.shed_wait_ms:
+                    return [f"queue wait p50 {p50:.0f} ms > "
+                            f"{self.shed_wait_ms:.0f} ms"]
+        return None
+
+    def _shed_reason_locked(self, r: _Routed) -> Optional[tuple]:
+        """Admission-time shed decision for one request; None admits.
+        Runs under the router lock (submit holds it); returns
+        ``(kind, message parts)`` — no string assembly here."""
+        degraded = self._degraded_locked()
+        if degraded is None:
+            return None
+        backlog = len(self._inflight)
+        if backlog >= self.shed_max_queue:
+            return ("queue_full", [
+                f"router backlog hit {backlog} >= {self.shed_max_queue} "
+                "(MXTPU_SHED_MAX_QUEUE) with all replicas degraded"]
+                + degraded)
+        if r.deadline is not None:
+            budget_ms = (r.deadline - time.perf_counter()) * 1e3
+            waits = sorted(self._recent_waits)
+            p50 = waits[len(waits) // 2] if len(waits) >= 8 else 0.0
+            if budget_ms <= 0 or p50 > budget_ms:
+                return ("deadline", [
+                    f"deadline budget {budget_ms:.0f} ms is infeasible "
+                    f"at queue-wait p50 {p50:.0f} ms with all replicas "
+                    "degraded"] + degraded)
+        return None
 
     def _may_recover_locked(self) -> bool:
         """Whether waiting could produce a healthy replica: a respawn
@@ -324,10 +457,18 @@ class Router:
             if rep.evicted:
                 continue
             ok, reason = rep.health()
-            if not ok:
+            if not ok and not rep.starting:
+                # a replica still booting (factory respawn: a worker
+                # process importing + warming) is skipped for placement
+                # but not evicted — its spawn failure is what evicts it
                 self._evict(rep, reason)
         healthy = sum(1 for rep in reps if rep.healthy)
-        _tel.registry().gauge("serve/replicas_healthy").set(healthy)
+        degraded = sum(1 for rep in reps if not rep.evicted
+                       and (rep.starting or not rep.healthy
+                            or rep.load() >= self.shed_queue_depth))
+        reg = _tel.registry()
+        reg.gauge("serve/replicas_healthy").set(healthy)
+        reg.gauge("serve/shed_degraded_replicas").set(degraded)
         if self._factory is not None and self._respawn_at is not None \
                 and now >= self._respawn_at:
             self._respawn()
@@ -412,9 +553,13 @@ class Router:
                         self._assign_locked(r)
                 continue
             if r.inner.done():
-                if r.replica is not None:
-                    with self._lock:
+                wait = r.inner.queue_wait_ms
+                with self._lock:
+                    if r.replica is not None:
                         r.replica.inflight = max(0, r.replica.inflight - 1)
+                    if r.inner.exception() is None and wait is not None:
+                        # feeds the shed gate's rolling p50
+                        self._recent_waits.append(wait)
                 err = r.inner.exception()
                 if err is None:
                     r.outer.weights_version = r.inner.weights_version

@@ -1,0 +1,541 @@
+"""Length-prefixed socket RPC for the cross-process serving plane.
+
+The PR-7 self-healing plane was in-process: a "replica crash" was a
+Python thread dying. This module is the boundary that makes it real —
+the router talks to worker *processes* (``serving.worker``) over a tiny
+explicit-schema RPC, so isolation, failover and swap coordination are
+exercised across the boundary that matters in deployments.
+
+Wire format: every frame is a 4-byte big-endian length prefix followed
+by one UTF-8 JSON object — **no pickle of arbitrary objects**, ever.
+Requests carry ``{"id": n, "verb": ..., ...payload}``; responses carry
+``{"id": n, "ok": bool, "done": bool, ...}``. A verb may answer with
+several frames: ``submit`` streams ``{"stream": [tokens...]}`` chunks
+(one per decode iteration under the worker's ``ContinuousBatcher``)
+before its final ``{"done": true, "tokens": [...]}`` frame.
+
+Verbs (the control channel of the cross-process plane):
+
+========== ===========================================================
+``submit``  enqueue one prompt into the worker's batcher; token chunks
+            stream back, the final frame carries the full trimmed
+            token list + ``weights_version``/``queue_wait_ms``.
+``health``  liveness/load snapshot: status (``serving``/``draining``),
+            queue depth, in-flight slots, ``weights_version``, pid.
+``stage``   phase 1 of the coordinated hot swap: the worker loads the
+            named committed checkpoint host-side and stages it into
+            its engine's standby buffer (``InferStep.stage_params``).
+``swap``    phase 2: flip the staged buffer live under the given
+            version tag — one reference assignment at a dispatch
+            boundary.
+``drain``   stop accepting new submits, finish in-flight requests,
+            reply when the batcher is drained (the SIGTERM path).
+``ping``    transport echo (connect probes, tests).
+========== ===========================================================
+
+Client calls take per-call timeouts (``MXTPU_RPC_TIMEOUT_S`` default)
+and the initial connect retries under the router's ``backoff_delay``
+(``MXTPU_RPC_CONNECT_S`` total budget) — a worker that is still booting
+is a retriable condition, not an outage. A dead connection fails every
+pending call with the client's ``dead_error`` (the router wires
+``ReplicaUnavailable`` so in-flight requests fail over transparently).
+
+Fault points (``serving.faults``): ``transport.send`` /
+``transport.recv`` — raise-mode drops the connection at that end,
+delay-mode injects latency, ``times=None`` on both simulates a
+partition; tags are the client/server name so ``match=`` can cut one
+replica's link.
+
+Telemetry: ``transport/rpc_ms`` per-call latency histogram,
+``transport/reconnects`` connect-retry counter, ``transport/errors``
+dead-connection counter; ``transport.dead`` instants mark connection
+loss.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from ..base import MXNetError
+from .. import telemetry as _tel
+from . import faults as _faults
+from .batcher import Backpressure, DeadlineExceeded, GenerationResult
+from .router import ReplicaUnavailable, backoff_delay
+
+__all__ = ["RpcClient", "RpcServer", "TransportError", "rpc_timeout_s",
+           "rpc_connect_s", "serve_port"]
+
+_MAX_FRAME = 64 << 20  # 64 MiB: a token stream frame is tiny; a header
+                       # this large means a corrupt/hostile peer
+
+# remote error types mapped back onto the caller's exception classes so
+# router semantics survive the wire (Backpressure retriable, deadline
+# final); anything unknown degrades to MXNetError
+_ERROR_TYPES = {
+    "Backpressure": Backpressure,
+    "DeadlineExceeded": DeadlineExceeded,
+    "ReplicaUnavailable": ReplicaUnavailable,
+}
+
+
+class TransportError(MXNetError):
+    """The RPC connection failed (dead socket, timeout, bad frame)."""
+
+
+def rpc_timeout_s(default: float = 30.0) -> float:
+    """``MXTPU_RPC_TIMEOUT_S``: default per-call RPC timeout (control
+    verbs; ``submit`` streams have no overall cap — deadlines do that)."""
+    v = os.environ.get("MXTPU_RPC_TIMEOUT_S", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def rpc_connect_s(default: float = 60.0) -> float:
+    """``MXTPU_RPC_CONNECT_S``: total budget for the initial connect
+    retry loop (a spawning worker needs import+build+warmup time)."""
+    v = os.environ.get("MXTPU_RPC_CONNECT_S", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def serve_port(default: int = 0) -> int:
+    """``MXTPU_SERVE_PORT``: base port for serving workers (0 = bind an
+    ephemeral port and announce it in ``worker.json``). Under
+    ``tools/launch.py`` each worker offsets by its ``MXNET_TPU_PROC_ID``."""
+    v = os.environ.get("MXTPU_SERVE_PORT", "").strip()
+    try:
+        return int(v) if v else default
+    except ValueError:
+        return default
+
+
+def parse_address(address) -> Tuple[str, int]:
+    if isinstance(address, (tuple, list)):
+        return str(address[0]), int(address[1])
+    host, _, port = str(address).rpartition(":")
+    return (host or "127.0.0.1"), int(port)
+
+
+# ------------------------------------------------------------------ frames
+def _send_frame(sock, msg: dict, tag=None) -> None:
+    """One frame out. The ``transport.send`` fault point sits before the
+    write: raise-mode = the link drops, delay-mode = a slow link."""
+    _faults.fire("transport.send", tag=tag)
+    body = json.dumps(msg).encode("utf-8")
+    sock.sendall(struct.pack(">I", len(body)) + body)
+
+
+def _recvall(sock, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise TransportError("connection closed mid-frame"
+                                 if buf else "connection closed")
+        buf += chunk
+    return buf
+
+
+def _recv_frame(sock, tag=None) -> dict:
+    """One frame in; raises :class:`TransportError` on EOF / bad data.
+    The ``transport.recv`` fault point models the receiving end of a
+    drop/partition."""
+    _faults.fire("transport.recv", tag=tag)
+    (n,) = struct.unpack(">I", _recvall(sock, 4))
+    if n > _MAX_FRAME:
+        raise TransportError(f"frame of {n} bytes exceeds the "
+                             f"{_MAX_FRAME}-byte cap (corrupt stream?)")
+    msg = json.loads(_recvall(sock, n).decode("utf-8"))
+    if not isinstance(msg, dict):
+        raise TransportError("frame is not a JSON object")
+    return msg
+
+
+def _remote_error(err: Optional[dict]) -> BaseException:
+    err = err or {}
+    cls = _ERROR_TYPES.get(err.get("type"), MXNetError)
+    return cls(f"remote: {err.get('message', 'unknown error')}")
+
+
+class _Call:
+    """Client-side record of one in-flight RPC id."""
+
+    __slots__ = ("queue", "future")
+
+    def __init__(self, queue=None, future=None):
+        self.queue = queue    # control verbs: a one-slot Queue
+        self.future = future  # submit: a GenerationResult
+
+
+class RpcClient:
+    """One connection to a serving worker.
+
+    A background reader thread routes response frames to their calls by
+    id, so concurrent ``call()``/``submit()`` from many threads share
+    the one socket. Thread-safety: ``_lock`` guards the call table and
+    the dead flag; ``_send_lock`` serializes frame writes; the two are
+    never nested.
+    """
+
+    def __init__(self, address, timeout_s: Optional[float] = None,
+                 name: Optional[str] = None,
+                 dead_error=TransportError):
+        self.address = parse_address(address)
+        self.timeout_s = timeout_s if timeout_s is not None \
+            else rpc_timeout_s()
+        self.name = name if name is not None else f"{self.address[1]}"
+        self._dead_error = dead_error
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._calls: Dict[int, _Call] = {}
+        self._next_id = 0
+        self._sock = None
+        self._dead: Optional[BaseException] = None
+        self._reader = None
+
+    # ----------------------------------------------------------- lifecycle
+    def connect(self, budget_s: Optional[float] = None,
+                backoff_base_s: float = 0.05) -> "RpcClient":
+        """Connect, retrying under capped exponential backoff until
+        ``budget_s`` (``MXTPU_RPC_CONNECT_S``) runs out — the peer may
+        still be importing jax and warming up its engine."""
+        budget = budget_s if budget_s is not None else rpc_connect_s()
+        deadline = time.monotonic() + budget
+        attempt = 0
+        while True:
+            try:
+                sock = socket.create_connection(self.address, timeout=5.0)
+                sock.settimeout(None)
+                break
+            except OSError as e:
+                attempt += 1
+                delay = backoff_delay(backoff_base_s, attempt - 1,
+                                      cap=1.0)
+                if time.monotonic() + delay > deadline:
+                    raise TransportError(
+                        f"could not connect to worker {self.name!r} at "
+                        f"{self.address} within {budget:.1f}s: {e}") \
+                        from e
+                _tel.registry().counter("transport/reconnects").inc()
+                time.sleep(delay)
+        with self._lock:
+            self._sock = sock
+            self._dead = None
+        self._reader = threading.Thread(
+            target=self._read_loop, name=f"mxtpu-rpc-{self.name}",
+            daemon=True)
+        self._reader.start()
+        return self
+
+    def close(self):
+        self._shutdown(TransportError(
+            f"client for worker {self.name!r} closed"))
+
+    @property
+    def dead(self) -> Optional[BaseException]:
+        """The error that killed the connection, or None while live."""
+        return self._dead
+
+    # ----------------------------------------------------------- requests
+    def _register(self, call: _Call) -> int:
+        with self._lock:
+            if self._dead is not None:
+                raise TransportError(
+                    f"connection to worker {self.name!r} is dead: "
+                    f"{self._dead}")
+            self._next_id += 1
+            self._calls[self._next_id] = call
+            return self._next_id
+
+    def _drop(self, call_id: int):
+        with self._lock:
+            self._calls.pop(call_id, None)
+
+    def _send(self, msg: dict):
+        try:
+            with self._send_lock:
+                _send_frame(self._sock, msg, tag=self.name)
+        except BaseException as e:
+            # a failed write means the link is gone: kill the connection
+            # so the reader's pending calls fail over too
+            self._shutdown(e)
+            raise TransportError(
+                f"send to worker {self.name!r} failed: {e}") from e
+
+    def call(self, verb: str, payload: Optional[dict] = None,
+             timeout_s: Optional[float] = None):
+        """One request/response RPC; returns the final frame's payload
+        dict. Raises :class:`TransportError` on timeout or a dead link,
+        or the mapped remote error class on ``ok: false``."""
+        import queue as _queue
+
+        timeout = timeout_s if timeout_s is not None else self.timeout_s
+        q = _queue.Queue(maxsize=4)
+        call_id = self._register(_Call(queue=q))
+        msg = {"id": call_id, "verb": str(verb)}
+        msg.update(payload or {})
+        t0 = time.perf_counter()
+        try:
+            self._send(msg)
+            try:
+                resp = q.get(timeout=timeout)
+            except _queue.Empty:
+                raise TransportError(
+                    f"rpc {verb!r} to worker {self.name!r} timed out "
+                    f"after {timeout:.1f}s") from None
+        finally:
+            self._drop(call_id)
+        _tel.registry().histogram("transport/rpc_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        if isinstance(resp, BaseException):
+            raise resp
+        if not resp.get("ok", False):
+            raise _remote_error(resp.get("error"))
+        return resp
+
+    def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
+               deadline_ms: Optional[float] = None) -> GenerationResult:
+        """Enqueue one prompt on the remote batcher. Returns a local
+        ``GenerationResult`` future fed by the response stream; a dead
+        connection fails it with the client's ``dead_error`` (the
+        router's signal to resubmit elsewhere)."""
+        import numpy as _np
+
+        prompt = _np.asarray(prompt_ids, dtype=_np.int64).reshape(-1)
+        fut = GenerationResult()
+        try:
+            call_id = self._register(_Call(future=fut))
+        except TransportError as e:
+            fut._fail(self._dead_error(str(e)))
+            return fut
+        msg = {"id": call_id, "verb": "submit",
+               "prompt": prompt.tolist()}
+        if max_new_tokens is not None:
+            msg["max_new_tokens"] = int(max_new_tokens)
+        if deadline_ms is not None:
+            msg["deadline_ms"] = float(deadline_ms)
+        try:
+            self._send(msg)
+        except TransportError as e:
+            self._drop(call_id)
+            if not fut.done():
+                fut._fail(self._dead_error(str(e)))
+        return fut
+
+    # -------------------------------------------------------- reader thread
+    def _read_loop(self):
+        sock = self._sock
+        try:
+            while True:
+                self._route(_recv_frame(sock, tag=self.name))
+        except BaseException as e:  # noqa: BLE001 - any read error = dead link
+            self._shutdown(e)
+
+    def _route(self, msg: dict):
+        call_id = msg.get("id")
+        done = msg.get("done", True)
+        with self._lock:
+            call = self._calls.get(call_id)
+            if call is not None and done:
+                self._calls.pop(call_id, None)
+        if call is None:
+            return  # zombie response after timeout/cancel: discarded
+        if call.queue is not None:
+            call.queue.put(msg)
+            return
+        fut = call.future
+        stream = msg.get("stream")
+        if stream:
+            fut._stream_tokens([int(t) for t in stream])
+        if not done:
+            return
+        if msg.get("ok", False):
+            fut.weights_version = msg.get("weights_version")
+            fut.replica = msg.get("replica", self.name)
+            fut.queue_wait_ms = msg.get("queue_wait_ms")
+            if not fut.done():
+                fut._resolve([int(t) for t in msg.get("tokens", ())])
+        elif not fut.done():
+            fut._fail(_remote_error(msg.get("error")))
+
+    def _shutdown(self, err: BaseException):
+        """Mark the connection dead and fail every pending call — no
+        future may ever be left unresolvable behind a dead socket."""
+        with self._lock:
+            already = self._dead is not None
+            if not already:
+                self._dead = err
+            pending = list(self._calls.values())
+            self._calls.clear()
+            sock, self._sock = self._sock, None
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if already and not pending:
+            return
+        if not already:
+            _tel.registry().counter("transport/errors").inc()
+            _tel.instant("transport.dead",
+                         {"worker": self.name, "error": repr(err)})
+        wrapped = self._dead_error(
+            f"connection to worker {self.name!r} died: {err}")
+        for call in pending:
+            if call.queue is not None:
+                call.queue.put(wrapped)
+            elif not call.future.done():
+                call.future._fail(wrapped)
+
+
+# ------------------------------------------------------------------ server
+class _Conn:
+    """One accepted connection: its socket plus a send lock so handler
+    threads (streamers) and the reader interleave whole frames."""
+
+    __slots__ = ("sock", "peer", "_send_lock")
+
+    def __init__(self, sock, peer):
+        self.sock = sock
+        self.peer = peer
+        self._send_lock = threading.Lock()
+
+    def send(self, msg: dict, tag=None) -> bool:
+        """Best-effort frame write; False when the peer is gone (a
+        streamer must simply stop, not crash the worker)."""
+        try:
+            with self._send_lock:
+                _send_frame(self.sock, msg, tag=tag)
+            return True
+        except BaseException:  # noqa: BLE001 - peer gone / injected drop
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+            return False
+
+    def close(self):
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+
+class RpcServer:
+    """Frame server over a handlers table: ``verb -> fn(payload,
+    respond)``.
+
+    Each connection gets a reader thread; quick verbs respond inline,
+    streaming verbs capture ``respond`` and reply from their own
+    threads. ``respond(done=..., ok=..., **fields)`` may be called any
+    number of times with ``done=False`` and exactly once with
+    ``done=True``.
+    """
+
+    def __init__(self, handlers: Dict[str, Callable],
+                 host: str = "127.0.0.1", port: int = 0,
+                 name: Optional[str] = None):
+        self._handlers = dict(handlers)
+        self.name = name
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, int(port)))
+        self._listener.listen(16)
+        self.host, self.port = self._listener.getsockname()[:2]
+        self._lock = threading.Lock()
+        self._conns: list = []
+        self._threads: list = []
+        self._stop = threading.Event()
+        self._accept_thread = None
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> "RpcServer":
+        if self._accept_thread is not None:
+            return self
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, name="mxtpu-rpc-accept", daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def stop(self, timeout: float = 5.0):
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self._conns)
+            self._conns = []
+            threads = list(self._threads)
+            self._threads = []
+        for conn in conns:
+            conn.close()
+        t, self._accept_thread = self._accept_thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+        for t in threads:
+            t.join(timeout=timeout)
+
+    # ------------------------------------------------------------- threads
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                sock, peer = self._listener.accept()
+            except OSError:
+                return  # listener closed (stop)
+            conn = _Conn(sock, peer)
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 name="mxtpu-rpc-conn", daemon=True)
+            with self._lock:
+                self._conns.append(conn)
+                self._threads.append(t)
+            t.start()
+
+    def _serve_conn(self, conn: _Conn):
+        try:
+            while not self._stop.is_set():
+                msg = _recv_frame(conn.sock, tag=self.name)
+                self._dispatch(conn, msg)
+        except BaseException:  # noqa: BLE001 - peer gone / injected drop
+            pass
+        finally:
+            conn.close()
+            with self._lock:
+                if conn in self._conns:
+                    self._conns.remove(conn)
+
+    def _dispatch(self, conn: _Conn, msg: dict):
+        call_id = msg.get("id")
+        verb = msg.get("verb")
+        tag = self.name
+
+        def respond(done: bool = True, ok: bool = True, **fields):
+            out = {"id": call_id, "ok": ok, "done": done}
+            out.update(fields)
+            return conn.send(out, tag=tag)
+
+        handler = self._handlers.get(verb)
+        if handler is None:
+            respond(ok=False, error={
+                "type": "TransportError",
+                "message": f"unknown verb {verb!r} (schema: "
+                           f"{sorted(self._handlers)})"})
+            return
+        try:
+            handler(msg, respond)
+        except BaseException as e:  # noqa: BLE001 - fail the call, not the conn
+            respond(ok=False, error={"type": type(e).__name__,
+                                     "message": str(e)})

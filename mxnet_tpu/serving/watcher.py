@@ -13,6 +13,18 @@ NEW committed checkpoint appears it
 3. **flips** every engine's live buffer (``swap_params``) — one reference
    assignment between decode dispatches.
 
+The engine set may mix IN-PROCESS engines (``InferStep``) and REMOTE
+worker processes (``serving.remote.RemoteEngineHandle``): the same
+two-phase protocol runs over the control channel — phase 1 sends each
+worker a ``stage`` verb (the worker loads the committed checkpoint
+host-side and stages standby; arrays never cross the socket), phase 2
+sends ``swap`` with ONE version tag derived once by the watcher
+(:func:`version_for`) — so every process flips at a dispatch boundary
+and version tags stay monotonic and coherent across the fleet. Staging
+is all-or-nothing: any stage failure (including a remote one) aborts
+the poll before ANY engine flips, counts ``serve/swap_failures``, and
+everyone keeps serving the old weights.
+
 In-flight dispatches hold their own param snapshot and finish on the old
 version; responses are tagged with the ``weights_version`` their dispatch
 actually served. A torn or unloadable checkpoint counts
@@ -32,7 +44,15 @@ from .. import checkpoint_sharded as _cs
 from .. import telemetry as _tel
 from . import faults as _faults
 
-__all__ = ["CheckpointWatcher", "swap_poll_s"]
+__all__ = ["CheckpointWatcher", "swap_poll_s", "version_for"]
+
+
+def version_for(path: str, token: str) -> str:
+    """Canonical version tag for a committed checkpoint — shared by the
+    watcher's flip and ``serving.worker --ckpt-dir`` boot adoption, so a
+    respawned process rejoins under the fleet's exact current tag."""
+    return os.path.basename(os.path.normpath(path)) + \
+        ":" + token.rsplit("@", 1)[-1]
 
 
 def swap_poll_s(default: float = 2.0) -> float:
@@ -67,7 +87,8 @@ class CheckpointWatcher:
                  start: bool = True):
         # NB: an InferStep is itself callable (its jitted forward), so
         # "factory" means callable-but-not-an-engine
-        if hasattr(engines, "stage_params"):
+        if hasattr(engines, "stage_params") or \
+                hasattr(engines, "stage_checkpoint"):
             fixed = [engines]
             self._engines_fn = lambda: fixed
         elif callable(engines):
@@ -142,25 +163,51 @@ class CheckpointWatcher:
         if token == self._seen:
             return None
         reg = _tel.registry()
+        engines = list(self._engines_fn())
+        local = [e for e in engines if hasattr(e, "stage_params")]
+        remote = [e for e in engines if hasattr(e, "stage_checkpoint")]
         try:
             # fault point: a checkpoint that commits but cannot be read
             # back (torn file, lost shard) mid-swap
             _faults.fire("ckpt.load", tag=path)
-            arrays = _cs.load_sharded(path)
-            engines = list(self._engines_fn())
-            # stage EVERYTHING before flipping ANYTHING: either all
-            # replicas move to the new version or none does
-            staged = [eng.stage_params(arrays) for eng in engines]
+            # phase 1 — stage EVERYTHING before flipping ANYTHING:
+            # either every replica (in-process or worker process) moves
+            # to the new version or none does. Workers load the
+            # committed checkpoint themselves (the `stage` verb) so
+            # arrays never cross the socket.
+            staged = []
+            if local:
+                arrays = _cs.load_sharded(path)
+                staged = [eng.stage_params(arrays) for eng in local]
+            for eng in remote:
+                eng.stage_checkpoint(path)
         except Exception as e:  # noqa: BLE001 - keep serving old weights
             self.last_error = e
             reg.counter("serve/swap_failures").inc()
             _tel.instant("serve.swap_failure",
                          {"path": path, "error": repr(e)})
             return None
-        version = os.path.basename(os.path.normpath(path)) + \
-            ":" + token.rsplit("@", 1)[-1]
-        for eng, vals in zip(engines, staged):
+        # phase 2 — flip ALL under one coherent tag, each at its own
+        # dispatch boundary. A remote flip can only fail if the worker
+        # died between the phases; it is then evicted/respawned and
+        # rejoins at this same version via --ckpt-dir boot adoption.
+        version = version_for(path, token)
+        for eng, vals in zip(local, staged):
             eng.swap_params(staged=vals, version=version)
+        flip_failures = 0
+        for eng in remote:
+            try:
+                eng.swap_staged(version)
+            except Exception as e:  # noqa: BLE001 - worker died mid-flip
+                flip_failures += 1
+                self.last_error = e
+                reg.counter("serve/swap_failures").inc()
+                _tel.instant("serve.swap_failure",
+                             {"path": path, "error": repr(e),
+                              "phase": "flip"})
+        if flip_failures and not local and \
+                flip_failures == len(remote):
+            return None  # nobody flipped: the next poll retries
         self._seen = token
         self.last_error = None
         reg.counter("serve/swaps").inc()

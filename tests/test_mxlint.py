@@ -426,6 +426,111 @@ class TestLockOrder:
         assert {f.key for f in findings} <= {
             "ContinuousBatcher._pending", "ContinuousBatcher._slots"}
 
+    def test_cross_process_modules_in_scope(self):
+        """The ISSUE-10 modules are part of the serving-plane set the
+        pass walks at HEAD (the head test above then proves them
+        finding-free)."""
+        assert {"mxnet_tpu/serving/transport.py",
+                "mxnet_tpu/serving/worker.py",
+                "mxnet_tpu/serving/remote.py"} <= set(lock_order.MODULES)
+
+
+class TestLockOrderTransport:
+    """Seeded controls in the RPC client's thread shape: a socket READER
+    thread routes responses while caller threads register calls — the
+    call table is cross-domain state."""
+
+    def test_unlocked_call_table_across_reader_flagged(self, tmp_path):
+        """Positive: the reader thread rebuilds the call table while
+        `call()` iterates it — the torn-table shape the real client must
+        lock against."""
+        _, _, shared = _analyze(tmp_path, """
+            import threading
+            class Client:
+                def __init__(self):
+                    self._calls = {}
+                    self._reader = threading.Thread(
+                        target=self._read_loop)
+                def _read_loop(self):
+                    self._calls = {}
+                def call(self, verb):
+                    return sorted(self._calls)
+            """)
+        assert any(attr == "_calls" for _, _, _, attr, _ in shared)
+
+    def test_locked_call_table_clean(self, tmp_path):
+        """Negative: every call-table touch under the client lock (the
+        real `RpcClient` shape) is clean — including a send lock that is
+        never nested with it."""
+        cycles, blocking, shared = _analyze(tmp_path, """
+            import threading
+            class Client:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._send_lock = threading.Lock()
+                    self._calls = {}
+                    self._reader = threading.Thread(
+                        target=self._read_loop)
+                def _read_loop(self):
+                    with self._lock:
+                        self._calls = {}
+                def call(self, verb):
+                    with self._lock:
+                        pending = list(self._calls.values())
+                    with self._send_lock:
+                        self._sock.sendall(verb)
+                    return pending
+            """)
+        assert not cycles and not blocking and not shared
+
+
+class TestLockOrderWorker:
+    """Seeded controls in the worker's thread shape: per-request
+    streamer threads relaying futures while handler/caller threads
+    manage shared staging state."""
+
+    def test_blocking_future_wait_under_lock_flagged(self, tmp_path):
+        """Positive: a streamer waiting on a future's result while
+        holding the worker lock couples every handler to decode
+        latency — the hung-worker shape."""
+        _, blocking, _ = _analyze(tmp_path, """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                def relay(self, fut):
+                    with self._lock:
+                        return fut.result()
+            """)
+        assert any("result" in m for _, _, _, _, m, _ in blocking)
+
+    def test_locked_staging_with_waits_outside_clean(self, tmp_path):
+        """Negative: the real worker shape — staged-swap state touched
+        only under the lock, future waits outside any lock, streamer
+        threads tracked under the lock — is clean."""
+        cycles, blocking, shared = _analyze(tmp_path, """
+            import threading
+            class Worker:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._staged = None
+                    self._streamers = []
+                    self._thread = threading.Thread(
+                        target=self._stream_result)
+                def _stream_result(self):
+                    with self._lock:
+                        self._streamers.append(1)
+                    return self._fut.result()
+                def handle_stage(self, arrays):
+                    with self._lock:
+                        self._staged = arrays
+                def handle_swap(self):
+                    with self._lock:
+                        staged, self._staged = self._staged, None
+                    return staged
+            """)
+        assert not cycles and not blocking and not shared
+
 
 # ================================================== donation self-tests
 class TestDonation:

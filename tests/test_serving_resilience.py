@@ -1,6 +1,6 @@
 """Self-healing serving plane: hot weight swap, router failover, faults.
 
-Contracts under test (ISSUE 7 tentpole):
+Contracts under test (ISSUE 7 tentpole + ISSUE 10 cross-process plane):
 
 - a hot weight swap under sustained ``DynamicBatcher`` load loses ZERO
   requests, responses carry the ``weights_version`` their dispatch
@@ -11,7 +11,16 @@ Contracts under test (ISSUE 7 tentpole):
   ``serve/failovers >= 1`` and zero steady-state recompiles;
 - the failure paths themselves are deterministic: ``serving.faults``
   drives dispatch raises, dispatcher-thread death, hangs, stale
-  heartbeats, and torn checkpoints from env specs or test code.
+  heartbeats, and torn checkpoints from env specs or test code;
+- CROSS-PROCESS (ISSUE 10): real ``serving.worker`` processes behind
+  the socket transport — SIGKILL mid-decode loses zero requests (one
+  failover, a respawned REAL process rejoins at the current version),
+  SIGTERM drains gracefully (exit 0, every in-flight request served),
+  and a coordinated swap flips every process onto ONE version tag with
+  post-swap greedy tokens bit-identical to a fresh engine;
+- LOAD SHEDDING: with every replica degraded the router sheds at
+  admission (``Backpressure`` + ``serve/shed_*``) and the backlog stays
+  bounded by construction; any healthy replica keeps admission open.
 """
 
 import json
@@ -28,10 +37,16 @@ from mxnet_tpu import checkpoint_sharded as cs
 from mxnet_tpu.base import MXNetError
 from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
 from mxnet_tpu.parallel import InferStep
-from mxnet_tpu.serving import (CheckpointWatcher, DeadlineExceeded,
-                               DynamicBatcher, Replica, ReplicaUnavailable,
-                               Router, faults)
+from mxnet_tpu.serving import (Backpressure, CheckpointWatcher,
+                               DeadlineExceeded, DynamicBatcher,
+                               RemoteReplica, Replica, ReplicaUnavailable,
+                               Router, RpcClient, RpcServer,
+                               TransportError, faults)
+from mxnet_tpu.serving.worker import make_transformer_net, spawn_worker
 from mxnet_tpu.telemetry.watchdog import Watchdog, read_heartbeat
+
+WORKER_ENV = {"JAX_PLATFORMS": os.environ.get("MXTPU_TEST_PLATFORM",
+                                              "cpu")}
 
 
 def _make_net(seed, prefix="serve_net_"):
@@ -750,6 +765,364 @@ class TestServeTelemetry:
         assert "serve/failovers" in out and "2" in out
         assert "launch/restarts" in out
         assert "WARNING" in out  # dropped > 0
+
+
+# -------------------------------------------------------------- transport
+class TestTransport:
+    """In-process RPC protocol tests (no worker processes): schema,
+    timeouts, streaming, and the transport fault points."""
+
+    def _server(self, handlers, name="srv"):
+        return RpcServer(handlers, name=name).start()
+
+    def test_roundtrip_and_unknown_verb(self):
+        srv = self._server({"ping": lambda m, r: r(pong=True, who="srv")})
+        cli = RpcClient(("127.0.0.1", srv.port), name="cli").connect(
+            budget_s=5.0)
+        try:
+            out = cli.call("ping", timeout_s=5.0)
+            assert out["pong"] and out["who"] == "srv"
+            with pytest.raises(MXNetError, match="unknown verb"):
+                cli.call("bogus", timeout_s=5.0)
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_per_call_timeout(self):
+        srv = self._server({"slow": lambda m, r: None})  # never replies
+        cli = RpcClient(("127.0.0.1", srv.port), name="cli").connect(
+            budget_s=5.0)
+        try:
+            t0 = time.perf_counter()
+            with pytest.raises(TransportError, match="timed out"):
+                cli.call("slow", timeout_s=0.2)
+            assert time.perf_counter() - t0 < 5.0
+            # the connection survives a timed-out call
+            assert cli.dead is None
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_connect_refused_within_budget(self):
+        cli = RpcClient(("127.0.0.1", 1), name="nobody")
+        with pytest.raises(TransportError, match="could not connect"):
+            cli.connect(budget_s=0.3)
+
+    def test_submit_streams_then_resolves(self):
+        def submit(msg, respond):
+            respond(done=False, stream=[1, 2])
+            respond(done=False, stream=[3])
+            respond(tokens=[1, 2, 3], weights_version="v7",
+                    queue_wait_ms=1.5, replica="srv")
+
+        srv = self._server({"submit": submit})
+        cli = RpcClient(("127.0.0.1", srv.port), name="cli").connect(
+            budget_s=5.0)
+        try:
+            fut = cli.submit([9, 9], 3)
+            chunks = list(fut.tokens_iter(timeout=10.0))
+            assert [t for c in chunks for t in c] == [1, 2, 3]
+            assert fut.result(timeout=10) == [1, 2, 3]
+            assert fut.weights_version == "v7" and fut.replica == "srv"
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_remote_error_maps_to_local_class(self):
+        def submit(msg, respond):
+            respond(ok=False, error={"type": "Backpressure",
+                                     "message": "pool full"})
+
+        srv = self._server({"submit": submit})
+        cli = RpcClient(("127.0.0.1", srv.port), name="cli").connect(
+            budget_s=5.0)
+        try:
+            fut = cli.submit([1], 2)
+            with pytest.raises(Backpressure, match="pool full"):
+                fut.result(timeout=10)
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_recv_fault_kills_connection_and_fails_pending(self):
+        """The `transport.recv` point in raise mode = a dropped link:
+        every pending call fails with the client's dead_error and the
+        client reports dead (the router's eviction signal)."""
+        srv = self._server({"submit": lambda m, r: None})  # holds forever
+        cli = RpcClient(("127.0.0.1", srv.port), name="cli-drop",
+                        dead_error=ReplicaUnavailable).connect(budget_s=5.0)
+        try:
+            fut = cli.submit([1, 2], 2)
+            assert not fut.done()
+            faults.inject("transport.recv", times=1, match="cli-drop")
+            # next inbound frame attempt trips the fault in the reader
+            srv_conns = srv._conns
+            deadline = time.perf_counter() + 10
+            while not srv_conns and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            for conn in list(srv_conns):
+                conn.send({"id": 999, "ok": True, "done": True})
+            deadline = time.perf_counter() + 10
+            while cli.dead is None and time.perf_counter() < deadline:
+                time.sleep(0.01)
+            assert cli.dead is not None
+            with pytest.raises(ReplicaUnavailable):
+                fut.result(timeout=10)
+        finally:
+            cli.close()
+            srv.stop()
+
+    def test_send_fault_marks_dead(self):
+        srv = self._server({"ping": lambda m, r: r(pong=True)})
+        cli = RpcClient(("127.0.0.1", srv.port), name="cli-send",
+                        dead_error=ReplicaUnavailable).connect(budget_s=5.0)
+        try:
+            faults.inject("transport.send", times=1, match="cli-send")
+            with pytest.raises(TransportError):
+                cli.call("ping", timeout_s=5.0)
+            assert cli.dead is not None
+        finally:
+            cli.close()
+            srv.stop()
+
+
+# ----------------------------------------------------------- load shedding
+class TestLoadShedding:
+    def _hung_replicas(self, engine, names=("shed-r1", "shed-r2"),
+                       delay=0.25):
+        for n in names:
+            faults.inject("batcher.hang", times=None, delay=delay,
+                          match=n)
+        return [Replica(n, _batcher(engine, name=n)) for n in names]
+
+    def test_all_degraded_bounds_queue(self, shared_engine):
+        """Acceptance: with every replica degraded (backlog past the
+        threshold) the router backlog never exceeds shed_max_queue and
+        every excess request is shed with Backpressure, counted in
+        serve/shed_queue_full."""
+        mx.telemetry.reset()
+        router = Router(self._hung_replicas(shared_engine),
+                        retry_backoff_s=0.01, health_interval_s=0.02,
+                        shed_queue_depth=1, shed_max_queue=3)
+        rng = np.random.RandomState(31)
+        futs, max_backlog = [], 0
+        try:
+            for p in _prompts(rng, 12):
+                futs.append(router.submit(p))
+                max_backlog = max(max_backlog, len(router._inflight))
+            shed = [f for f in futs
+                    if isinstance(f.exception(), Backpressure)]
+            assert shed, "no request was shed under a degraded fleet"
+            assert max_backlog <= 3, max_backlog
+            reg = mx.telemetry.registry()
+            assert reg.counter("serve/shed_queue_full").value == len(shed)
+            # the admitted ones still complete (bounded, not starved)
+            for f in futs:
+                if f not in shed:
+                    assert isinstance(f.result(timeout=120), list)
+        finally:
+            router.stop()
+            mx.telemetry.reset()
+
+    def test_deadline_infeasible_shed_immediately(self, shared_engine):
+        """A deadline the rolling wait p50 cannot meet is shed AT
+        admission (serve/shed_deadline) instead of queueing until the
+        deadline fails it."""
+        mx.telemetry.reset()
+        router = Router(self._hung_replicas(
+            shared_engine, names=("shed-r3", "shed-r4")),
+            retry_backoff_s=0.01, health_interval_s=0.02,
+            shed_queue_depth=1, shed_max_queue=64)
+        rng = np.random.RandomState(32)
+        try:
+            # occupy both replicas so the fleet counts as degraded
+            pinned = [router.submit(p) for p in _prompts(rng, 2)]
+            time.sleep(0.05)
+            with router._lock:  # prime the rolling wait window
+                router._recent_waits.extend([200.0] * 10)
+            doomed = router.submit(rng.randint(3, 61, (5,))
+                                   .astype(np.int32), deadline_ms=50.0)
+            assert isinstance(doomed.exception(), Backpressure)
+            assert mx.telemetry.registry().counter(
+                "serve/shed_deadline").value == 1
+            # a feasible deadline is still admitted
+            ok = router.submit(rng.randint(3, 61, (5,)).astype(np.int32),
+                               deadline_ms=60_000.0)
+            assert isinstance(ok.result(timeout=120), list)
+            for f in pinned:
+                f.result(timeout=120)
+        finally:
+            router.stop()
+            mx.telemetry.reset()
+
+    def test_healthy_replica_keeps_admission_open(self, shared_engine):
+        """Shedding must NOT engage while any replica is in good shape —
+        placement, not admission control, handles partial degradation."""
+        faults.inject("batcher.hang", times=None, delay=0.25,
+                      match="shed-r5")
+        reps = [Replica("shed-r5", _batcher(shared_engine, name="shed-r5")),
+                Replica("shed-ok", _batcher(shared_engine, name="shed-ok"))]
+        router = Router(reps, retry_backoff_s=0.01,
+                        health_interval_s=0.02, shed_queue_depth=3,
+                        shed_max_queue=2)
+        rng = np.random.RandomState(33)
+        try:
+            futs = []
+            for p in _prompts(rng, 6):
+                futs.append(router.submit(p))
+                time.sleep(0.05)  # the healthy replica keeps draining
+            assert not any(isinstance(f.exception(), Backpressure)
+                           for f in futs)
+            for f in futs:
+                assert isinstance(f.result(timeout=120), list)
+        finally:
+            router.stop()
+
+    def test_report_shed_fields_and_transport_section(self, tmp_path,
+                                                      capsys):
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(__file__), "..", "tools"))
+        import telemetry_report
+
+        report = {
+            "counters": {"serve/shed_queue_full": 4,
+                         "serve/shed_deadline": 2,
+                         "transport/reconnects": 1,
+                         "transport/errors": 1},
+            "histograms": {"transport/rpc_ms":
+                           {"p50": 1.0, "p95": 2.0, "count": 9}},
+        }
+        p = tmp_path / "report.json"
+        p.write_text(json.dumps(report))
+        telemetry_report._print_transport_family(str(p))
+        out = capsys.readouterr().out
+        assert "Cross-process transport" in out
+        assert "transport/rpc_ms" in out
+        assert "serve/shed_queue_full" in out
+        assert "shed at router admission" in out  # shed warning
+        assert "dead worker connection" in out    # error warning
+
+
+# ------------------------------------------------------------ cross-process
+def _spawn_pair(tmp_path, ckpt_dir, n=2, **kw):
+    wkw = dict(model=dict(seed=0), max_len=24, bucket_keys=(8,), slots=2,
+               max_new=4, ckpt_dir=ckpt_dir, extra_env=WORKER_ENV,
+               heartbeat_s=0.1)
+    wkw.update(kw)
+    return [spawn_worker(str(tmp_path / f"w{i}"), name=f"w{i}", **wkw)
+            for i in range(n)]
+
+
+@pytest.mark.chaos
+class TestCrossProcess:
+    def test_sigkill_failover_respawn_and_coordinated_swap(self, tmp_path):
+        """THE cross-process acceptance scenario: 2 real worker
+        processes under load; a coordinated swap lands, then one worker
+        is SIGKILL'd mid-decode. Zero lost requests, exactly one
+        failover, the factory respawns a REAL process that rejoins at
+        the swapped version, every live process reports ONE coherent
+        version tag, and post-swap greedy tokens are bit-identical to a
+        fresh in-process engine from the same checkpoint."""
+        mx.telemetry.reset()
+        ckpt = str(tmp_path / "ckpt")
+        handles = _spawn_pair(tmp_path, ckpt)
+        made = []
+
+        def factory():
+            h = spawn_worker(str(tmp_path / f"w{2 + len(made)}"),
+                             name=f"w{2 + len(made)}", model=dict(seed=0),
+                             max_len=24, bucket_keys=(8,), slots=2,
+                             max_new=4, ckpt_dir=ckpt,
+                             extra_env=WORKER_ENV, heartbeat_s=0.1)
+            made.append(h)
+            return RemoteReplica.spawning(h, heartbeat_stale_s=1.0)
+
+        reps = [RemoteReplica(h.name, address=h.address,
+                              heartbeat_path=h.heartbeat_path,
+                              heartbeat_stale_s=1.0) for h in handles]
+        router = Router(reps, retry_backoff_s=0.02,
+                        health_interval_s=0.05, replica_factory=factory,
+                        respawn_backoff_s=0.05, no_replica_timeout_s=60.0)
+        net_b = make_transformer_net(seed=1)
+        cs.save_sharded(os.path.join(ckpt, "step_1"),
+                        {n: p._data.data
+                         for n, p in net_b.collect_params().items()})
+        watcher = CheckpointWatcher(router.engines, ckpt, start=False)
+        rng = np.random.RandomState(17)
+        futs, swap_ver = [], None
+        try:
+            for i, p in enumerate(_prompts(rng, 30)):
+                futs.append(router.submit(p))
+                if i == 8:
+                    swap_ver = watcher.poll_once()
+                    assert swap_ver is not None
+                if i == 16:
+                    handles[1].kill()  # SIGKILL mid-decode
+                time.sleep(0.01)
+            results = [f.result(timeout=240) for f in futs]
+            assert all(isinstance(r, list) for r in results)
+            reg = mx.telemetry.registry()
+            assert reg.counter("serve/failovers").value == 1
+            assert reg.counter("serve/dropped").value == 0
+            versions = {f.weights_version for f in futs}
+            assert versions == {"v0", swap_ver}, versions
+            # respawned process rejoins, healthy, on the swapped version
+            deadline = time.perf_counter() + 120
+            live = []
+            while time.perf_counter() < deadline:
+                live = [r for r in router.replicas
+                        if not r.evicted and r.healthy]
+                if len(live) >= 2:
+                    break
+                time.sleep(0.1)
+            assert len(live) >= 2, "respawned worker never became healthy"
+            assert made, "factory never invoked"
+            assert {r.weights_version for r in live} == {swap_ver}
+            assert reg.counter("serve/replica_restarts").value == 1
+            # post-swap greedy tokens bit-identical to a fresh engine
+            fresh = InferStep(net_b, max_len=24)
+            src = rng.randint(3, 61, (2, 8)).astype(np.int32)
+            toks, lens = fresh.decode_n(src, np.array([8, 8], np.int32),
+                                        max_new_tokens=4)
+            toks, lens = toks.asnumpy(), lens.asnumpy()
+            for r in live:
+                for row in range(2):
+                    got = r.batcher.submit(src[row], 4).result(timeout=120)
+                    want = toks[row, :min(int(lens[row]), 4)].tolist()
+                    assert got == want, (r.name, got, want)
+        finally:
+            router.stop()
+            for h in handles + made:
+                if h.alive():
+                    h.terminate()
+            for h in handles + made:
+                try:
+                    h.wait(timeout=60)
+                except Exception:  # noqa: BLE001 - teardown best-effort
+                    h.kill()
+            mx.telemetry.reset()
+
+    def test_sigterm_drains_gracefully(self, tmp_path):
+        """SIGTERM mid-load: every already-accepted request is served
+        (drained, not dropped), the worker exits 0, and post-drain
+        submits are rejected as retriable ReplicaUnavailable."""
+        h = _spawn_pair(tmp_path, None, n=1)[0]
+        rep = RemoteReplica(h.name, address=h.address,
+                            heartbeat_path=h.heartbeat_path)
+        rng = np.random.RandomState(19)
+        try:
+            futs = [rep.batcher.submit(p, 4) for p in _prompts(rng, 6)]
+            time.sleep(0.2)  # ensure the worker accepted them
+            h.terminate()
+            results = [f.result(timeout=240) for f in futs]
+            assert all(isinstance(r, list) for r in results)
+            assert h.wait(timeout=120) == 0
+        finally:
+            if h.alive():
+                h.kill()
+            rep.batcher.stop(drain=False)
 
 
 # ------------------------------------------------------------ chaos smoke

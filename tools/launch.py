@@ -17,9 +17,18 @@ Launchers:
          reference's ssh tracker). Assumes a shared working directory and
          passwordless ssh, like the reference.
 
+The same spawn machinery brings up a SERVING fleet: each
+``mxnet_tpu.serving.worker`` process reads its rank from
+``MXNET_TPU_PROC_ID`` to derive its name (``worker-<rank>``), its state
+subdirectory and its port offset from ``MXTPU_SERVE_PORT``, so one
+launch line starts N workers a router can front via
+``serving.RemoteReplica``.
+
 Examples:
   python tools/launch.py -n 4 -- python train.py --kv-store dist_sync
   python tools/launch.py -n 8 --launcher ssh -H hosts.txt -- python train.py
+  MXTPU_SERVE_PORT=7070 python tools/launch.py -n 2 -- \\
+      python -m mxnet_tpu.serving.worker --dir /tmp/fleet
 """
 
 from __future__ import annotations
@@ -59,6 +68,30 @@ def _pump(proc: subprocess.Popen, tag: str):
         sys.stdout.flush()
 
 
+def spawn_procs(num_procs: int, command, coordinator: str | None = None,
+                env_extra: dict | None = None):
+    """Spawn ``command`` num_procs times with the rendezvous env vars;
+    returns ``(procs, pumps)`` — the reusable half of :func:`launch_local`
+    (chaos drivers spawn serving-worker fleets through it and keep the
+    per-process handles so they can SIGKILL/SIGTERM individuals)."""
+    procs = []
+    pumps = []
+    for pid in range(num_procs):
+        env = worker_env(coordinator, num_procs, pid)
+        env.update(env_extra or {})
+        p = subprocess.Popen(
+            command,
+            env=env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+        )
+        t = threading.Thread(target=_pump, args=(p, f"worker-{pid}"), daemon=True)
+        t.start()
+        procs.append(p)
+        pumps.append(t)
+    return procs, pumps
+
+
 def launch_local(num_procs: int, command, coordinator: str | None = None,
                  timeout: float | None = None):
     """Spawn ``command`` num_procs times locally; returns max exit code.
@@ -69,19 +102,7 @@ def launch_local(num_procs: int, command, coordinator: str | None = None,
     tracker killed the job the same way. ``timeout`` (seconds) bounds the
     whole job; expiry kills all workers and returns 124."""
     coordinator = coordinator or f"localhost:{find_free_port()}"
-    procs = []
-    pumps = []
-    for pid in range(num_procs):
-        p = subprocess.Popen(
-            command,
-            env=worker_env(coordinator, num_procs, pid),
-            stdout=subprocess.PIPE,
-            stderr=subprocess.STDOUT,
-        )
-        t = threading.Thread(target=_pump, args=(p, f"worker-{pid}"), daemon=True)
-        t.start()
-        procs.append(p)
-        pumps.append(t)
+    procs, pumps = spawn_procs(num_procs, command, coordinator)
 
     def _kill_all():
         for p in procs:

@@ -31,6 +31,7 @@ KNOWN_METRIC_FAMILIES = {
     "infer": "Inference / serving",
     "serve": "Self-healing serving",
     "launch": "Self-healing serving",
+    "transport": "Cross-process transport",
     "shard": "SPMD sharding",
     "trainer": "Host-side training",
     "kvstore": "Host-side training",
@@ -46,7 +47,7 @@ KNOWN_METRIC_FAMILIES = {
 KNOWN_SPAN_FAMILIES = {
     "checkpoint", "dataloader", "estimator", "imperative", "infer",
     "input", "kvstore", "launch", "serve", "trainer", "trainstep",
-    "watchdog",
+    "transport", "watchdog",
 }
 
 
@@ -270,6 +271,50 @@ def _print_serve_family(report_path):
               "exhaustion — check replica health and MXTPU_RETRY_MAX")
 
 
+def _print_transport_family(report_path):
+    """Surface the ``transport/`` metric family (cross-process serving
+    plane: per-call RPC latency, connect retries, dead connections) plus
+    the router's worker-facing shed counters from a ``report.json``
+    snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith("transport/")}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k.startswith("transport/")}
+    hists = {k: v for k, v in report.get("histograms", {}).items()
+             if k.startswith("transport/")}
+    sheds = {k: v for k, v in report.get("counters", {}).items()
+             if k.startswith("serve/shed_")}
+    if not counters and not gauges and not hists and not sheds:
+        return
+    print("\n== Cross-process transport ==")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    for k in sorted(hists):
+        h = hists[k]
+        print(f"  {k:<38} p50={h.get('p50')} p95={h.get('p95')} "
+              f"n={h.get('count')}")
+    for k in sorted(sheds):
+        print(f"  {k:<38} {sheds[k]}")
+    shed_total = sum(sheds.values())
+    if shed_total:
+        print(f"  WARNING: {shed_total} request(s) shed at router "
+              "admission — every replica was degraded; scale out or "
+              "relax MXTPU_SHED_* thresholds")
+    errors = counters.get("transport/errors", 0)
+    if errors:
+        print(f"  WARNING: {errors} dead worker connection(s) — check "
+              "worker logs/heartbeats for crashes or partitions")
+
+
 def _print_shard_family(report_path):
     """Surface the ``shard/`` metric family (SPMD sharding spine: mesh
     shape, global vs per-shard parameter bytes, collective-traffic
@@ -347,6 +392,7 @@ def main(argv=None):
         _print_infer_family(os.path.join(directory, "report.json"))
         _print_shard_family(os.path.join(directory, "report.json"))
         _print_serve_family(os.path.join(directory, "report.json"))
+        _print_transport_family(os.path.join(directory, "report.json"))
     return 0
 
 
