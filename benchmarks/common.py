@@ -128,6 +128,51 @@ def infer_fields():
     return fields
 
 
+def disagg_fields():
+    """Disaggregated-serving bench-row columns from the ``disagg/``
+    metric family plus the scaler counters (null-safe). NOTE the
+    router-side registry only sees the router's half (per-class TTFT,
+    fallback re-prefills, scale actions); worker-side adoption/push
+    figures live in the worker processes and ride the health verb —
+    benches report those separately."""
+    fields = {
+        "disagg_re_prefills": 0,
+        "disagg_handoffs": 0,
+        "kv_push_ms_p50": None,
+        "kv_bytes": 0,
+        "ttft_interactive_ms_p50": None,
+        "ttft_interactive_ms_p95": None,
+        "ttft_batch_ms_p50": None,
+        "ttft_batch_ms_p95": None,
+        "scale_up": 0,
+        "scale_down": 0,
+    }
+    try:
+        from mxnet_tpu import telemetry as _tel
+
+        snap = _tel.registry().snapshot()
+        h = snap["histograms"]
+        c = snap["counters"]
+        if "disagg/kv_push_ms" in h:
+            fields["kv_push_ms_p50"] = h["disagg/kv_push_ms"]["p50"]
+        if "disagg/ttft_interactive_ms" in h:
+            fields["ttft_interactive_ms_p50"] = \
+                h["disagg/ttft_interactive_ms"]["p50"]
+            fields["ttft_interactive_ms_p95"] = \
+                h["disagg/ttft_interactive_ms"]["p95"]
+        if "disagg/ttft_batch_ms" in h:
+            fields["ttft_batch_ms_p50"] = h["disagg/ttft_batch_ms"]["p50"]
+            fields["ttft_batch_ms_p95"] = h["disagg/ttft_batch_ms"]["p95"]
+        fields["disagg_re_prefills"] = c.get("disagg/re_prefills", 0)
+        fields["disagg_handoffs"] = c.get("disagg/handoffs", 0)
+        fields["kv_bytes"] = c.get("disagg/kv_bytes", 0)
+        fields["scale_up"] = c.get("serve/scale_up", 0)
+        fields["scale_down"] = c.get("serve/scale_down", 0)
+    except Exception:  # noqa: BLE001 - telemetry must never kill a bench
+        pass
+    return fields
+
+
 def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
               warmup=3, steps=20, windows=4):
     """Time ``step_fn`` and print the driver JSON line.

@@ -51,7 +51,9 @@ MODULES = (
     "mxnet_tpu/serving/transport.py",
     "mxnet_tpu/serving/worker.py",
     "mxnet_tpu/serving/remote.py",
+    "mxnet_tpu/serving/disagg.py",
     "mxnet_tpu/telemetry/watchdog.py",
+    "tools/launch.py",
 )
 
 LOCK_TYPES = {"threading.Lock", "threading.RLock", "threading.Condition"}

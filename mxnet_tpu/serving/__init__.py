@@ -38,6 +38,18 @@ and replica failures:
   resubmission → factory respawns a REAL process) and the
   ``CheckpointWatcher`` drives the same stage-all-then-flip-all hot
   swap over the control channel so every process flips coherently.
+- ``disagg`` splits the fleet into prefill and decode roles
+  (``MXTPU_ROLE``): prefill workers run the admission prefill and ship
+  the filled KV page frames over the ``kv_push`` transport verb (or the
+  ``MXTPU_KV_SPILL_DIR`` filesystem spill) to decode workers whose
+  batcher ADOPTS them without re-prefilling — bit-identical greedy
+  tokens, with any handoff failure degrading to a local re-prefill
+  (zero lost requests). The router is SLO-aware: predicted-wait
+  placement (worker-reported rolling p50 × backlog, rotating
+  tie-break), request classes (``interactive``/``batch``) with
+  per-class deadline defaults (``MXTPU_SLO_*_MS``) and batch-first
+  shedding, and ``tools.launch.FleetScaler`` elasticity
+  (``MXTPU_SCALE_*``).
 - ``faults`` plants deterministic failure points in all of the above
   (``MXTPU_FAULT_*``), so the failure paths are testable in tier-1.
 
@@ -57,14 +69,18 @@ backoff base, shared with ``tools/launch.py``), ``MXTPU_SERVE_PORT`` /
 specs — see ``serving.faults``).
 """
 
+from . import disagg
 from . import faults
 from . import pages
 from .batcher import Backpressure, ContinuousBatcher, DeadlineExceeded, \
     DynamicBatcher, GenerationResult, batcher_kind, batcher_slots, \
     batcher_timeout_ms, iter_tokens_default, make_batcher
+from .disagg import HandoffStash, PrefillEngine, kv_spill_dir, \
+    worker_role
 from .pages import PagePool
-from .router import Replica, ReplicaUnavailable, Router, restart_backoff_s, \
-    retry_max, shed_max_queue, shed_queue_depth, shed_wait_ms
+from .router import REQUEST_CLASSES, Replica, ReplicaUnavailable, \
+    Router, restart_backoff_s, retry_max, shed_max_queue, \
+    shed_queue_depth, shed_wait_ms, slo_batch_ms, slo_interactive_ms
 from .remote import RemoteEngineHandle, RemoteReplica
 from .transport import RpcClient, RpcServer, TransportError, \
     rpc_connect_s, rpc_timeout_s, serve_port
@@ -79,4 +95,6 @@ __all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
            "make_batcher", "swap_poll_s", "version_for", "retry_max",
            "restart_backoff_s", "shed_queue_depth", "shed_wait_ms",
            "shed_max_queue", "rpc_timeout_s", "rpc_connect_s",
-           "serve_port"]
+           "serve_port", "disagg", "PrefillEngine", "HandoffStash",
+           "worker_role", "kv_spill_dir", "REQUEST_CLASSES",
+           "slo_interactive_ms", "slo_batch_ms"]

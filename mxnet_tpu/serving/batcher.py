@@ -221,13 +221,17 @@ class GenerationResult:
 
 
 class _Request:
-    __slots__ = ("prompt", "max_new", "future", "deadline")
+    __slots__ = ("prompt", "max_new", "future", "deadline", "frames")
 
-    def __init__(self, prompt, max_new, future, deadline=None):
+    def __init__(self, prompt, max_new, future, deadline=None,
+                 frames=None):
         self.prompt = prompt
         self.max_new = max_new
         self.future = future
         self.deadline = deadline  # absolute perf_counter instant or None
+        # disaggregated serving: prefilled KV frames shipped by a
+        # prefill-role worker (serving.disagg); None = prefill locally
+        self.frames = frames
 
 
 class _BatcherBase:
@@ -256,10 +260,48 @@ class _BatcherBase:
         self.name = name
         self._watchdog = watchdog
         self._queue: "queue.Queue[_Request]" = queue.Queue()
+        self._init_rolling()
         self._stop = threading.Event()
         self._thread = None
         if start:
             self.start()
+
+    # --------------------------------------------------- SLO telemetry
+    def _init_rolling(self):
+        """Rolling SLO windows (queue wait / TTFT) feeding the worker's
+        health report and the router's predicted-wait placement; written
+        by the scheduler thread, read by caller threads — every touch
+        holds ``_roll_lock`` (and nothing blocking runs under it)."""
+        self._roll_lock = threading.Lock()
+        self._recent_waits = collections.deque(maxlen=64)
+        self._recent_ttft = collections.deque(maxlen=64)
+
+    def _note_wait(self, ms: float):
+        with self._roll_lock:
+            self._recent_waits.append(ms)
+
+    def _note_ttft(self, ms: float):
+        with self._roll_lock:
+            self._recent_ttft.append(ms)
+
+    def rolling_wait_ms(self, min_samples: int = 8) -> Optional[float]:
+        """Rolling queue-wait p50 (ms) over recent completions, or None
+        below ``min_samples`` — the worker-reported signal behind both
+        admission control and SLO-aware router placement."""
+        with self._roll_lock:
+            waits = sorted(self._recent_waits)
+        if len(waits) < min_samples:
+            return None
+        return waits[len(waits) // 2]
+
+    def rolling_ttft_ms(self, min_samples: int = 4) -> Optional[float]:
+        """Rolling time-to-first-token p50 (ms), or None below
+        ``min_samples``."""
+        with self._roll_lock:
+            ttft = sorted(self._recent_ttft)
+        if len(ttft) < min_samples:
+            return None
+        return ttft[len(ttft) // 2]
 
     def _label(self) -> str:
         return f"{type(self).__name__}" + (f" {self.name!r}"
@@ -331,7 +373,8 @@ class _BatcherBase:
         return True
 
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> GenerationResult:
+               deadline_ms: Optional[float] = None,
+               frames: Optional[dict] = None) -> GenerationResult:
         """Enqueue one prompt (1-D int sequence). Returns a future whose
         ``result()`` is the generated token list, trimmed at EOS and at
         the request's ``max_new_tokens`` (<= the batcher's).
@@ -340,6 +383,13 @@ class _BatcherBase:
         request still queued (or, under continuous batching, still
         decoding) when its deadline passes is failed with
         ``DeadlineExceeded`` instead of being served late.
+
+        ``frames`` carries prefilled KV from a prefill-role worker
+        (``serving.disagg``): ``ContinuousBatcher`` adopts them into its
+        pool at admission instead of re-running the prefill; any
+        adoption failure (and the ``DynamicBatcher`` fallback, which has
+        no paged pool) re-prefills from the prompt — the request is
+        served either way.
 
         Submitting to a stopped (or crashed) batcher fails the future
         immediately with a RuntimeError — a request must never enqueue
@@ -366,7 +416,8 @@ class _BatcherBase:
             return fut
         deadline = None if deadline_ms is None \
             else time.perf_counter() + float(deadline_ms) / 1e3
-        self._queue.put(_Request(prompt, max_new, fut, deadline))
+        self._queue.put(_Request(prompt, max_new, fut, deadline,
+                                 frames=frames))
         return fut
 
     def _expire(self, reqs):
@@ -539,13 +590,16 @@ class DynamicBatcher(_BatcherBase):
                 - dispatch_ms
             reg.histogram("infer/queue_wait_ms").observe(
                 max(r.future.queue_wait_ms, 0.0))
+            self._note_wait(max(r.future.queue_wait_ms, 0.0))
             emitted += n
             r.future.weights_version = version
             r.future.replica = self.name
             r.future._resolve(tokens[i, :n].tolist())
             if r.future.first_token_at is not None:
-                reg.histogram("infer/ttft_ms").observe(
-                    (r.future.first_token_at - r.future.enqueued_at) * 1e3)
+                ttft = (r.future.first_token_at
+                        - r.future.enqueued_at) * 1e3
+                reg.histogram("infer/ttft_ms").observe(ttft)
+                self._note_ttft(ttft)
         wd = self._watchdog
         if wd is not None:
             wd.notify_step(seconds=dispatch_ms / 1e3)
@@ -649,7 +703,6 @@ class ContinuousBatcher(_BatcherBase):
             if admit_max_queue is not None else _pages.admit_max_queue()
         self._admit_max_wait_ms = admit_max_wait_ms \
             if admit_max_wait_ms is not None else _pages.admit_max_wait_ms()
-        self._recent_waits = collections.deque(maxlen=64)
         self.pool = _pages.PagePool(self.num_pages, self.page_size,
                                     self.slots, self.pages_per_slot)
         self._state = engine.init_paged_state(
@@ -666,7 +719,11 @@ class ContinuousBatcher(_BatcherBase):
         self._stats_lock = threading.Lock()
         self.stats = {"iterations": 0, "occupancy_sum": 0.0,
                       "admitted": 0, "retired": 0, "preempted": 0,
-                      "rejected": 0, "tokens": 0}
+                      "rejected": 0, "tokens": 0,
+                      # disaggregated serving: KV handoffs adopted into
+                      # this pool / handoffs that fell back to a local
+                      # re-prefill (serving.disagg)
+                      "adopted": 0, "re_prefills": 0}
         if warmup:
             self._warmup()
         if start:
@@ -705,6 +762,25 @@ class ContinuousBatcher(_BatcherBase):
             _np.zeros((self.slots,), bool), steps=self.iter_tokens,
             **self._sampling)
         jax.block_until_ready(buf.data)
+        # warm the disaggregated-handoff adoption scatters too: the
+        # first `.at[].set` per pool array otherwise compiles on the
+        # scheduler thread mid-serving (a ~200 ms TTFT spike on the
+        # first adopted request, measured on the CPU rig)
+        if self.pool.alloc(0, 1):
+            st = self._state
+            fake = {"length": 1, "carry": 0, "emitted": [0], "mem_vl": 1,
+                    "k": [_np.zeros((1,) + tuple(p.shape[2:]), _np.float32)
+                          for p in st["k_pools"]],
+                    "v": [_np.zeros((1,) + tuple(p.shape[2:]), _np.float32)
+                          for p in st["v_pools"]],
+                    "ck": [_np.zeros((1,) + tuple(c.shape[2:]),
+                                     _np.float32)
+                           for c in st["cross_k"]],
+                    "cv": [_np.zeros((1,) + tuple(c.shape[2:]),
+                                     _np.float32)
+                           for c in st["cross_v"]]}
+            self._adopt(0, fake)
+            self.pool.release(0)
         reg.counter("compile/warmup_compiles").inc(
             eng.compile_guard.signatures - before)
         eng.compile_guard.mark_steady()
@@ -720,14 +796,11 @@ class ContinuousBatcher(_BatcherBase):
             reason = (f"queue depth {self._queue.qsize()} >= "
                       f"{self._admit_max_queue} (MXTPU_ADMIT_MAX_QUEUE)")
         elif self._admit_max_wait_ms > 0:
-            with self._stats_lock:
-                waits = sorted(self._recent_waits)
-            if len(waits) >= 8:
-                p50 = waits[len(waits) // 2]
-                if p50 > self._admit_max_wait_ms:
-                    reason = (f"queue wait p50 {p50:.0f} ms > "
-                              f"{self._admit_max_wait_ms:.0f} ms "
-                              "(MXTPU_ADMIT_MAX_WAIT_MS)")
+            p50 = self.rolling_wait_ms()
+            if p50 is not None and p50 > self._admit_max_wait_ms:
+                reason = (f"queue wait p50 {p50:.0f} ms > "
+                          f"{self._admit_max_wait_ms:.0f} ms "
+                          "(MXTPU_ADMIT_MAX_WAIT_MS)")
         if reason is not None:
             with self._stats_lock:
                 self.stats["rejected"] += 1
@@ -828,10 +901,85 @@ class ContinuousBatcher(_BatcherBase):
             reg.counter("infer/requests").inc()
             reg.counter("infer/tokens").inc(len(s.emitted))
 
+    def _adopt(self, slot: int, frames: dict) -> bool:
+        """Adopt prefilled KV frames (``serving.disagg``) into ``slot``'s
+        pages and cross buffers WITHOUT re-running the prefill — the
+        decode half of a disaggregated handoff. Host-side ``.at[].set``
+        scatters between dispatches; shapes/dtypes never change, so the
+        decode program is untouched. Returns False on any geometry
+        mismatch or failure (the caller then re-prefills from the
+        prompt — zero lost requests by construction)."""
+        import jax.numpy as jnp
+
+        try:
+            L = int(frames["length"])
+            mvl = int(frames["mem_vl"])
+            st = dict(self._state)
+            if len(frames["k"]) != len(st["k_pools"]):
+                return False
+            if mvl > self.mem_len or L < 1 \
+                    or L > self.pages_per_slot * self.page_size:
+                return False
+            if not self.pool.ensure(slot, L):
+                return False
+            # indices ride as TRACED operands (jnp scalars), never
+            # Python ints: a concrete index bakes into the compiled
+            # scatter, so every distinct slot/page combination would
+            # compile its own program ON the scheduler thread mid-run —
+            # measured as a multi-hundred-ms TTFT tail on the CPU rig
+            slot_idx = jnp.asarray(slot, jnp.int32)
+            kps, vps, cks, cvs = [], [], [], []
+            for i in range(len(st["k_pools"])):
+                kp, vp = st["k_pools"][i], st["v_pools"][i]
+                ck, cv = st["cross_k"][i], st["cross_v"][i]
+                k = _np.asarray(frames["k"][i])
+                v = _np.asarray(frames["v"][i])
+                if k.shape != (L,) + kp.shape[2:] or v.shape != k.shape:
+                    return False
+                for pi in range(_pages.pages_for(L, self.page_size)):
+                    page = jnp.asarray(int(self.pool.table[slot, pi]),
+                                       jnp.int32)
+                    lo = pi * self.page_size
+                    hi = min(L, lo + self.page_size)
+                    kp = kp.at[page, :hi - lo].set(
+                        jnp.asarray(k[lo:hi], kp.dtype))
+                    vp = vp.at[page, :hi - lo].set(
+                        jnp.asarray(v[lo:hi], vp.dtype))
+                # zero-fill the slot's cross row beyond mem_vl so the
+                # buffer matches what a local prefill_paged (which pads
+                # the projections to mem_len) would have written —
+                # bit-identical decode regardless of the slot's
+                # previous occupant
+                ckf = _np.zeros((self.mem_len,) + tuple(ck.shape[2:]),
+                                _np.dtype(ck.dtype))
+                cvf = _np.zeros_like(ckf)
+                cka = _np.asarray(frames["ck"][i])
+                cva = _np.asarray(frames["cv"][i])
+                if cka.shape != (mvl,) + tuple(ck.shape[2:]) or \
+                        cva.shape != cka.shape:
+                    return False
+                ckf[:mvl] = cka
+                cvf[:mvl] = cva
+                kps.append(kp)
+                vps.append(vp)
+                cks.append(ck.at[slot_idx].set(jnp.asarray(ckf, ck.dtype)))
+                cvs.append(cv.at[slot_idx].set(jnp.asarray(cvf, cv.dtype)))
+            st["k_pools"] = tuple(kps)
+            st["v_pools"] = tuple(vps)
+            st["cross_k"] = tuple(cks)
+            st["cross_v"] = tuple(cvs)
+            st["mem_vl"] = st["mem_vl"].at[slot_idx].set(mvl)
+            self._state = st
+            return True
+        except Exception:  # noqa: BLE001 - torn frames = re-prefill
+            return False
+
     def _admit(self) -> int:
-        """Fill vacated slots from the waiting line through ONE padded
-        (slots, bucket) prefill-into-pages dispatch; stream each admitted
-        row's first token. Respects the free-page watermark."""
+        """Fill vacated slots from the waiting line: requests carrying
+        prefilled KV frames (disaggregated handoff) are ADOPTED straight
+        into their slots, the rest go through ONE padded (slots, bucket)
+        prefill-into-pages dispatch; stream each admitted row's first
+        token. Respects the free-page watermark."""
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free or not self._pending:
             return 0
@@ -851,6 +999,53 @@ class ContinuousBatcher(_BatcherBase):
         reg.histogram("infer/admitted_per_iter").observe(len(picked))
         if not picked:
             return 0
+        version = getattr(self._engine, "weights_version", None)
+        adopt, plain = [], []
+        for slot, r in picked:
+            if r.frames is not None and self._adopt(slot, r.frames):
+                adopt.append((slot, r))
+            else:
+                if r.frames is not None:
+                    # handoff arrived but cannot be adopted (mismatched
+                    # geometry / torn frames): fall back to a local
+                    # prefill from the prompt — the request still serves
+                    r.frames = None
+                    with self._stats_lock:
+                        self.stats["re_prefills"] += 1
+                    reg.counter("disagg/re_prefills").inc()
+                plain.append((slot, r))
+        if adopt:
+            t_admit = time.perf_counter()
+            for slot, r in adopt:
+                fr = r.frames
+                r.frames = None
+                s = _Slot(r, self._seq)
+                self._seq += 1
+                s.length = int(fr["length"])
+                s.carry = int(fr["carry"])
+                s.emitted = [int(t) for t in fr["emitted"]]
+                s.version = version
+                self._slots[slot] = s
+                r.future.queue_wait_ms = \
+                    (t_admit - r.future.enqueued_at) * 1e3
+                self._note_wait(max(r.future.queue_wait_ms, 0.0))
+                reg.histogram("infer/queue_wait_ms").observe(
+                    max(r.future.queue_wait_ms, 0.0))
+                r.future._stream_tokens(list(s.emitted))
+                ttft = (r.future.first_token_at
+                        - r.future.enqueued_at) * 1e3
+                reg.histogram("infer/ttft_ms").observe(ttft)
+                self._note_ttft(ttft)
+                if s.carry == self._engine._eos \
+                        or len(s.emitted) >= r.max_new:
+                    s.finished = True
+            with self._stats_lock:
+                self.stats["adopted"] += len(adopt)
+                self.stats["admitted"] += len(adopt)
+            reg.counter("disagg/handoffs").inc(len(adopt))
+        picked = plain
+        if not picked:
+            return len(adopt)
         bucket = self._bucket_for(
             max(r.prompt.shape[0] for _, r in picked))
         # admission sub-batch menu: the prefill dispatch shape is the
@@ -875,7 +1070,6 @@ class ContinuousBatcher(_BatcherBase):
             first_pages[i] = self.pool.table[slot, 0]
             active[i] = True
         t0 = time.perf_counter()
-        version = getattr(self._engine, "weights_version", None)
         try:
             _faults.fire("batcher.dispatch", tag=self.name)
             tok0, self._state = self._engine.prefill_paged(
@@ -899,18 +1093,18 @@ class ContinuousBatcher(_BatcherBase):
             s.emitted.append(s.carry)
             self._slots[slot] = s
             r.future.queue_wait_ms = (t0 - r.future.enqueued_at) * 1e3
-            with self._stats_lock:
-                self._recent_waits.append(r.future.queue_wait_ms)
+            self._note_wait(max(r.future.queue_wait_ms, 0.0))
             reg.histogram("infer/queue_wait_ms").observe(
                 max(r.future.queue_wait_ms, 0.0))
             r.future._stream_tokens([s.carry])
-            reg.histogram("infer/ttft_ms").observe(
-                (r.future.first_token_at - r.future.enqueued_at) * 1e3)
+            ttft = (r.future.first_token_at - r.future.enqueued_at) * 1e3
+            reg.histogram("infer/ttft_ms").observe(ttft)
+            self._note_ttft(ttft)
             if s.carry == self._engine._eos or len(s.emitted) >= r.max_new:
                 s.finished = True
         with self._stats_lock:
             self.stats["admitted"] += len(picked)
-        return len(picked)
+        return len(adopt) + len(picked)
 
     def _ensure_capacity(self, live):
         """Grow page allocations so every live row can cache

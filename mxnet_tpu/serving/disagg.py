@@ -1,0 +1,415 @@
+"""Disaggregated prefill/decode: KV handoff across the process boundary.
+
+Prefill is compute-bound and bursty; decode is memory-bound and steady.
+Co-scheduling them on one worker makes TTFT and tokens/sec fight for the
+same dispatch slots (DistServe/Splitwise observation). This module is
+the mechanics of splitting the roles over the PR-10 transport:
+
+- ``worker_role()`` (``MXTPU_ROLE``): each ``serving.worker`` process is
+  ``both`` (the co-scheduled default), ``prefill`` (runs admission
+  prefills only, ships the filled KV out) or ``decode`` (owns a
+  long-running page pool and adopts shipped KV without re-prefilling).
+- ``PrefillEngine``: a one-request-at-a-time prefill-into-pages front
+  over an ``InferStep``. It owns a tiny private paged state (1 slot,
+  1 allocatable page) whose OWNERSHIP passes through a one-slot queue
+  (baton passing — no lock is ever held across the device dispatch, the
+  shape the mxlint lock-order pass flags), runs the exact
+  ``prefill_paged`` program the continuous batcher would, and extracts
+  the filled page frames + slot metadata as host arrays.
+- ``pack_frames``/``unpack_frames``: the ``kv_push`` wire format — a
+  JSON meta dict (lengths, carry token, per-array dtype/shape) plus raw
+  length-prefixed binary frames riding the JSON-frame RPC
+  (``serving.transport``), one buffer per array, no pickle.
+- ``spill_frames``/``load_spilled``: the shared-filesystem fallback
+  (``MXTPU_KV_SPILL_DIR``): the prefill worker writes ``<handoff>.npz``
+  (tmp + atomic rename, the commit protocol every file in this repo
+  uses) and the decode worker adopts from disk — for fleets without
+  worker-to-worker connectivity.
+- ``HandoffStash``: the decode-side arrival buffer — ``kv_push`` frames
+  land here (keyed by handoff id, bounded, oldest-evicted) until the
+  router's ``submit`` for the same handoff id claims them.
+
+Failure contract — zero lost requests by construction: every handoff
+``submit`` carries the FULL prompt, so a push that never arrived, a
+prefill worker that died mid-push, or frames that fail adoption
+(mismatched geometry, torn spill file) all degrade to the decode worker
+re-prefilling from the prompt (counted ``disagg/re_prefills``); greedy
+tokens are bit-identical either way because adoption reproduces exactly
+the state ``prefill_paged`` would have written locally.
+
+Telemetry (``disagg/`` family): ``kv_push_ms`` (push wall, prefill
+side), ``kv_bytes`` (frames shipped), ``handoffs`` (adoptions),
+``re_prefills`` (fallbacks), ``ttft_interactive_ms``/``ttft_batch_ms``
+(per-class time-to-first-token, router side).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..base import MXNetError
+from . import pages as _pages
+
+__all__ = ["worker_role", "kv_spill_dir", "PrefillEngine", "pack_frames",
+           "unpack_frames", "spill_frames", "load_spilled", "HandoffStash",
+           "frame_bytes"]
+
+ROLES = ("both", "prefill", "decode")
+
+# frames are shipped in four per-layer groups, in this fixed order
+_GROUPS = ("k", "v", "ck", "cv")
+
+
+def worker_role(default: str = "both") -> str:
+    """``MXTPU_ROLE``: this serving worker's place in a disaggregated
+    fleet — ``both`` (co-scheduled prefill+decode, the default),
+    ``prefill`` (admission prefills only; KV ships out over ``kv_push``)
+    or ``decode`` (long-running page pools; adopts shipped KV)."""
+    v = os.environ.get("MXTPU_ROLE", "").strip().lower()
+    return v if v in ROLES else default
+
+
+def kv_spill_dir() -> Optional[str]:
+    """``MXTPU_KV_SPILL_DIR``: when set, prefill workers spill KV frames
+    to ``<dir>/<handoff>.npz`` (atomic rename) instead of pushing them
+    over a worker-to-worker socket — the shared-filesystem handoff for
+    fleets where workers cannot dial each other."""
+    v = os.environ.get("MXTPU_KV_SPILL_DIR", "").strip()
+    return v or None
+
+
+# ------------------------------------------------------------------ frames
+def frame_bytes(frames: dict) -> int:
+    """Total payload bytes of one handoff's arrays (``disagg/kv_bytes``)."""
+    return sum(np.asarray(a).nbytes
+               for g in _GROUPS for a in frames[g])
+
+
+def pack_frames(frames: dict) -> Tuple[dict, List[bytes]]:
+    """Split a frames dict into (JSON-safe meta, raw binary buffers) for
+    the ``kv_push`` verb. Buffer order is the meta's ``arrays`` order:
+    the four groups in ``_GROUPS`` order, each layer-major."""
+    meta = {"length": int(frames["length"]),
+            "carry": int(frames["carry"]),
+            "emitted": [int(t) for t in frames["emitted"]],
+            "mem_vl": int(frames["mem_vl"]),
+            "layers": len(frames["k"]),
+            "arrays": []}
+    bufs: List[bytes] = []
+    for g in _GROUPS:
+        for a in frames[g]:
+            a = np.ascontiguousarray(a)
+            meta["arrays"].append({"group": g, "shape": list(a.shape),
+                                   "dtype": a.dtype.name})
+            bufs.append(a.tobytes())
+    return meta, bufs
+
+
+def unpack_frames(meta: dict, bufs: Sequence[bytes]) -> dict:
+    """Inverse of :func:`pack_frames`; raises ``MXNetError`` on a
+    meta/buffer mismatch (a torn push must fail adoption loudly, the
+    caller then re-prefills)."""
+    specs = meta.get("arrays", [])
+    if len(specs) != len(bufs):
+        raise MXNetError(
+            f"kv_push carried {len(bufs)} binary frames for "
+            f"{len(specs)} declared arrays")
+    frames = {"length": int(meta["length"]), "carry": int(meta["carry"]),
+              "emitted": [int(t) for t in meta.get("emitted", ())],
+              "mem_vl": int(meta["mem_vl"])}
+    for g in _GROUPS:
+        frames[g] = []
+    for spec, buf in zip(specs, bufs):
+        a = np.frombuffer(buf, dtype=np.dtype(spec["dtype"]))
+        a = a.reshape([int(d) for d in spec["shape"]])
+        frames[spec["group"]].append(a)
+    if len(frames["k"]) != meta.get("layers"):
+        raise MXNetError("kv_push frame groups do not cover every layer")
+    return frames
+
+
+def spill_frames(directory: str, handoff: str, frames: dict) -> str:
+    """Write one handoff to ``<directory>/<handoff>.npz`` (tmp + atomic
+    rename: a reader never observes a torn file). Returns the path."""
+    os.makedirs(directory, exist_ok=True)
+    path = os.path.join(directory, f"{handoff}.npz")
+    tmp = f"{path}.{os.getpid()}.tmp"
+    arrays = {"meta": np.frombuffer(json.dumps({
+        "length": int(frames["length"]), "carry": int(frames["carry"]),
+        "emitted": [int(t) for t in frames["emitted"]],
+        "mem_vl": int(frames["mem_vl"]),
+        "layers": len(frames["k"])}).encode("utf-8"), np.uint8)}
+    for g in _GROUPS:
+        for i, a in enumerate(frames[g]):
+            arrays[f"{g}{i}"] = np.ascontiguousarray(a)
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+    os.replace(tmp, path)
+    return path
+
+
+def load_spilled(directory: str, handoff: str,
+                 unlink: bool = True) -> Optional[dict]:
+    """Load (and by default consume) one spilled handoff; None when the
+    file does not exist or cannot be read (the caller re-prefills)."""
+    path = os.path.join(directory, f"{handoff}.npz")
+    try:
+        with np.load(path) as z:
+            meta = json.loads(bytes(z["meta"]).decode("utf-8"))
+            frames = {"length": int(meta["length"]),
+                      "carry": int(meta["carry"]),
+                      "emitted": [int(t) for t in meta["emitted"]],
+                      "mem_vl": int(meta["mem_vl"])}
+            for g in _GROUPS:
+                frames[g] = [z[f"{g}{i}"]
+                             for i in range(int(meta["layers"]))]
+    except Exception:  # noqa: BLE001 - missing/torn spill = re-prefill
+        return None
+    if unlink:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    return frames
+
+
+class HandoffStash:
+    """Decode-side arrival buffer for pushed KV frames.
+
+    ``kv_push`` handlers (transport connection threads) ``put`` frames
+    keyed by handoff id; the matching ``submit`` handler ``pop``s them.
+    Bounded: past ``capacity`` entries the OLDEST is dropped (its request
+    re-prefills — a stash can never grow without bound behind a router
+    that crashed between push and submit). Every touch holds the stash
+    lock; nothing blocking runs under it."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._frames: Dict[str, dict] = {}
+        self._order: List[str] = []
+        self.dropped = 0
+
+    def put(self, handoff: str, frames: dict) -> None:
+        with self._lock:
+            if handoff not in self._frames:
+                self._order.append(handoff)
+            self._frames[handoff] = frames
+            while len(self._order) > self.capacity:
+                old = self._order.pop(0)
+                self._frames.pop(old, None)
+                self.dropped += 1
+
+    def pop(self, handoff: str) -> Optional[dict]:
+        with self._lock:
+            frames = self._frames.pop(handoff, None)
+            if frames is not None:
+                self._order.remove(handoff)
+            return frames
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._order)
+
+
+# ----------------------------------------------------------- prefill engine
+class _PrefillItem:
+    """One waiting prefill request in the engine's batching queue."""
+
+    __slots__ = ("prompt", "bucket", "done", "frames", "error")
+
+    def __init__(self, prompt, bucket):
+        self.prompt = prompt
+        self.bucket = bucket
+        self.done = threading.Event()
+        self.frames = None
+        self.error = None
+
+
+class PrefillEngine:
+    """Batched prefill-into-pages + frame extraction — the compute half
+    of a disaggregated fleet's prefill worker.
+
+    Owns a private paged state sized for ``rows`` concurrent requests
+    (slot ``i`` always uses page ``i + 1``; page 0 stays the trash
+    page): concurrent RPC handler threads enqueue their prompts and
+    whichever thread holds the STATE BATON drains up to ``rows`` pending
+    requests OF ONE BUCKET into a single padded ``prefill_paged``
+    dispatch — the identical jitted admission program (and admission
+    batching economics) the continuous batcher uses, so a burst of
+    pushes costs one dispatch, not one per request. Grouping by bucket
+    keeps short interactive prompts off the long-prompt pad width. The
+    pools and cross buffers are read back to host in ONE transfer per
+    array per batch, then sliced per request.
+
+    State ownership passes through a one-slot queue (baton passing): no
+    lock is ever held across device work — the shape the mxlint
+    lock-order pass flags.
+
+    Bit-exactness contract: with identical weights, the frames a decode
+    worker adopts reproduce exactly the pool/slot contents its own
+    ``prefill_paged`` would have written — greedy decode continues
+    bit-identically to the co-scheduled path.
+    """
+
+    def __init__(self, engine, bucket_keys: Sequence[int],
+                 rows: int = 4, page_size: Optional[int] = None,
+                 sampling: Optional[dict] = None, warmup: bool = True,
+                 baton_timeout_s: float = 60.0):
+        if not getattr(engine, "supports_paged", False):
+            raise MXNetError(
+                "PrefillEngine needs a paged-protocol InferStep "
+                "(net with prefill_paged)")
+        self._engine = engine
+        self.bucket_keys = sorted(int(k) for k in bucket_keys)
+        if not self.bucket_keys:
+            raise MXNetError("bucket_keys must be non-empty")
+        self.rows = max(int(rows), 1)
+        self.mem_len = self.bucket_keys[-1]
+        self.page_size = int(page_size) if page_size is not None \
+            else _pages.page_size_default()
+        self._sampling = dict(sampling or {})
+        self._sampling.pop("seed", None)
+        self._pad = engine._pad
+        self.baton_timeout_s = float(baton_timeout_s)
+        self._queue: "queue.Queue[_PrefillItem]" = queue.Queue()
+        self._baton: "queue.Queue" = queue.Queue(maxsize=1)
+        self._baton.put(engine.init_paged_state(
+            self.rows, self.rows, self.page_size, self.mem_len))
+        self.prefills = 0
+        self.batches = 0
+        if warmup:
+            self._warmup()
+
+    def _warmup(self):
+        """Compile the admission program per bucket with fully inert
+        rows (OOB slots, trash page) — same trick as the batcher's
+        warmup — then mark the guard steady."""
+        import jax
+
+        state = self._baton.get(timeout=self.baton_timeout_s)
+        try:
+            for bucket in self.bucket_keys:
+                src = np.zeros((self.rows, bucket), np.int32)
+                vl = np.full((self.rows,), bucket, np.int32)
+                tok0, state = self._engine.prefill_paged(
+                    state, src, vl,
+                    np.full((self.rows,), self.rows, np.int32),
+                    np.zeros((self.rows,), np.int32),
+                    np.zeros((self.rows,), bool), **self._sampling)
+                jax.block_until_ready(tok0.data)
+        finally:
+            self._baton.put(state)
+        self._engine.compile_guard.mark_steady()
+
+    def _bucket_for(self, n: int) -> int:
+        for k in self.bucket_keys:
+            if n <= k:
+                return k
+        raise MXNetError(f"prompt length {n} > largest bucket key "
+                         f"{self.bucket_keys[-1]}")
+
+    def prefill(self, prompt_ids) -> dict:
+        """Prefill one prompt (batched opportunistically with concurrent
+        callers) and return its handoff frames: ``{length, carry,
+        emitted, mem_vl, k[], v[], ck[], cv[]}`` with per-layer host
+        arrays — ``k``/``v`` hold the ``length`` filled self-KV entries,
+        ``ck``/``cv`` the ``mem_vl`` valid cross-attention
+        projections."""
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        item = _PrefillItem(prompt, self._bucket_for(prompt.shape[0]))
+        self._queue.put(item)
+        deadline = time.monotonic() + self.baton_timeout_s
+        while not item.done.wait(0.001):
+            if time.monotonic() > deadline:
+                raise MXNetError(
+                    f"prefill timed out after {self.baton_timeout_s}s "
+                    "waiting for the engine baton")
+            try:
+                state = self._baton.get_nowait()
+            except queue.Empty:
+                continue  # another caller is dispatching our batch
+            try:
+                state = self._serve_locked_out_batch(state)
+            finally:
+                self._baton.put(state)
+        if item.error is not None:
+            raise item.error
+        return item.frames
+
+    def _serve_locked_out_batch(self, state):
+        """Drain the pending queue, group by bucket, and dispatch the
+        SMALLEST bucket group first (up to ``rows`` of it) — interactive
+        short prompts never wait behind a long-prompt pad width, the
+        prefill-side analogue of batch-sheds-first. The rest requeues
+        for the next baton holder. Runs on whichever caller thread won
+        the baton; returns the (new) state. NB: no lock held —
+        exclusivity comes from baton ownership."""
+        pending: List[_PrefillItem] = []
+        while True:
+            try:
+                pending.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        if not pending:
+            return state
+        bucket = min(item.bucket for item in pending)
+        picked = [i for i in pending if i.bucket == bucket][:self.rows]
+        for item in pending:
+            if item not in picked:
+                self._queue.put(item)
+        try:
+            state = self._dispatch_batch(state, picked, bucket)
+        except Exception as e:  # noqa: BLE001 - fail the items, not the baton
+            for item in picked:
+                item.error = e
+                item.done.set()
+        return state
+
+    def _dispatch_batch(self, state, picked, bucket):
+        """One padded ``prefill_paged`` over the picked items; slot i /
+        page i+1 per row; bulk host readback, per-item slicing."""
+        rows = self.rows
+        src = np.full((rows, bucket), self._pad, np.int32)
+        vl = np.full((rows,), bucket, np.int32)
+        slot_ids = np.full((rows,), rows, np.int32)  # OOB = inert row
+        first_pages = np.zeros((rows,), np.int32)
+        active = np.zeros((rows,), bool)
+        for i, item in enumerate(picked):
+            n = item.prompt.shape[0]
+            src[i, :n] = item.prompt
+            vl[i] = n
+            slot_ids[i] = i
+            first_pages[i] = i + 1
+            active[i] = True
+        tok0, state = self._engine.prefill_paged(
+            state, src, vl, slot_ids, first_pages, active,
+            **self._sampling)
+        tok0 = np.asarray(tok0.asnumpy()).reshape(-1)
+        # ONE host transfer per array per batch; items slice host-side
+        k_pools = [np.asarray(p) for p in state["k_pools"]]
+        v_pools = [np.asarray(p) for p in state["v_pools"]]
+        cross_k = [np.asarray(c) for c in state["cross_k"]]
+        cross_v = [np.asarray(c) for c in state["cross_v"]]
+        for i, item in enumerate(picked):
+            n = item.prompt.shape[0]
+            carry = int(tok0[i])
+            frames = {"length": 1, "carry": carry, "emitted": [carry],
+                      "mem_vl": n, "k": [], "v": [], "ck": [], "cv": []}
+            for li in range(len(k_pools)):
+                frames["k"].append(k_pools[li][i + 1, :1].copy())
+                frames["v"].append(v_pools[li][i + 1, :1].copy())
+                frames["ck"].append(cross_k[li][i, :n].copy())
+                frames["cv"].append(cross_v[li][i, :n].copy())
+            item.frames = frames
+            item.done.set()
+        self.prefills += len(picked)
+        self.batches += 1
+        return state

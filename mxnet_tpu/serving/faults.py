@@ -34,6 +34,15 @@ Failure points wired in this package:
 ``worker.exit``       hard-kills a serving worker process from inside
                       its main loop (``os._exit``) — sudden process
                       death on a deterministic schedule.
+``transport.kv_push`` fires in a prefill-role worker's KV-handoff push
+                      path (socket or spill): raise-mode drops the
+                      handoff (the decode side re-prefills from the
+                      prompt, ``disagg/re_prefills``), delay-mode is a
+                      slow push; tags are the ``push_to`` address.
+``router.place``      fires inside the router's placement decision:
+                      raise-mode makes that pass place nothing (the
+                      monitor retries), delay-mode is a slow placement;
+                      tags are the request class.
 ==================== ====================================================
 
 Env spec grammar (one var per point, ``.`` becomes ``_``)::

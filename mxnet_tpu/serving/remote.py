@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import threading
 import time
+import uuid
 from typing import Optional
 
 from .. import telemetry as _tel
@@ -122,7 +123,8 @@ class RemoteReplica(Replica):
                  heartbeat_stale_s: float = 10.0,
                  rpc_timeout_s: Optional[float] = None,
                  probe_ttl_s: float = 0.05,
-                 connect_budget_s: Optional[float] = None):
+                 connect_budget_s: Optional[float] = None,
+                 role: str = "both"):
         if address is None and worker is None:
             raise ValueError("RemoteReplica needs address= or worker=")
         self.worker = worker
@@ -144,7 +146,7 @@ class RemoteReplica(Replica):
         super().__init__(name, _RemoteBatcher(self._client, name,
                                               self._engine_handle),
                          heartbeat_path=heartbeat_path,
-                         heartbeat_stale_s=heartbeat_stale_s)
+                         heartbeat_stale_s=heartbeat_stale_s, role=role)
         if address is not None and worker is None:
             self._connect_now()
         else:
@@ -227,9 +229,88 @@ class RemoteReplica(Replica):
         backlog (queued + occupied slots, from the health probe)."""
         return self.inflight + int(self._probe_info.get("queue_depth", 0))
 
+    def queue_wait_p50_ms(self) -> Optional[float]:
+        """The worker-reported rolling queue-wait p50 (health verb) —
+        the SLO placement signal."""
+        return self._probe_info.get("queue_wait_p50_ms")
+
     @property
     def weights_version(self) -> Optional[str]:
         return self._probe_info.get("weights_version")
+
+    # ------------------------------------------------ disaggregated serving
+    @property
+    def role(self) -> str:  # type: ignore[override]
+        """Worker-reported role (health verb / ``MXTPU_ROLE``); the
+        constructor's role until the first probe answers."""
+        return self._probe_info.get("role", self._role)
+
+    @role.setter
+    def role(self, value: str):
+        self._role = value
+
+    def submit_disagg(self, prefill_rep, prompt_ids, max_new_tokens=None,
+                      deadline_ms: Optional[float] = None,
+                      klass: str = "interactive") -> GenerationResult:
+        """Disaggregated submit: ask ``prefill_rep`` (a prefill-role
+        replica) to run the admission prefill and push the KV frames to
+        THIS worker, then submit here with the handoff id — the decode
+        batcher adopts the frames without re-prefilling.
+
+        Returns the future immediately; the prefill RPC + submit run on
+        a handoff thread (the router's lock is never held across the
+        wire). ANY handoff failure — prefill worker dead, push dropped,
+        frames unusable — degrades to a plain submit whose prompt the
+        decode worker prefills locally (``disagg/re_prefills``): the
+        request is never lost to the handoff."""
+        fut = GenerationResult()
+        deadline_at = None if deadline_ms is None \
+            else time.perf_counter() + float(deadline_ms) / 1e3
+        threading.Thread(
+            target=self._disagg_handoff,
+            args=(prefill_rep, prompt_ids, max_new_tokens, deadline_at,
+                  klass, fut),
+            name=f"mxtpu-disagg-{self.name}", daemon=True).start()
+        return fut
+
+    def _disagg_handoff(self, prefill_rep, prompt_ids, max_new,
+                        deadline_at, klass, fut):
+        """Handoff thread body: prefill RPC (bounded by the remaining
+        deadline), then the wire submit feeding the SAME future the
+        router already holds."""
+        handoff = uuid.uuid4().hex
+        extra = {"klass": klass}
+        budget = None
+        if deadline_at is not None:
+            budget = max(0.05, deadline_at - time.perf_counter())
+        try:
+            host, port = self._client.address
+            prefill_rep.client.call(
+                "prefill",
+                {"prompt": [int(t) for t in prompt_ids],
+                 "push_to": f"{host}:{port}", "handoff": handoff},
+                timeout_s=budget)
+            extra["handoff"] = handoff
+        except Exception as e:  # noqa: BLE001 - fall back to local prefill
+            _tel.registry().counter("disagg/re_prefills").inc()
+            _tel.instant("disagg.push_failed",
+                         {"handoff": handoff, "replica": self.name,
+                          "error": repr(e)})
+        remaining_ms = None
+        if deadline_at is not None:
+            remaining_ms = (deadline_at - time.perf_counter()) * 1e3
+            if remaining_ms <= 0 and not fut.done():
+                fut._fail(self._dead_error_instance(
+                    "deadline passed during the KV handoff"))
+                return
+        self._client.submit(prompt_ids, max_new,
+                            deadline_ms=remaining_ms, extra=extra,
+                            future=fut)
+
+    def _dead_error_instance(self, msg: str):
+        from .batcher import DeadlineExceeded
+
+        return DeadlineExceeded(msg)
 
     # ------------------------------------------------------------- factory
     @classmethod

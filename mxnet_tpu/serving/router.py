@@ -4,9 +4,28 @@ failover, bounded transparent retries.
 One ``submit()`` front-end over N engine+batcher replicas. The router
 owns the request lifecycle end to end:
 
-- **Placement**: each request goes to the healthy replica with the
-  lightest load (router-tracked in-flight count + the replica's queued
-  backlog; ties break round-robin via the replica order).
+- **Placement (SLO-aware)**: each request goes to the healthy
+  decode-serving replica with the lowest PREDICTED WAIT — the replica's
+  rolling queue-wait p50 (worker-reported over the health verb, or the
+  local batcher's window) times its backlog + 1 — rather than the
+  instantaneous backlog count alone; replicas with no wait signal yet
+  degenerate to backlog ordering. Ties break round-robin via a rotating
+  cursor, so equal-score replicas share load instead of the first one
+  absorbing everything.
+- **Request classes**: ``submit(..., klass="interactive"|"batch")``
+  tags each request; a request without an explicit ``deadline_ms``
+  picks up its class default (``MXTPU_SLO_INTERACTIVE_MS`` /
+  ``MXTPU_SLO_BATCH_MS``), and under a degraded fleet BATCH traffic
+  sheds at HALF the ``MXTPU_SHED_MAX_QUEUE`` backlog bound — batch
+  sheds before interactive by construction.
+- **Disaggregation**: when the fleet contains prefill-role replicas
+  (``serving.disagg.worker_role``), placement picks a decode replica
+  AND a prefill replica: the prefill worker runs the admission prefill
+  and ships the KV frames to the decode worker (``kv_push`` /
+  ``MXTPU_KV_SPILL_DIR`` spill), whose batcher adopts them without
+  re-prefilling. Any handoff failure degrades to the decode worker
+  re-prefilling from the prompt (``disagg/re_prefills``) — requests
+  are never lost to a handoff.
 - **Health**: a replica is healthy while (a) its batcher's dispatcher
   thread is alive (``DynamicBatcher.healthy``), (b) its watchdog
   heartbeat — the PR-1 ``heartbeat.json``, written atomically — is fresh
@@ -55,12 +74,16 @@ from typing import Callable, Optional, Sequence
 from ..base import MXNetError
 from .. import telemetry as _tel
 from ..telemetry.watchdog import read_heartbeat
+from . import faults as _faults
 from .batcher import Backpressure, DeadlineExceeded, DynamicBatcher, \
     GenerationResult
 
 __all__ = ["Router", "Replica", "ReplicaUnavailable", "retry_max",
            "restart_backoff_s", "shed_queue_depth", "shed_wait_ms",
-           "shed_max_queue"]
+           "shed_max_queue", "slo_interactive_ms", "slo_batch_ms",
+           "REQUEST_CLASSES"]
+
+REQUEST_CLASSES = ("interactive", "batch")
 
 
 class ReplicaUnavailable(MXNetError):
@@ -122,6 +145,43 @@ def shed_max_queue(default: int = 128) -> int:
         return default
 
 
+def disagg_min_prompt(default: int = 16) -> int:
+    """``MXTPU_DISAGG_MIN_PROMPT``: prompts SHORTER than this prefill in
+    place on the decode worker even when prefill-role replicas exist —
+    a short prompt's prefill costs less than the handoff's extra hop,
+    and keeping long-prompt prefills (and only those) off the decode
+    workers is the whole point of the split. 0/1 = hand off
+    everything."""
+    v = os.environ.get("MXTPU_DISAGG_MIN_PROMPT", "").strip()
+    try:
+        return max(int(v), 1) if v else default
+    except ValueError:
+        return default
+
+
+def slo_interactive_ms(default: float = 0.0) -> float:
+    """``MXTPU_SLO_INTERACTIVE_MS``: default deadline for
+    ``klass="interactive"`` requests submitted without an explicit
+    ``deadline_ms`` (0/unset = no class default; the router-wide
+    ``deadline_ms`` still applies)."""
+    v = os.environ.get("MXTPU_SLO_INTERACTIVE_MS", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+def slo_batch_ms(default: float = 0.0) -> float:
+    """``MXTPU_SLO_BATCH_MS``: default deadline for ``klass="batch"``
+    requests submitted without an explicit ``deadline_ms`` (0/unset =
+    no class default)."""
+    v = os.environ.get("MXTPU_SLO_BATCH_MS", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
 def backoff_delay(base: float, attempt: int, cap: float = 30.0,
                   jitter: float = 0.25) -> float:
     """Capped exponential backoff with multiplicative jitter: attempt 0
@@ -142,7 +202,7 @@ class Replica:
 
     def __init__(self, name: str, batcher: DynamicBatcher,
                  heartbeat_path: Optional[str] = None,
-                 heartbeat_stale_s: float = 10.0):
+                 heartbeat_stale_s: float = 10.0, role: str = "both"):
         self.name = str(name)
         self.batcher = batcher
         if batcher.name is None:
@@ -150,6 +210,13 @@ class Replica:
         self.heartbeat_path = heartbeat_path
         self.heartbeat_stale_s = float(heartbeat_stale_s)
         self.evicted = False
+        # disaggregated fleet role (serving.disagg.worker_role):
+        # "prefill" replicas never receive decode placements; they serve
+        # as KV-handoff sources and still join the coordinated hot swap
+        self.role = str(role)
+        # deliberate scale-down (Router.retire_replica): excluded from
+        # placement, its eventual eviction schedules NO respawn
+        self.retired = False
         self.inflight = 0  # router-assigned, guarded by the router lock
 
     @property
@@ -186,20 +253,47 @@ class Replica:
         process replicas are ready at construction."""
         return False
 
+    @property
+    def serves_decode(self) -> bool:
+        """Whether decode placements may land here (everything but a
+        dedicated prefill worker)."""
+        return self.role != "prefill"
+
+    @property
+    def serves_prefill(self) -> bool:
+        """Whether this replica is a KV-handoff source — only DEDICATED
+        prefill workers; a ``both`` replica co-schedules instead."""
+        return self.role == "prefill"
+
     def load(self) -> int:
-        """Placement score: requests the router has in flight here plus
-        the batcher's queued backlog (infer/ telemetry's queue_wait is
-        this backlog measured in time)."""
+        """Backlog: requests the router has in flight here plus the
+        batcher's queued backlog (infer/ telemetry's queue_wait is this
+        backlog measured in time)."""
         return self.inflight + self.batcher._queue.qsize()
+
+    def queue_wait_p50_ms(self) -> Optional[float]:
+        """Rolling queue-wait p50 this replica reports (the local
+        batcher's window; remote replicas report it over the health
+        verb). None until enough samples exist."""
+        fn = getattr(self.batcher, "rolling_wait_ms", None)
+        return fn() if fn is not None else None
+
+    def predicted_wait_ms(self) -> float:
+        """SLO placement score: rolling queue-wait p50 × (backlog + 1).
+        With no wait signal yet the p50 factor is 1 ms, so scoring
+        degenerates to backlog ordering on a fresh fleet."""
+        p50 = self.queue_wait_p50_ms()
+        return (p50 if p50 else 1.0) * (self.load() + 1)
 
 
 class _Routed:
     """Router-side record of one request across (re)submissions."""
 
     __slots__ = ("prompt", "max_new", "deadline", "outer", "replica",
-                 "inner", "attempts", "next_try_at", "created")
+                 "inner", "attempts", "next_try_at", "created", "klass")
 
-    def __init__(self, prompt, max_new, deadline, outer):
+    def __init__(self, prompt, max_new, deadline, outer,
+                 klass="interactive"):
         self.prompt = prompt
         self.max_new = max_new
         self.deadline = deadline  # absolute perf_counter instant or None
@@ -209,6 +303,7 @@ class _Routed:
         self.attempts = 0  # placements so far
         self.next_try_at = 0.0
         self.created = time.perf_counter()
+        self.klass = klass  # SLO class: "interactive" | "batch"
 
 
 class Router:
@@ -239,6 +334,7 @@ class Router:
                  shed_queue_depth: Optional[int] = None,
                  shed_wait_ms: Optional[float] = None,
                  shed_max_queue: Optional[int] = None,
+                 disagg_min_prompt: Optional[int] = None,
                  start: bool = True):
         from . import router as _self  # module fns shadowed by kwargs
 
@@ -260,8 +356,12 @@ class Router:
             if shed_wait_ms is not None else _self.shed_wait_ms()
         self.shed_max_queue = shed_max_queue \
             if shed_max_queue is not None else _self.shed_max_queue()
+        self.disagg_min_prompt = disagg_min_prompt \
+            if disagg_min_prompt is not None \
+            else _self.disagg_min_prompt()
         self._recent_waits = collections.deque(maxlen=64)
         self._lock = threading.Lock()
+        self._rr = 0  # rotating tie-break cursor, guarded by the lock
         self._inflight: list = []
         self._respawn_at = None  # next respawn attempt instant
         self._respawn_attempt = 0
@@ -318,17 +418,33 @@ class Router:
 
     # ------------------------------------------------------------- requests
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> GenerationResult:
+               deadline_ms: Optional[float] = None,
+               klass: str = "interactive") -> GenerationResult:
         """Route one prompt to a healthy replica. The returned future
         resolves even across replica failures (transparent resubmission)
         — it fails only on retry exhaustion, deadline expiry, or total
-        replica loss."""
+        replica loss.
+
+        ``klass`` is the SLO class (``interactive`` default, or
+        ``batch``): without an explicit ``deadline_ms`` the class
+        default (``MXTPU_SLO_INTERACTIVE_MS``/``MXTPU_SLO_BATCH_MS``)
+        applies, per-class TTFT is recorded
+        (``disagg/ttft_interactive_ms``/``disagg/ttft_batch_ms``), and
+        under a degraded fleet batch traffic sheds first."""
+        if klass not in REQUEST_CLASSES:
+            raise MXNetError(
+                f"unknown request class {klass!r} "
+                f"(one of {REQUEST_CLASSES})")
         outer = GenerationResult()
-        dl_ms = deadline_ms if deadline_ms is not None \
-            else self.default_deadline_ms
+        dl_ms = deadline_ms
+        if dl_ms is None:
+            slo = slo_batch_ms() if klass == "batch" \
+                else slo_interactive_ms()
+            dl_ms = slo if slo > 0 else self.default_deadline_ms
         deadline = None if dl_ms is None \
             else time.perf_counter() + float(dl_ms) / 1e3
-        r = _Routed(prompt_ids, max_new_tokens, deadline, outer)
+        r = _Routed(prompt_ids, max_new_tokens, deadline, outer,
+                    klass=klass)
         _tel.registry().counter("serve/requests").inc()
         with self._lock:
             shed = self._shed_reason_locked(r)
@@ -359,7 +475,10 @@ class Router:
         (callers format outside the lock)."""
         reasons = []
         for rep in self._replicas:
-            if rep.evicted:
+            if rep.evicted or rep.retired or not rep.serves_decode:
+                # prefill-only replicas cannot absorb decode work and a
+                # retiring replica is on its way out: neither keeps
+                # admission open
                 continue
             if rep.starting or not rep.healthy:
                 reasons.append(f"{rep.name}: unhealthy")
@@ -387,10 +506,16 @@ class Router:
         if degraded is None:
             return None
         backlog = len(self._inflight)
-        if backlog >= self.shed_max_queue:
+        # batch traffic sheds FIRST: under a degraded fleet its backlog
+        # bound is half the interactive one, so the queue that remains
+        # is spent on the latency-sensitive class
+        limit = self.shed_max_queue if r.klass != "batch" \
+            else max(1, self.shed_max_queue // 2)
+        if backlog >= limit:
             return ("queue_full", [
-                f"router backlog hit {backlog} >= {self.shed_max_queue} "
-                "(MXTPU_SHED_MAX_QUEUE) with all replicas degraded"]
+                f"router backlog hit {backlog} >= {limit} "
+                f"({r.klass} bound, MXTPU_SHED_MAX_QUEUE="
+                f"{self.shed_max_queue}) with all replicas degraded"]
                 + degraded)
         if r.deadline is not None:
             budget_ms = (r.deadline - time.perf_counter()) * 1e3
@@ -419,16 +544,50 @@ class Router:
         with self._lock:
             return list(self._replicas)
 
+    def _pick_locked(self, candidates: list):
+        """Lowest predicted wait (rolling p50 × backlog) wins; exact
+        ties rotate through a cursor so equal-score replicas share load
+        instead of the first in replica order absorbing everything."""
+        scored = [(rep.predicted_wait_ms(), rep) for rep in candidates]
+        best = min(s for s, _ in scored)
+        ties = [rep for s, rep in scored if s == best]
+        rep = ties[self._rr % len(ties)]
+        self._rr += 1
+        return rep
+
+    def _pick_prefill_locked(self):
+        """A healthy dedicated prefill-role replica for the KV handoff,
+        or None (the decode replica then prefills locally)."""
+        pre = [rep for rep in self._replicas
+               if not rep.evicted and not rep.retired
+               and rep.serves_prefill and rep.healthy]
+        return self._pick_locked(pre) if pre else None
+
     def _assign_locked(self, r: _Routed) -> bool:
-        """Place ``r`` on the lightest-loaded healthy replica; False when
-        none is available (the monitor retries until
-        ``no_replica_timeout_s``)."""
-        candidates = [rep for rep in self._replicas if rep.healthy]
+        """Place ``r`` on the decode-serving healthy replica with the
+        lowest predicted wait; False when none is available (the monitor
+        retries until ``no_replica_timeout_s``). With prefill-role
+        replicas in the fleet the placement is DISAGGREGATED: the
+        chosen prefill worker computes and ships the KV, the decode
+        replica adopts it (``RemoteReplica.submit_disagg``)."""
+        now = time.perf_counter()
+        candidates = [rep for rep in self._replicas
+                      if rep.healthy and rep.serves_decode
+                      and not rep.retired]
         if not candidates:
             r.inner = None
-            r.next_try_at = time.perf_counter() + self.health_interval_s
+            r.next_try_at = now + self.health_interval_s
             return False
-        rep = min(candidates, key=lambda x: x.load())
+        try:
+            # fault point: a placement decision that fails/stalls (raise
+            # = this pass places nothing and the monitor retries; delay
+            # = a slow placement)
+            _faults.fire("router.place", tag=r.klass)
+        except _faults.FaultInjected:
+            r.inner = None
+            r.next_try_at = now + self.health_interval_s
+            return False
+        rep = self._pick_locked(candidates)
         remaining_ms = None
         if r.deadline is not None:
             remaining_ms = (r.deadline - time.perf_counter()) * 1e3
@@ -437,9 +596,42 @@ class Router:
         r.replica = rep
         r.attempts += 1
         rep.inflight += 1
-        r.inner = rep.batcher.submit(r.prompt, r.max_new,
-                                     deadline_ms=remaining_ms)
+        # hand off only prefill-HEAVY prompts: a short prompt's local
+        # prefill is cheaper than the handoff's extra RPC hop, and the
+        # split's whole point is keeping the long prefills off the
+        # decode workers
+        pre = None
+        if hasattr(rep, "submit_disagg") \
+                and len(r.prompt) >= self.disagg_min_prompt:
+            pre = self._pick_prefill_locked()
+        if pre is not None:
+            r.inner = rep.submit_disagg(pre, r.prompt, r.max_new,
+                                        deadline_ms=remaining_ms,
+                                        klass=r.klass)
+        else:
+            r.inner = rep.batcher.submit(r.prompt, r.max_new,
+                                         deadline_ms=remaining_ms)
         return True
+
+    # ----------------------------------------------------------- elasticity
+    def add_replica(self, rep: Replica) -> Replica:
+        """Register a replica mid-flight (fleet elasticity scale-up —
+        ``tools.launch.FleetScaler`` spawns a worker, wraps it in a
+        ``RemoteReplica`` and hands it here)."""
+        with self._lock:
+            self._replicas.append(rep)
+        _tel.instant("serve.scale", {"action": "add", "replica": rep.name})
+        return rep
+
+    def retire_replica(self, rep: Replica) -> Replica:
+        """Deliberate scale-down: exclude ``rep`` from placement and
+        from the shed gate, let its in-flight work finish on the worker
+        (the caller SIGTERMs it — the existing graceful drain), and when
+        its health finally fails the eviction schedules NO respawn."""
+        rep.retired = True
+        _tel.instant("serve.scale", {"action": "retire",
+                                     "replica": rep.name})
+        return rep
 
     # -------------------------------------------------------------- monitor
     def _run(self):
@@ -503,7 +695,10 @@ class Router:
             rep.batcher.stop(drain=False, timeout=0.1)
         except Exception:  # noqa: BLE001
             pass
-        if self._factory is not None and self._respawn_at is None:
+        # a deliberately retired replica (scale-down) leaves for good —
+        # respawning it would defeat the scaler
+        if self._factory is not None and self._respawn_at is None \
+                and not rep.retired:
             self._respawn_at = time.perf_counter() + backoff_delay(
                 self._respawn_base, self._respawn_attempt)
 
@@ -565,6 +760,19 @@ class Router:
                     r.outer.weights_version = r.inner.weights_version
                     r.outer.replica = r.inner.replica
                     r.outer.queue_wait_ms = r.inner.queue_wait_ms
+                    ft = getattr(r.inner, "first_token_at", None)
+                    if ft is not None:
+                        # per-class TTFT, measured from the router's
+                        # admission instant (the SLO the classes exist
+                        # for)
+                        ttft = (ft - r.created) * 1e3
+                        if r.klass == "batch":
+                            reg.histogram(
+                                "disagg/ttft_batch_ms").observe(ttft)
+                        else:
+                            reg.histogram(
+                                "disagg/ttft_interactive_ms").observe(
+                                    ttft)
                     r.outer._resolve(r.inner.result())
                     reg.counter("serve/completed").inc()
                     done.append(r)

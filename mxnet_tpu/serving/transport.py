@@ -31,7 +31,20 @@ Verbs (the control channel of the cross-process plane):
 ``drain``   stop accepting new submits, finish in-flight requests,
             reply when the batcher is drained (the SIGTERM path).
 ``ping``    transport echo (connect probes, tests).
+``prefill`` disaggregated serving (prefill-role workers): run one
+            admission prefill and ship the filled KV frames to the
+            decode worker named in ``push_to`` (or the spill dir).
+``kv_push`` decode-role workers: receive one handoff's KV frames —
+            a JSON header (``nbin`` = binary frame count) followed by
+            that many LENGTH-PREFIXED BINARY frames (high bit of the
+            length word set) carrying raw array bytes, no pickle.
 ========== ===========================================================
+
+Binary frames ride the same 4-byte big-endian length prefix as JSON
+frames with the TOP BIT set (``_BIN_FLAG``): a reader that expects JSON
+and sees the flag fails loudly instead of parsing garbage. A sender
+holds its send lock across the JSON header AND its binary frames, so a
+handoff arrives contiguous on the stream.
 
 Client calls take per-call timeouts (``MXTPU_RPC_TIMEOUT_S`` default)
 and the initial connect retries under the router's ``backoff_delay``
@@ -73,6 +86,7 @@ __all__ = ["RpcClient", "RpcServer", "TransportError", "rpc_timeout_s",
 
 _MAX_FRAME = 64 << 20  # 64 MiB: a token stream frame is tiny; a header
                        # this large means a corrupt/hostile peer
+_BIN_FLAG = 0x80000000  # length-word top bit: raw binary frame (kv_push)
 
 # remote error types mapped back onto the caller's exception classes so
 # router semantics survive the wire (Backpressure retriable, deadline
@@ -146,12 +160,27 @@ def _recvall(sock, n: int) -> bytes:
     return buf
 
 
+def _send_bin(sock, buf: bytes, tag=None) -> None:
+    """One raw binary frame out (kv_push payload): the length word
+    carries ``_BIN_FLAG`` so a JSON reader cannot mistake it."""
+    _faults.fire("transport.send", tag=tag)
+    if len(buf) > _MAX_FRAME:
+        raise TransportError(
+            f"binary frame of {len(buf)} bytes exceeds the "
+            f"{_MAX_FRAME}-byte cap")
+    sock.sendall(struct.pack(">I", len(buf) | _BIN_FLAG) + bytes(buf))
+
+
 def _recv_frame(sock, tag=None) -> dict:
     """One frame in; raises :class:`TransportError` on EOF / bad data.
     The ``transport.recv`` fault point models the receiving end of a
     drop/partition."""
     _faults.fire("transport.recv", tag=tag)
     (n,) = struct.unpack(">I", _recvall(sock, 4))
+    if n & _BIN_FLAG:
+        raise TransportError(
+            "binary frame where a JSON frame was expected (kv_push "
+            "header/stream desync)")
     if n > _MAX_FRAME:
         raise TransportError(f"frame of {n} bytes exceeds the "
                              f"{_MAX_FRAME}-byte cap (corrupt stream?)")
@@ -159,6 +188,21 @@ def _recv_frame(sock, tag=None) -> dict:
     if not isinstance(msg, dict):
         raise TransportError("frame is not a JSON object")
     return msg
+
+
+def _recv_bin(sock, tag=None) -> bytes:
+    """One binary frame in (the ``nbin`` frames following a kv_push
+    header); the flag bit must be set."""
+    _faults.fire("transport.recv", tag=tag)
+    (n,) = struct.unpack(">I", _recvall(sock, 4))
+    if not n & _BIN_FLAG:
+        raise TransportError(
+            "JSON frame where a kv_push binary frame was expected")
+    n &= ~_BIN_FLAG
+    if n > _MAX_FRAME:
+        raise TransportError(f"binary frame of {n} bytes exceeds the "
+                             f"{_MAX_FRAME}-byte cap (corrupt stream?)")
+    return _recvall(sock, n)
 
 
 def _remote_error(err: Optional[dict]) -> BaseException:
@@ -261,10 +305,12 @@ class RpcClient:
         with self._lock:
             self._calls.pop(call_id, None)
 
-    def _send(self, msg: dict):
+    def _send(self, msg: dict, bin_frames=None):
         try:
             with self._send_lock:
                 _send_frame(self._sock, msg, tag=self.name)
+                for buf in bin_frames or ():
+                    _send_bin(self._sock, buf, tag=self.name)
         except BaseException as e:
             # a failed write means the link is gone: kill the connection
             # so the reader's pending calls fail over too
@@ -273,10 +319,15 @@ class RpcClient:
                 f"send to worker {self.name!r} failed: {e}") from e
 
     def call(self, verb: str, payload: Optional[dict] = None,
-             timeout_s: Optional[float] = None):
+             timeout_s: Optional[float] = None, bin_frames=None):
         """One request/response RPC; returns the final frame's payload
         dict. Raises :class:`TransportError` on timeout or a dead link,
-        or the mapped remote error class on ``ok: false``."""
+        or the mapped remote error class on ``ok: false``.
+
+        ``bin_frames``: raw buffers appended after the JSON header as
+        length-prefixed BINARY frames under one send-lock hold (the
+        ``kv_push`` payload path); ``nbin`` is stamped on the header so
+        the server reader consumes exactly that many."""
         import queue as _queue
 
         timeout = timeout_s if timeout_s is not None else self.timeout_s
@@ -284,9 +335,11 @@ class RpcClient:
         call_id = self._register(_Call(queue=q))
         msg = {"id": call_id, "verb": str(verb)}
         msg.update(payload or {})
+        if bin_frames:
+            msg["nbin"] = len(bin_frames)
         t0 = time.perf_counter()
         try:
-            self._send(msg)
+            self._send(msg, bin_frames)
             try:
                 resp = q.get(timeout=timeout)
             except _queue.Empty:
@@ -304,15 +357,22 @@ class RpcClient:
         return resp
 
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
-               deadline_ms: Optional[float] = None) -> GenerationResult:
+               deadline_ms: Optional[float] = None,
+               extra: Optional[dict] = None,
+               future: Optional[GenerationResult] = None
+               ) -> GenerationResult:
         """Enqueue one prompt on the remote batcher. Returns a local
         ``GenerationResult`` future fed by the response stream; a dead
         connection fails it with the client's ``dead_error`` (the
-        router's signal to resubmit elsewhere)."""
+        router's signal to resubmit elsewhere). ``extra`` merges more
+        header fields (e.g. the disagg path's ``handoff`` id /
+        ``klass``); ``future`` reuses a caller-made result object so a
+        handoff thread can hand the SAME future to the router before the
+        wire submit happens."""
         import numpy as _np
 
         prompt = _np.asarray(prompt_ids, dtype=_np.int64).reshape(-1)
-        fut = GenerationResult()
+        fut = future if future is not None else GenerationResult()
         try:
             call_id = self._register(_Call(future=fut))
         except TransportError as e:
@@ -324,6 +384,8 @@ class RpcClient:
             msg["max_new_tokens"] = int(max_new_tokens)
         if deadline_ms is not None:
             msg["deadline_ms"] = float(deadline_ms)
+        if extra:
+            msg.update(extra)
         try:
             self._send(msg)
         except TransportError as e:
@@ -508,6 +570,12 @@ class RpcServer:
         try:
             while not self._stop.is_set():
                 msg = _recv_frame(conn.sock, tag=self.name)
+                nbin = int(msg.get("nbin", 0) or 0)
+                if nbin:
+                    # a kv_push header: its binary frames follow
+                    # contiguously (the sender held its send lock)
+                    msg["_bin"] = [_recv_bin(conn.sock, tag=self.name)
+                                   for _ in range(nbin)]
                 self._dispatch(conn, msg)
         except BaseException:  # noqa: BLE001 - peer gone / injected drop
             pass

@@ -25,6 +25,14 @@ is all-or-nothing: any stage failure (including a remote one) aborts
 the poll before ANY engine flips, counts ``serve/swap_failures``, and
 everyone keeps serving the old weights.
 
+In a DISAGGREGATED fleet (``serving.disagg``) the swap barrier covers
+BOTH roles: ``Router.engines`` includes prefill-role replicas, so phase
+1 stages every prefill AND decode worker before phase 2 flips any — a
+handoff can never pair a new-version prefill with an old-version decode
+(or vice versa) across the flip, because nobody flips until everyone
+staged and each worker flips at its own dispatch boundary under the one
+version tag.
+
 In-flight dispatches hold their own param snapshot and finish on the old
 version; responses are tagged with the ``weights_version`` their dispatch
 actually served. A torn or unloadable checkpoint counts

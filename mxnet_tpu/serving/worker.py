@@ -34,13 +34,28 @@ heartbeat/announce files land in ``<dir>/worker-<id>`` — so
 ``python tools/launch.py -n 4 -- python -m mxnet_tpu.serving.worker
 --dir /tmp/fleet`` brings up a 4-worker fleet in one line.
 
+Disaggregated serving (``--role`` / ``MXTPU_ROLE``, ``serving.disagg``):
+a ``prefill``-role worker serves the ``prefill`` verb — one admission
+prefill per request, KV frames shipped to the decode worker named in
+``push_to`` over ``kv_push`` (or spilled to ``MXTPU_KV_SPILL_DIR``) —
+and REFUSES decode submits; a ``decode``-role worker stashes pushed
+frames (``HandoffStash``) until the router's ``submit`` with the same
+handoff id claims them, adopting the KV without re-prefilling (missing
+or unusable frames re-prefill from the prompt: ``disagg/re_prefills``,
+zero lost requests). The default ``both`` co-schedules as before. The
+health verb reports the role plus the rolling queue-wait/TTFT p50s the
+SLO-aware router places by.
+
 Fault point: ``worker.exit`` (``MXTPU_FAULT_WORKER_EXIT``) hard-kills
 the process from the inside (``os._exit``) — sudden process death on a
-deterministic schedule, for the chaos bench.
+deterministic schedule, for the chaos bench. ``transport.kv_push``
+fires in the prefill worker's push path (raise = the handoff fails and
+the decode side re-prefills; delay = a slow push).
 
 Env knobs: ``MXTPU_SERVE_PORT`` (base port, 0 = ephemeral),
 ``MXTPU_WORKER_DRAIN_S`` (SIGTERM drain budget, default 30),
-``MXTPU_RPC_TIMEOUT_S``/``MXTPU_RPC_CONNECT_S`` (transport).
+``MXTPU_RPC_TIMEOUT_S``/``MXTPU_RPC_CONNECT_S`` (transport),
+``MXTPU_ROLE``/``MXTPU_KV_SPILL_DIR`` (disaggregation).
 """
 
 from __future__ import annotations
@@ -55,8 +70,10 @@ import time
 from typing import Optional
 
 from ..base import MXNetError
+from .. import telemetry as _tel
+from . import disagg as _disagg
 from . import faults as _faults
-from .transport import RpcServer, serve_port
+from .transport import RpcClient, RpcServer, serve_port
 
 __all__ = ["ServingWorker", "WorkerHandle", "spawn_worker", "main",
            "worker_drain_s"]
@@ -133,7 +150,8 @@ class ServingWorker:
                  batcher_kind: Optional[str] = None,
                  warmup: bool = True, heartbeat_s: float = 0.5,
                  ckpt_dir: Optional[str] = None,
-                 drain_s: Optional[float] = None):
+                 drain_s: Optional[float] = None,
+                 role: Optional[str] = None):
         from ..parallel import InferStep
         from ..telemetry.watchdog import Watchdog
         from . import make_batcher
@@ -143,32 +161,52 @@ class ServingWorker:
         os.makedirs(directory, exist_ok=True)
         self.name = name
         self.drain_s = drain_s if drain_s is not None else worker_drain_s()
+        self.role = role if role else _disagg.worker_role()
+        if self.role not in _disagg.ROLES:
+            raise MXNetError(f"unknown worker role {self.role!r} "
+                             f"(one of {_disagg.ROLES})")
         self._lock = threading.Lock()   # guards _staged/_streamers
         self._staged = None             # (arrays staged, pending version)
         self._streamers: list = []
         self._stop = threading.Event()
         self._draining = False
         self.exit_code = 0
+        # disaggregated serving state: arrival stash for pushed KV
+        # (decode side) and cached worker-to-worker clients (prefill
+        # side); _peer_lock guards the cache, never held across a
+        # connect or a call
+        self._handoffs = _disagg.HandoffStash()
+        self._peers: dict = {}
+        self._peer_lock = threading.Lock()
 
         self.engine = InferStep(net, max_len=max_len)
         if ckpt_dir:
             self._adopt_checkpoint(ckpt_dir)
         self.watchdog = Watchdog(directory, interval=heartbeat_s)
+        # a dedicated prefill worker never decodes: skip the batcher's
+        # decode-program warmup and warm the prefill engine instead
+        bat_warmup = warmup and self.role != "prefill"
         if batcher_kind == "fixed":
             self.batcher = DynamicBatcher(
                 self.engine, bucket_keys=tuple(bucket_keys), slots=slots,
-                max_new_tokens=max_new, warmup=warmup, name=name,
+                max_new_tokens=max_new, warmup=bat_warmup, name=name,
                 watchdog=self.watchdog)
         else:
             self.batcher = make_batcher(
                 self.engine, tuple(bucket_keys), slots=slots,
-                max_new_tokens=max_new, warmup=warmup, name=name,
+                max_new_tokens=max_new, warmup=bat_warmup, name=name,
                 watchdog=self.watchdog)
+        self.prefiller = None
+        if self.role == "prefill":
+            self.prefiller = _disagg.PrefillEngine(
+                self.engine, tuple(bucket_keys), warmup=warmup)
         self.watchdog.start()
         self.server = RpcServer({
             "ping": self._handle_ping,
             "health": self._handle_health,
             "submit": self._handle_submit,
+            "prefill": self._handle_prefill,
+            "kv_push": self._handle_kv_push,
             "stage": self._handle_stage,
             "swap": self._handle_swap,
             "drain": self._handle_drain,
@@ -194,7 +232,7 @@ class ServingWorker:
         info = {"name": self.name, "host": self.server.host,
                 "port": self.server.port, "pid": os.getpid(),
                 "heartbeat": self.watchdog.heartbeat_path,
-                "dir": self.directory}
+                "dir": self.directory, "role": self.role}
         path = os.path.join(self.directory, "worker.json")
         tmp = f"{path}.{os.getpid()}.tmp"
         with open(tmp, "w") as f:
@@ -236,6 +274,10 @@ class ServingWorker:
             streamers = list(self._streamers)
         for t in streamers:
             t.join(timeout=5.0)
+        with self._peer_lock:
+            peers, self._peers = list(self._peers.values()), {}
+        for client in peers:
+            client.close()
         self.server.stop()
         self.watchdog.stop()
 
@@ -249,10 +291,21 @@ class ServingWorker:
         slots = getattr(bat, "_slots", None)
         if slots is not None:
             busy = sum(1 for s in slots if s is not None)
+        adopted = re_prefilled = None
+        stats_lock = getattr(bat, "_stats_lock", None)
+        if stats_lock is not None:
+            with stats_lock:
+                adopted = bat.stats.get("adopted")
+                re_prefilled = bat.stats.get("re_prefills")
         respond(healthy=bool(bat.healthy and not self._draining),
                 status="draining" if self._draining else "serving",
                 queue_depth=bat._queue.qsize() + busy,
                 weights_version=self.engine.weights_version,
+                role=self.role,
+                queue_wait_p50_ms=bat.rolling_wait_ms(),
+                ttft_p50_ms=bat.rolling_ttft_ms(),
+                disagg_adopted=adopted,
+                disagg_re_prefills=re_prefilled,
                 name=self.name, pid=os.getpid())
 
     def _handle_submit(self, msg, respond):
@@ -263,10 +316,28 @@ class ServingWorker:
                 "type": "ReplicaUnavailable",
                 "message": f"worker {self.name!r} is draining"})
             return
+        if self.role == "prefill":
+            respond(ok=False, error={
+                "type": "ReplicaUnavailable",
+                "message": f"worker {self.name!r} is prefill-role: it "
+                           "does not serve decode submits"})
+            return
         prompt = np.asarray(msg.get("prompt", ()), np.int32).reshape(-1)
+        frames = None
+        handoff = msg.get("handoff")
+        if handoff:
+            frames = self._handoffs.pop(str(handoff))
+            if frames is None:
+                spill = _disagg.kv_spill_dir()
+                if spill:
+                    frames = _disagg.load_spilled(spill, str(handoff))
+            if frames is None:
+                # the push never landed (dead prefill worker, dropped
+                # link, torn spill): prefill locally from the prompt
+                _tel.registry().counter("disagg/re_prefills").inc()
         fut = self.batcher.submit(
             prompt, msg.get("max_new_tokens"),
-            deadline_ms=msg.get("deadline_ms"))
+            deadline_ms=msg.get("deadline_ms"), frames=frames)
         t = threading.Thread(target=self._stream_result,
                              args=(fut, respond),
                              name="mxtpu-worker-stream", daemon=True)
@@ -292,6 +363,113 @@ class ServingWorker:
             return
         respond(tokens=tokens, weights_version=fut.weights_version,
                 replica=self.name, queue_wait_ms=fut.queue_wait_ms)
+
+    # ------------------------------------------------ disaggregated verbs
+    def _peer(self, address) -> RpcClient:
+        """Cached worker-to-worker RPC client (prefill -> decode
+        ``kv_push``). A dead cached link is replaced; connects happen
+        OUTSIDE the cache lock."""
+        with self._peer_lock:
+            client = self._peers.get(address)
+        if client is not None and client.dead is None:
+            return client
+        fresh = RpcClient(address,
+                          name=f"{self.name}->{address}").connect(
+                              budget_s=5.0)
+        with self._peer_lock:
+            held = self._peers.get(address)
+            if held is not None and held is not client \
+                    and held.dead is None:
+                chosen = held  # another handler won the connect race
+            else:
+                self._peers[address] = fresh
+                chosen = fresh
+        if chosen is not fresh:
+            fresh.close()
+        return chosen
+
+    def _handle_prefill(self, msg, respond):
+        """Prefill-role verb: run ONE admission prefill and ship the
+        filled KV frames to the decode worker named in ``push_to`` (or
+        the ``MXTPU_KV_SPILL_DIR`` spill). The frames reproduce exactly
+        what the decode worker's own ``prefill_paged`` would have
+        written, so adopted decode is bit-identical.
+
+        The work runs on its OWN thread: all of a router's prefill
+        verbs arrive over one connection, and the transport dispatches
+        a connection's verbs inline on its reader thread — served
+        inline they would serialize (and the ``PrefillEngine``'s
+        request batching could never engage)."""
+        if self.prefiller is None:
+            raise MXNetError(
+                f"worker {self.name!r} has role {self.role!r}: no "
+                "prefill engine (spawn it with --role prefill)")
+        if self._draining:
+            respond(ok=False, error={
+                "type": "ReplicaUnavailable",
+                "message": f"worker {self.name!r} is draining"})
+            return
+        handoff = str(msg.get("handoff") or "")
+        if not handoff:
+            raise MXNetError("prefill verb needs a 'handoff' id")
+        t = threading.Thread(target=self._run_prefill,
+                             args=(msg, handoff, respond),
+                             name="mxtpu-worker-prefill", daemon=True)
+        with self._lock:
+            self._streamers.append(t)
+            if len(self._streamers) > 64:
+                self._streamers = [s for s in self._streamers
+                                   if s.is_alive()]
+        t.start()
+
+    def _run_prefill(self, msg, handoff, respond):
+        """Prefill-thread body: prefill (batched with concurrent
+        callers), push, respond — exceptions relay as error frames (the
+        transport's inline catch does not cover this thread)."""
+        try:
+            self._prefill_and_push(msg, handoff, respond)
+        except BaseException as e:  # noqa: BLE001 - relay the failure
+            respond(ok=False, error={"type": type(e).__name__,
+                                     "message": str(e)})
+
+    def _prefill_and_push(self, msg, handoff, respond):
+        frames = self.prefiller.prefill(msg.get("prompt", ()))
+        nbytes = _disagg.frame_bytes(frames)
+        t0 = time.perf_counter()
+        # fault point: the push itself drops (raise) or crawls (delay) —
+        # the decode side then re-prefills from the prompt
+        _faults.fire("transport.kv_push",
+                     tag=str(msg.get("push_to") or handoff))
+        spill = _disagg.kv_spill_dir()
+        if spill:
+            _disagg.spill_frames(spill, handoff, frames)
+        else:
+            push_to = msg.get("push_to")
+            if not push_to:
+                raise MXNetError("prefill verb needs 'push_to' when "
+                                 "MXTPU_KV_SPILL_DIR is unset")
+            meta, bufs = _disagg.pack_frames(frames)
+            self._peer(str(push_to)).call(
+                "kv_push", {"handoff": handoff, "meta": meta},
+                bin_frames=bufs)
+        reg = _tel.registry()
+        reg.histogram("disagg/kv_push_ms").observe(
+            (time.perf_counter() - t0) * 1e3)
+        reg.counter("disagg/kv_bytes").inc(nbytes)
+        respond(pushed=True, handoff=handoff, kv_bytes=nbytes,
+                spilled=bool(spill))
+
+    def _handle_kv_push(self, msg, respond):
+        """Decode-role verb: stash one handoff's KV frames (JSON meta +
+        the binary frames the transport read after the header) until the
+        matching ``submit`` claims them."""
+        handoff = str(msg.get("handoff") or "")
+        if not handoff:
+            raise MXNetError("kv_push needs a 'handoff' id")
+        frames = _disagg.unpack_frames(msg.get("meta") or {},
+                                       msg.get("_bin") or [])
+        self._handoffs.put(handoff, frames)
+        respond(received=True, handoff=handoff)
 
     def _handle_stage(self, msg, respond):
         """Swap phase 1: load the committed checkpoint host-side and
@@ -401,7 +579,8 @@ def spawn_worker(directory: str, name: Optional[str] = None,
                  batcher: Optional[str] = None, warmup: bool = True,
                  heartbeat_s: float = 0.1,
                  extra_env: Optional[dict] = None,
-                 python: Optional[str] = None) -> WorkerHandle:
+                 python: Optional[str] = None,
+                 role: Optional[str] = None) -> WorkerHandle:
     """Spawn one serving worker process (``-m mxnet_tpu.serving.worker``)
     with stdout/stderr captured to ``<directory>/worker.log``. Readiness
     is ``handle.wait_ready()`` (the worker announces after warmup)."""
@@ -424,6 +603,8 @@ def spawn_worker(directory: str, name: Optional[str] = None,
         cmd += ["--ckpt-dir", ckpt_dir]
     if batcher:
         cmd += ["--batcher", batcher]
+    if role:
+        cmd += ["--role", role]
     if not warmup:
         cmd += ["--no-warmup"]
     env = dict(os.environ)
@@ -471,6 +652,10 @@ def main(argv=None) -> int:
     ap.add_argument("--batcher", default=None,
                     choices=["continuous", "fixed"],
                     help="override MXTPU_BATCHER for this worker")
+    ap.add_argument("--role", default=None,
+                    choices=["both", "prefill", "decode"],
+                    help="disaggregated-fleet role (default MXTPU_ROLE "
+                    "or 'both')")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--heartbeat-s", type=float, default=0.5)
     ap.add_argument("--ckpt-dir", default=None,
@@ -501,7 +686,7 @@ def main(argv=None) -> int:
         slots=args.slots, max_new=args.max_new,
         batcher_kind=args.batcher, warmup=not args.no_warmup,
         heartbeat_s=args.heartbeat_s, ckpt_dir=args.ckpt_dir,
-        drain_s=args.drain_s)
+        drain_s=args.drain_s, role=args.role)
 
     def _sigterm(signum, frame):
         worker.request_stop()

@@ -532,6 +532,111 @@ class TestLockOrderWorker:
         assert not cycles and not blocking and not shared
 
 
+class TestLockOrderKvPush:
+    """Seeded controls in the kv-push arrival path (ISSUE 11): transport
+    reader threads stash pushed frames while submit handler/caller
+    threads claim them — the stash is cross-domain state."""
+
+    def test_unlocked_stash_across_reader_flagged(self, tmp_path):
+        """Positive: the reader thread appends to the arrival order
+        while callers iterate it unlocked — the torn-stash shape the
+        real HandoffStash must lock against."""
+        _, _, shared = _analyze(tmp_path, """
+            import threading
+            class Stash:
+                def __init__(self):
+                    self._frames = {}
+                    self._order = []
+                    self._reader = threading.Thread(
+                        target=self._read_loop)
+                def _read_loop(self):
+                    self._order.append("h")
+                def pop(self, handoff):
+                    return sorted(self._order)
+            """)
+        assert any(attr == "_order" for _, _, _, attr, _ in shared)
+
+    def test_locked_stash_clean(self, tmp_path):
+        """Negative: the real HandoffStash shape — every frames/order
+        touch under the stash lock, nothing blocking under it."""
+        cycles, blocking, shared = _analyze(tmp_path, """
+            import threading
+            class Stash:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._frames = {}
+                    self._order = []
+                    self._reader = threading.Thread(
+                        target=self._read_loop)
+                def _read_loop(self):
+                    with self._lock:
+                        self._order.append("h")
+                def pop(self, handoff):
+                    with self._lock:
+                        order = sorted(self._order)
+                        return self._frames.pop(handoff, None)
+            """)
+        assert not cycles and not blocking and not shared
+
+
+class TestLockOrderScaler:
+    """Seeded controls in the fleet-scaler's thread shape (ISSUE 11): a
+    supervisor loop thread mutating decision state that public ``step``
+    callers also touch."""
+
+    def test_unlocked_decision_state_flagged(self, tmp_path):
+        """Positive: the loop thread appends action records while
+        callers iterate them unlocked."""
+        _, _, shared = _analyze(tmp_path, """
+            import threading
+            class Scaler:
+                def __init__(self):
+                    self.actions = []
+                    self._thread = threading.Thread(target=self._run)
+                def _run(self):
+                    self.actions.append("up")
+                def history(self):
+                    return sorted(self.actions)
+            """)
+        assert any(attr == "actions" for _, _, _, attr, _ in shared)
+
+    def test_decide_under_lock_act_outside_clean(self, tmp_path):
+        """Negative: the real FleetScaler shape — decisions (and every
+        state write) under the scaler lock via a ``*_locked`` helper,
+        the potentially-blocking spawn/retire callables OUTSIDE it."""
+        cycles, blocking, shared = _analyze(tmp_path, """
+            import threading
+            class Scaler:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.actions = []
+                    self._hot = 0
+                    self._thread = threading.Thread(target=self._run)
+                def _run(self):
+                    self.step()
+                def step(self):
+                    with self._lock:
+                        action = self._decide_locked()
+                    if action is not None:
+                        self._spawn()
+                    return action
+                def _decide_locked(self):
+                    self._hot += 1
+                    if self._hot >= 2:
+                        self.actions.append("up")
+                        return "up"
+                    return None
+            """)
+        assert not cycles and not blocking and not shared
+
+    def test_disagg_modules_in_scope(self):
+        """The ISSUE-11 modules are part of the serving-plane set the
+        lock-order pass walks at HEAD (the head test above then proves
+        them finding-free)."""
+        assert {"mxnet_tpu/serving/disagg.py",
+                "tools/launch.py"} <= set(lock_order.MODULES)
+
+
 # ================================================== donation self-tests
 class TestDonation:
     def test_real_modules_satisfy_contract(self, ctx):

@@ -162,6 +162,195 @@ def restart_backoff_s(default: float = 1.0) -> float:
         return default
 
 
+def scale_min(default: int = 1) -> int:
+    """``MXTPU_SCALE_MIN``: the serving fleet's decode-worker floor —
+    :class:`FleetScaler` never retires below it."""
+    v = os.environ.get("MXTPU_SCALE_MIN", "").strip()
+    try:
+        return max(int(v), 1) if v else default
+    except ValueError:
+        return default
+
+
+def scale_max(default: int = 4) -> int:
+    """``MXTPU_SCALE_MAX``: the decode-worker ceiling —
+    :class:`FleetScaler` never grows past it."""
+    v = os.environ.get("MXTPU_SCALE_MAX", "").strip()
+    try:
+        return max(int(v), 1) if v else default
+    except ValueError:
+        return default
+
+
+def scale_cooldown_s(default: float = 30.0) -> float:
+    """``MXTPU_SCALE_COOLDOWN_S``: minimum seconds between scaling
+    actions (either direction) — a spawn takes import+warmup time, so
+    back-to-back decisions would thrash on a signal the previous action
+    has not yet moved."""
+    v = os.environ.get("MXTPU_SCALE_COOLDOWN_S", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
+
+
+class FleetScaler:
+    """Serving-fleet elasticity supervisor: grow decode workers on
+    sustained occupancy/shed pressure, drain and retire them when idle.
+
+    The scaler is deliberately decoupled from the serving package — it
+    drives three callables, so the same loop supervises an in-process
+    router fleet, a ``spawn_worker`` process fleet, or a test fake:
+
+    ``pressure()``
+        -> dict with ``size`` (current decode workers), ``occupancy``
+        (mean decode-batch occupancy, 0..1) and ``shed`` (CUMULATIVE
+        router shed count; the scaler differences it).
+    ``spawn()``
+        start one decode worker and register it (e.g. ``spawn_worker``
+        + ``RemoteReplica.spawning`` + ``Router.add_replica``).
+    ``retire()``
+        pick one idle decode worker, ``Router.retire_replica`` it and
+        SIGTERM the process (the existing graceful drain) — return
+        False when nothing is retirable (the scaler just waits).
+
+    Policy: ``sustain`` consecutive samples of occupancy >= ``high`` (or
+    ANY shed growth) scale UP; ``sustain`` samples of occupancy <=
+    ``low`` with no sheds scale DOWN; every action is separated by
+    ``cooldown_s`` (``MXTPU_SCALE_COOLDOWN_S``) and clamped to
+    [``MXTPU_SCALE_MIN``, ``MXTPU_SCALE_MAX``]. Actions are counted as
+    ``serve/scale_up``/``serve/scale_down``.
+
+    Thread shape: decisions run under the scaler lock
+    (``_decide_locked``); the spawn/retire callables — which may block
+    for seconds — run OUTSIDE it, on whichever thread called
+    :meth:`step` (the supervisor loop, or a test driving steps
+    manually).
+    """
+
+    def __init__(self, pressure, spawn, retire,
+                 min_workers: int | None = None,
+                 max_workers: int | None = None,
+                 cooldown_s: float | None = None,
+                 interval_s: float = 1.0, high: float = 0.85,
+                 low: float = 0.15, sustain: int = 3,
+                 start: bool = False):
+        self._pressure = pressure
+        self._spawn = spawn
+        self._retire = retire
+        self.min_workers = min_workers if min_workers is not None \
+            else scale_min()
+        self.max_workers = max_workers if max_workers is not None \
+            else scale_max()
+        self.cooldown_s = cooldown_s if cooldown_s is not None \
+            else scale_cooldown_s()
+        self.interval_s = float(interval_s)
+        self.high = float(high)
+        self.low = float(low)
+        self.sustain = max(int(sustain), 1)
+        self._lock = threading.Lock()
+        self._hot = 0           # consecutive high-pressure samples
+        self._cold = 0          # consecutive idle samples
+        self._last_shed = None  # previous cumulative shed count
+        self._last_action_at = 0.0
+        self.actions: list = []  # ("up"/"down", monotonic instant)
+        self._stop_evt = threading.Event()
+        self._thread = None
+        if start:
+            self.start()
+
+    # ------------------------------------------------------------ lifecycle
+    def start(self):
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop_evt.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-fleet-scaler", daemon=True)
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0):
+        self._stop_evt.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=timeout)
+
+    def _run(self):
+        while not self._stop_evt.wait(self.interval_s):
+            try:
+                self.step()
+            except Exception:  # noqa: BLE001 - a scaler crash must never
+                pass           # take the serving plane down
+
+    # --------------------------------------------------------------- policy
+    def _decide_locked(self, sample: dict, now: float):
+        """Pure decision under the scaler lock: update the sustained
+        counters and return 'up'/'down'/None. No callable (and nothing
+        blocking) runs in here."""
+        size = int(sample.get("size", 0))
+        occ = float(sample.get("occupancy", 0.0))
+        shed = sample.get("shed")
+        shed_delta = 0
+        if shed is not None:
+            if self._last_shed is not None:
+                shed_delta = max(int(shed) - self._last_shed, 0)
+            self._last_shed = int(shed)
+        hot = occ >= self.high or shed_delta > 0
+        cold = occ <= self.low and shed_delta == 0
+        self._hot = self._hot + 1 if hot else 0
+        self._cold = self._cold + 1 if cold else 0
+        if now - self._last_action_at < self.cooldown_s:
+            return None
+        if self._hot >= self.sustain and size < self.max_workers:
+            self._hot = 0
+            self._cold = 0
+            self._last_action_at = now
+            self.actions.append(("up", now))
+            return "up"
+        if self._cold >= self.sustain and size > self.min_workers:
+            self._hot = 0
+            self._cold = 0
+            self._last_action_at = now
+            self.actions.append(("down", now))
+            return "down"
+        return None
+
+    def step(self):
+        """One supervision sample: read pressure, decide, act. Returns
+        the action taken ('up'/'down'/None)."""
+        sample = self._pressure()
+        now = time.monotonic()
+        with self._lock:
+            action = self._decide_locked(dict(sample), now)
+        if action == "up":
+            self._spawn()
+            self._count("serve/scale_up", sample)
+        elif action == "down":
+            if self._retire() is False:
+                with self._lock:
+                    # nothing retirable: undo the action record, spend
+                    # no cooldown
+                    self._last_action_at = 0.0
+                    self.actions.pop()
+                return None
+            self._count("serve/scale_down", sample)
+        return action
+
+    @staticmethod
+    def _count(counter: str, sample: dict):
+        """Scaling accounting (best-effort — the launcher must run even
+        where the package is not importable)."""
+        try:
+            from mxnet_tpu import telemetry as _tel
+
+            _tel.registry().counter(counter).inc()
+            _tel.instant("serve.scale", {
+                "counter": counter,
+                "occupancy": sample.get("occupancy"),
+                "size": sample.get("size")})
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def _count_restart(attempt: int, rc: int, delay: float):
     """Restart accounting in the launcher's telemetry registry (the
     ``launch/`` family; best-effort — the launcher must run even where

@@ -32,6 +32,7 @@ KNOWN_METRIC_FAMILIES = {
     "serve": "Self-healing serving",
     "launch": "Self-healing serving",
     "transport": "Cross-process transport",
+    "disagg": "Disaggregated serving",
     "shard": "SPMD sharding",
     "trainer": "Host-side training",
     "kvstore": "Host-side training",
@@ -45,9 +46,9 @@ KNOWN_METRIC_FAMILIES = {
 # generically in the Spans table, so membership here is the emitted
 # surface the consistency pass checks, not a formatting choice.
 KNOWN_SPAN_FAMILIES = {
-    "checkpoint", "dataloader", "estimator", "imperative", "infer",
-    "input", "kvstore", "launch", "serve", "trainer", "trainstep",
-    "transport", "watchdog",
+    "checkpoint", "dataloader", "disagg", "estimator", "imperative",
+    "infer", "input", "kvstore", "launch", "serve", "trainer",
+    "trainstep", "transport", "watchdog",
 }
 
 
@@ -315,6 +316,41 @@ def _print_transport_family(report_path):
               "worker logs/heartbeats for crashes or partitions")
 
 
+def _print_disagg_family(report_path):
+    """Surface the ``disagg/`` metric family (disaggregated serving:
+    KV handoffs adopted vs re-prefill fallbacks, push latency and
+    bytes, per-class TTFT, scale actions) from a ``report.json``
+    snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith("disagg/")
+                or k in ("serve/scale_up", "serve/scale_down")}
+    hists = {k: v for k, v in report.get("histograms", {}).items()
+             if k.startswith("disagg/")}
+    if not counters and not hists:
+        return
+    print("\n== Disaggregated serving ==")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    for k in sorted(hists):
+        h = hists[k]
+        print(f"  {k:<38} p50={h.get('p50')} p95={h.get('p95')} "
+              f"n={h.get('count')}")
+    re_prefills = counters.get("disagg/re_prefills", 0)
+    handoffs = counters.get("disagg/handoffs", 0)
+    if re_prefills and re_prefills >= max(handoffs, 1):
+        print(f"  WARNING: {re_prefills} re-prefill(s) vs {handoffs} "
+              "adopted handoff(s) — pushes are failing (dead prefill "
+              "workers, dropped links, or mismatched model geometry); "
+              "the fleet is paying prefill twice")
+
+
 def _print_shard_family(report_path):
     """Surface the ``shard/`` metric family (SPMD sharding spine: mesh
     shape, global vs per-shard parameter bytes, collective-traffic
@@ -393,6 +429,7 @@ def main(argv=None):
         _print_shard_family(os.path.join(directory, "report.json"))
         _print_serve_family(os.path.join(directory, "report.json"))
         _print_transport_family(os.path.join(directory, "report.json"))
+        _print_disagg_family(os.path.join(directory, "report.json"))
     return 0
 
 
