@@ -469,6 +469,152 @@ def open_loop_main(args):
     return 0 if ok else 1
 
 
+# -------------------------------------------------------- prefix-mix mode
+def prefix_mix_main(args):
+    """Prefix caching ablation (the ISSUE-13 acceptance run, CPU-sized).
+
+    One seeded multi-turn chat workload — half the conversations share
+    one system prompt (their histories diverge at per-conversation user
+    tokens: the COW/branching regime), half carry distinct prompts (one
+    linear trie chain each) — is replayed TWICE through the same warmed
+    engine: once through a ``ContinuousBatcher`` with the prefix trie on
+    and once with it off (every turn re-prefills its full forced
+    history). Turn 1 is cold for both; turns >= 2 re-send the
+    accumulated history as ``prefix_ids``.
+
+    Gates: >= 3x TTFT p50 improvement on the prefix-carrying turns,
+    BIT-identical greedy transcripts between the two runs, a
+    refcount-exact pool/trie audit after the cached run, and zero
+    steady-state recompiles."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+    from mxnet_tpu.parallel import InferStep
+    from mxnet_tpu.serving import ContinuousBatcher
+    from .common import infer_fields
+
+    V = args.vocab
+    bucket = 16          # prompt bucket (system prompts are short)
+    T = 16               # new tokens per turn
+    turns = 4            # 1 cold + 3 prefix-carrying
+    max_prefix = 96      # >= turns' accumulated history
+    convs = max(args.batch_size, 6)
+    # prefix savings only show when the replayed HISTORY costs real
+    # compute (same floor rationale as the open-loop mode): the hit
+    # path's adoption overhead is O(1) in history length, the cold
+    # replay O(len) — at micro sizes both drown in dispatch overhead
+    units = max(args.units, 128)
+    layers = max(args.layers, 2)
+
+    np.random.seed(args.seed)
+    mx.random.seed(args.seed)
+    net = TransformerModel(
+        src_vocab=V, tgt_vocab=V, units=units, hidden_size=units * 2,
+        num_layers=layers, num_heads=2,
+        max_length=max_prefix + T + 8, dropout=0.0)
+    net.initialize(mx.initializer.Xavier())
+    net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                      nd.zeros((2, 8), dtype="int32"))
+    eng = InferStep(net, max_len=max_prefix + T + 8)
+
+    rng = np.random.RandomState(args.seed)
+    system = rng.randint(3, V, (12,)).astype("int32")
+    prompts = [system if i < convs // 2
+               else rng.randint(3, V, (rng.randint(8, 13),))
+               .astype("int32") for i in range(convs)]
+    # the user's reply tokens per conversation+turn: what makes shared-
+    # prompt histories diverge (and exercises the COW tail)
+    user = [[rng.randint(3, V, (2,)).tolist() for _ in range(turns)]
+            for _ in range(convs)]
+
+    def drive(cache_on, tag):
+        # every conversation gets a slot (TTFT measures the cache, not
+        # queueing) and the pool holds the whole working set — eviction
+        # thrash would bill the cached run for pool pressure instead
+        bat = ContinuousBatcher(
+            eng, bucket_keys=(bucket,), slots=convs, max_new_tokens=T,
+            page_size=args.page_size if args.page_size is not None else 8,
+            num_pages=convs * 2 * ((max_prefix + T) // 8 + 2),
+            iter_tokens=args.iter_tokens
+            if args.iter_tokens is not None else 4,
+            max_prefix_tokens=max_prefix, prefix_cache=cache_on,
+            warmup=True, name=tag)
+        hist = [[] for _ in range(convs)]
+        transcript = []
+        ttfts = []
+        t0 = time.perf_counter()
+        for turn in range(turns):
+            futs = []
+            for c in range(convs):
+                futs.append(bat.submit(
+                    prompts[c], max_new_tokens=T,
+                    prefix_ids=hist[c] if turn else None))
+            for c, f in enumerate(futs):
+                out = f.result(timeout=600)
+                transcript.append(list(out))
+                if turn and f.first_token_at is not None:
+                    ttfts.append((f.first_token_at - f.enqueued_at) * 1e3)
+                hist[c] = hist[c] + list(out) + user[c][turn]
+        wall = time.perf_counter() - t0
+        stats = bat.prefix_stats()
+        audit_ok = True
+        try:
+            bat.cache.check_invariants()
+            bat.pool.check_invariants(cache_pages=bat.cache.pages())
+        except Exception as e:  # noqa: BLE001 - report, don't crash
+            audit_ok = False
+            print(f"AUDIT FAIL ({tag}): {e}", file=sys.stderr)
+        bat.stop()
+        ttfts.sort()
+        return transcript, {
+            "wall_s": round(wall, 3),
+            "prefix_ttft_ms_p50": round(_q(ttfts, 50), 1),
+            "prefix_ttft_ms_p95": round(_q(ttfts, 95), 1),
+            "hits": stats["hits"],
+            "hit_rate": round(stats["hit_rate"], 4),
+            "tokens_saved": stats["tokens_saved"],
+            "cow_copies": stats["cow_copies"],
+            "cached_pages": stats["pages"],
+            "evicted_pages": stats["evicted_pages"],
+            "audit_ok": audit_ok,
+        }
+
+    cached_transcript, cached = drive(True, "prefix-cached")
+    cold_transcript, cold = drive(False, "prefix-off")
+
+    identical = cached_transcript == cold_transcript
+    speedup = round(cold["prefix_ttft_ms_p50"]
+                    / max(cached["prefix_ttft_ms_p50"], 1e-9), 2)
+    recompiles = eng.compile_guard.steady_state_recompiles
+    row = {
+        "metric": "transformer_prefix_mix_ttft_speedup",
+        "value": speedup,
+        "unit": "x",
+        "conversations": convs,
+        "turns": turns,
+        "max_prefix_tokens": max_prefix,
+        "bit_identical": identical,
+        "steady_state_recompiles": recompiles,
+        "cached": cached,
+        "uncached": cold,
+    }
+    row.update(infer_fields())
+    print(json.dumps(row))
+    print(f"prefix mix, {convs} convs x {turns} turns: cached ttft p50 "
+          f"{cached['prefix_ttft_ms_p50']} ms (hit rate "
+          f"{cached['hit_rate']}, {cached['cow_copies']} COW copies) vs "
+          f"uncached {cold['prefix_ttft_ms_p50']} ms -> {speedup}x, "
+          f"bit-identical={identical}, {recompiles} steady recompiles")
+    ok = (speedup >= 3.0 and identical and cached["audit_ok"]
+          and cached["hits"] >= convs * (turns - 1) and recompiles == 0)
+    if not ok:
+        print("FAIL: prefix caching must cut prefix-turn TTFT p50 by "
+              ">= 3x with bit-identical greedy transcripts, every "
+              "prefix turn a trie hit, a refcount-exact audit and zero "
+              "steady recompiles", file=sys.stderr)
+    return 0 if ok else 1
+
+
 # -------------------------------------------------------- serve-chaos mode
 def serve_chaos_main(args):
     """Self-healing serving ablation (CPU-sized): sustained open-loop
@@ -1154,6 +1300,12 @@ def main(argv=None):
     ap.add_argument("--iter-tokens", type=int, default=None,
                     help="decode tokens per scheduler iteration for "
                          "--open-loop (MXTPU_ITER_TOKENS default)")
+    ap.add_argument("--prefix-mix", action="store_true",
+                    help="prefix caching ablation: a shared-system-"
+                         "prompt + multi-turn chat mix through the same "
+                         "engine with the prefix trie on vs off (TTFT "
+                         "p50 on prefix turns, hit rate, COW copies, "
+                         "bit-identity gate)")
     ap.add_argument("--serve-chaos", action="store_true",
                     help="self-healing serving ablation: hot weight swap "
                          "+ replica kill under sustained router load")
@@ -1186,6 +1338,8 @@ def main(argv=None):
     ap.add_argument("--epochs", type=int, default=2)
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args(argv)
+    if args.prefix_mix:
+        return prefix_mix_main(args)
     if args.disagg:
         return disagg_main(args)
     if args.serve_chaos:

@@ -48,7 +48,8 @@ FORBIDDEN = {"batch", "label", "frozen_vals", "src", "vl", "values",
              "page_tables", "tokens", "lengths", "active", "prime"}
 
 # serving-side donating calls: callee attr -> donated positional index
-DONATING_CALLS = {"decode_iter": 0, "prefill_paged": 0}
+DONATING_CALLS = {"decode_iter": 0, "prefill_paged": 0,
+                  "prefill_suffix_paged": 0}
 
 
 def _literal_tuple(node) -> Optional[Tuple[int, ...]]:

@@ -48,6 +48,7 @@ MODULES = (
     "mxnet_tpu/serving/watcher.py",
     "mxnet_tpu/serving/faults.py",
     "mxnet_tpu/serving/pages.py",
+    "mxnet_tpu/serving/prefix.py",
     "mxnet_tpu/serving/transport.py",
     "mxnet_tpu/serving/worker.py",
     "mxnet_tpu/serving/remote.py",

@@ -36,7 +36,8 @@ FAST_PATH_FUNCS = ("__call__", "_dispatch")
 TARGETS = (
     (STEP_PY, "TrainStep", FAST_PATH_FUNCS),
     (INFER_PY, "InferStep", ("__call__", "_dispatch", "decode_n",
-                             "decode_iter", "prefill_paged")),
+                             "decode_iter", "prefill_paged",
+                             "prefill_suffix_paged")),
     (BATCHER_PY, "DynamicBatcher", ("_dispatch",)),
     (BATCHER_PY, "ContinuousBatcher", ("_dispatch", "_step_once")),
 )

@@ -41,12 +41,14 @@ INFER_PY = "mxnet_tpu/parallel/infer.py"
 TRACED_BUILDERS = {
     STEP_PY: ("_build",),
     INFER_PY: ("_build_forward", "_get_prefill_fn", "_get_decode_fn",
-               "_get_paged_prefill_fn", "_get_decode_iter_fn"),
+               "_get_paged_prefill_fn", "_get_decode_iter_fn",
+               "_get_suffix_fn"),
 }
 
 # dispatch methods that must account their signatures with the guard
 GUARDED_DISPATCHES = {
-    INFER_PY: ("_dispatch", "decode_n", "prefill_paged", "decode_iter"),
+    INFER_PY: ("_dispatch", "decode_n", "prefill_paged", "decode_iter",
+               "prefill_suffix_paged"),
     STEP_PY: ("_dispatch",),
 }
 

@@ -422,6 +422,73 @@ class TransformerModel(HybridBlock):
                      "mem_vl": mem_vl}
         return logits, new_state
 
+    def prefill_suffix_paged(self, tokens, token_vl, q_offset, state,
+                             page_tables, slot_ids, active):
+        """Prefix-cache suffix prefill: decode-side forward over ONLY the
+        uncached tail of each admitted row's target prefix, at absolute
+        positions ``q_offset[r] + j``.
+
+        ``tokens`` (B, S) int32 are the left-aligned suffix token ids
+        (``token_vl`` (B,) of them real per row); ``page_tables`` (B, P)
+        are the admitted rows' page-table rows (padding rows all-trash);
+        ``slot_ids`` (B,) map rows to slots for the cross-memory gather —
+        the slot's cross buffers and ``mem_vl`` must already be populated
+        (by a prior ``prefill_paged``, an adopted cache root, or a disagg
+        handoff; this method deliberately runs NO encoder — skipping it
+        is the point of a prefix hit). Padding rows carry out-of-bounds
+        ``slot_ids`` whose gathers clamp harmlessly and whose page writes
+        land in trash.
+
+        Bit-identity contract: each position runs through the exact
+        ``decode_step_paged`` program (a teacher-forced ``fori_loop``,
+        one position per step) rather than one batched multi-token
+        attention — a wide-S pass computes the same math but rounds
+        differently per shape, so cached pages would drift from the
+        token-at-a-time stream in the last float bits. Per-step bodies
+        are shape-identical no matter where the cached/uncached split
+        falls, which is what makes a cache-hit replay bit-identical to
+        the cold path (asserted in tests/test_prefix.py). Returns
+        ``(last_logits, new_state)`` with row ``r``'s logits taken at
+        suffix position ``token_vl[r] - 1`` — the first new token's."""
+        import jax
+
+        tok = tokens.data if isinstance(tokens, NDArray) else \
+            jnp.asarray(tokens)
+        tok = tok.astype(jnp.int32)
+        S = tok.shape[1]
+        q_offset = jnp.asarray(q_offset, jnp.int32)
+        token_vl = jnp.asarray(token_vl, jnp.int32)
+        active = jnp.asarray(active, jnp.bool_)
+        # per-row cross memory gathered by slot once; empty slots report
+        # mem_vl 0 — clamp so padding rows' masked softmax stays finite
+        # (their output is discarded anyway)
+        sub = {"k_pools": state["k_pools"], "v_pools": state["v_pools"],
+               "cross_k": tuple(c[slot_ids] for c in state["cross_k"]),
+               "cross_v": tuple(c[slot_ids] for c in state["cross_v"]),
+               "mem_vl": jnp.maximum(state["mem_vl"][slot_ids], 1)}
+
+        def one(j, sub):
+            tok_j = jax.lax.dynamic_index_in_dim(tok, j, axis=1,
+                                                 keepdims=False)
+            live = jnp.logical_and(active, j < token_vl)
+            lg, sub = self.decode_step_paged(
+                NDArray(tok_j), q_offset + j, sub, page_tables, live)
+            return (lg.data if isinstance(lg, NDArray) else lg), sub
+
+        last, sub = one(0, sub)
+
+        def body(j, carry):
+            sub, last = carry
+            lg, sub = one(j, sub)
+            return sub, jnp.where((j == token_vl - 1)[:, None], lg, last)
+
+        if S > 1:
+            sub, last = jax.lax.fori_loop(1, S, body, (sub, last))
+        new_state = dict(state)
+        new_state["k_pools"] = sub["k_pools"]
+        new_state["v_pools"] = sub["v_pools"]
+        return last, new_state
+
     def decode_step_paged(self, tokens, pos, state, page_tables, active):
         """One O(1) paged decode step over the SLOT batch: ``tokens``
         (slots,) int32 at per-row absolute positions ``pos`` (slots,),

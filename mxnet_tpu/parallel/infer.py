@@ -481,6 +481,30 @@ class InferStep:
         self._paged_fns[cfg] = fn
         return fn
 
+    def _get_suffix_fn(self, method, top_k):
+        cfg = ("paged_suffix", method, top_k)
+        fn = self._paged_fns.get(cfg)
+        if fn is not None:
+            return fn
+        net = self._net
+
+        def prefill(values, state, tokens, token_vl, q_offset,
+                    page_tables, slot_ids, active, key, temperature):
+            with self._net_scope(values, key):
+                logits, new_state = net.prefill_suffix_paged(
+                    NDArray(tokens), token_vl, q_offset, state,
+                    page_tables, slot_ids, active)
+            logits = logits.data if isinstance(logits, NDArray) else logits
+            key, sub = jax.random.split(key)
+            tok0 = _sample_tokens(logits.astype(jnp.float32), sub, method,
+                                  top_k, temperature)
+            return tok0, new_state
+
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        fn = jax.jit(prefill, donate_argnums=donate)
+        self._paged_fns[cfg] = fn
+        return fn
+
     def _get_decode_iter_fn(self, steps, method, top_k):
         cfg = ("decode_iter", steps, method, top_k)
         fn = self._paged_fns.get(cfg)
@@ -551,6 +575,39 @@ class InferStep:
         vals = self._values  # one coherent weight snapshot per dispatch
         tok0, new_state = fn(vals, state, src, vl, slot_ids, first_pages,
                              active, jax.random.PRNGKey(seed),
+                             jnp.float32(temperature))
+        return NDArray(tok0), new_state
+
+    def prefill_suffix_paged(self, state, tokens, token_vl, q_offset,
+                             page_tables, slot_ids, active,
+                             method="greedy", top_k=0, temperature=1.0,
+                             seed=0):
+        """Prefix-cache admission dispatch: run the decode-side forward
+        over ONLY each row's uncached suffix (absolute positions
+        ``q_offset[r] + j``) and sample its first new token. The encoder
+        never runs — cross memory comes from the adopted cache root (or
+        a prior prefill). Same staging/guard/donation contract as
+        ``prefill_paged``; sync-free by lint. Returns ``(tok0 (B,)
+        NDArray, new_state)``."""
+        tokens = jnp.asarray(tokens, jnp.int32)
+        token_vl = jnp.asarray(token_vl, jnp.int32)
+        q_offset = jnp.asarray(q_offset, jnp.int32)
+        page_tables = jnp.asarray(page_tables, jnp.int32)
+        slot_ids = jnp.asarray(slot_ids, jnp.int32)
+        active = jnp.asarray(active, jnp.bool_)
+        method, top_k, seed, _ = self._paged_cfg(method, top_k, seed)
+        cfg = (method, top_k)
+        sig = ("paged_suffix", cfg, (tokens.shape, tokens.dtype.name),
+               page_tables.shape, state["k_pools"][0].shape,
+               state["cross_k"][0].shape)
+        self.compile_guard.observe(
+            sig, lambda: f"paged_suffix{cfg} "
+            + _cc.aval_summary((tokens,)))
+        fn = self._get_suffix_fn(*cfg)
+        vals = self._values  # one coherent weight snapshot per dispatch
+        tok0, new_state = fn(vals, state, tokens, token_vl, q_offset,
+                             page_tables, slot_ids, active,
+                             jax.random.PRNGKey(seed),
                              jnp.float32(temperature))
         return NDArray(tok0), new_state
 

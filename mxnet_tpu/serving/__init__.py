@@ -50,6 +50,14 @@ and replica failures:
   per-class deadline defaults (``MXTPU_SLO_*_MS``) and batch-first
   shedding, and ``tools.launch.FleetScaler`` elasticity
   (``MXTPU_SCALE_*``).
+- ``prefix`` caches computed KV across requests: a radix trie per
+  exact prompt maps page-aligned target-token blocks to refcounted KV
+  pages; retiring slots donate their chains, admission adopts matched
+  prefixes read-only (copy-on-write on the partial tail page) and
+  replays only the uncached suffix through a teacher-forced program
+  that is bit-identical to the token-at-a-time decode. The router
+  prefers replicas advertising the request's prompt digest
+  (prefix-affinity placement, ``MXTPU_PREFIX_AFFINITY``).
 - ``faults`` plants deterministic failure points in all of the above
   (``MXTPU_FAULT_*``), so the failure paths are testable in tier-1.
 
@@ -65,19 +73,26 @@ Env knobs: ``MXTPU_BATCHER`` (scheduler kind, default ``continuous``),
 backoff base, shared with ``tools/launch.py``), ``MXTPU_SERVE_PORT`` /
 ``MXTPU_RPC_TIMEOUT_S`` / ``MXTPU_RPC_CONNECT_S`` (worker transport),
 ``MXTPU_WORKER_DRAIN_S`` (SIGTERM drain budget), ``MXTPU_SHED_*``
-(router load-shedding thresholds), ``MXTPU_FAULT_*`` (fault-injection
+(router load-shedding thresholds), ``MXTPU_PREFIX_CACHE`` /
+``MXTPU_PREFIX_MAX_PAGES`` / ``MXTPU_PREFIX_MAX_ROOTS`` /
+``MXTPU_PREFIX_AFFINITY`` / ``MXTPU_PREFIX_DIGEST_MAX`` (prefix cache +
+affinity — see ``serving.prefix``), ``MXTPU_FAULT_*`` (fault-injection
 specs — see ``serving.faults``).
 """
 
 from . import disagg
 from . import faults
 from . import pages
+from . import prefix
 from .batcher import Backpressure, ContinuousBatcher, DeadlineExceeded, \
     DynamicBatcher, GenerationResult, batcher_kind, batcher_slots, \
     batcher_timeout_ms, iter_tokens_default, make_batcher
 from .disagg import HandoffStash, PrefillEngine, kv_spill_dir, \
     worker_role
 from .pages import PagePool
+from .prefix import PrefixCache, prefix_affinity_enabled, \
+    prefix_cache_enabled, prefix_digest_max, prefix_max_pages, \
+    prefix_max_roots, prompt_digest
 from .router import REQUEST_CLASSES, Replica, ReplicaUnavailable, \
     Router, restart_backoff_s, retry_max, shed_max_queue, \
     shed_queue_depth, shed_wait_ms, slo_batch_ms, slo_interactive_ms
@@ -97,4 +112,7 @@ __all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
            "shed_max_queue", "rpc_timeout_s", "rpc_connect_s",
            "serve_port", "disagg", "PrefillEngine", "HandoffStash",
            "worker_role", "kv_spill_dir", "REQUEST_CLASSES",
-           "slo_interactive_ms", "slo_batch_ms"]
+           "slo_interactive_ms", "slo_batch_ms", "prefix", "PrefixCache",
+           "prompt_digest", "prefix_cache_enabled", "prefix_max_pages",
+           "prefix_max_roots", "prefix_affinity_enabled",
+           "prefix_digest_max"]

@@ -44,6 +44,7 @@ from ..base import MXNetError
 from .. import telemetry as _tel
 from . import faults as _faults
 from . import pages as _pages
+from . import prefix as _prefix
 
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
            "DeadlineExceeded", "Backpressure", "batcher_slots",
@@ -112,7 +113,8 @@ def make_batcher(engine, bucket_keys, **kwargs):
                                              False):
         kwargs.pop("timeout_ms", None)
         return ContinuousBatcher(engine, bucket_keys, **kwargs)
-    for k in ("page_size", "num_pages", "iter_tokens"):
+    for k in ("page_size", "num_pages", "iter_tokens",
+              "max_prefix_tokens", "prefix_cache"):
         kwargs.pop(k, None)
     return DynamicBatcher(engine, bucket_keys, **kwargs)
 
@@ -221,10 +223,11 @@ class GenerationResult:
 
 
 class _Request:
-    __slots__ = ("prompt", "max_new", "future", "deadline", "frames")
+    __slots__ = ("prompt", "max_new", "future", "deadline", "frames",
+                 "prefix")
 
     def __init__(self, prompt, max_new, future, deadline=None,
-                 frames=None):
+                 frames=None, prefix=None):
         self.prompt = prompt
         self.max_new = max_new
         self.future = future
@@ -232,6 +235,11 @@ class _Request:
         # disaggregated serving: prefilled KV frames shipped by a
         # prefill-role worker (serving.disagg); None = prefill locally
         self.frames = frames
+        # prefix caching: target-side conversation history the client
+        # re-sends (multi-turn); forced verbatim before new tokens, and
+        # the part already in the prefix trie is adopted instead of
+        # recomputed. None/empty = fresh conversation.
+        self.prefix = prefix
 
 
 class _BatcherBase:
@@ -255,6 +263,9 @@ class _BatcherBase:
             raise MXNetError("bucket_keys must be non-empty")
         self.slots = int(slots) if slots is not None else batcher_slots()
         self.max_new = int(max_new_tokens)
+        # forced target-prefix budget; only ContinuousBatcher (paged
+        # pool + prefix trie) raises this above zero
+        self.max_prefix = 0
         self._sampling = dict(sampling or {})
         self._pad = int(pad_id) if pad_id is not None else engine._pad
         self.name = name
@@ -374,7 +385,8 @@ class _BatcherBase:
 
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
-               frames: Optional[dict] = None) -> GenerationResult:
+               frames: Optional[dict] = None,
+               prefix_ids=None) -> GenerationResult:
         """Enqueue one prompt (1-D int sequence). Returns a future whose
         ``result()`` is the generated token list, trimmed at EOS and at
         the request's ``max_new_tokens`` (<= the batcher's).
@@ -391,6 +403,13 @@ class _BatcherBase:
         no paged pool) re-prefills from the prompt — the request is
         served either way.
 
+        ``prefix_ids`` is target-side conversation history (tokens the
+        model already produced in earlier turns, re-sent by the client):
+        ``ContinuousBatcher`` forces them verbatim before sampling new
+        tokens and serves any part already in its prefix trie straight
+        from cached KV pages. Only new tokens are returned. Requires a
+        batcher built with ``max_prefix_tokens > 0``.
+
         Submitting to a stopped (or crashed) batcher fails the future
         immediately with a RuntimeError — a request must never enqueue
         behind a dispatcher that will not run again."""
@@ -405,6 +424,15 @@ class _BatcherBase:
             raise MXNetError(
                 f"request max_new_tokens {max_new} > batcher "
                 f"max_new_tokens {self.max_new}")
+        prefix = None
+        if prefix_ids is not None:
+            prefix = _np.asarray(prefix_ids, dtype=_np.int32).reshape(-1)
+            if prefix.shape[0] == 0:
+                prefix = None
+            elif prefix.shape[0] > self.max_prefix:
+                raise MXNetError(
+                    f"prefix length {prefix.shape[0]} > batcher "
+                    f"max_prefix_tokens {self.max_prefix}")
         fut = GenerationResult()
         if not self.healthy:
             fut._fail(RuntimeError(
@@ -417,7 +445,7 @@ class _BatcherBase:
         deadline = None if deadline_ms is None \
             else time.perf_counter() + float(deadline_ms) / 1e3
         self._queue.put(_Request(prompt, max_new, fut, deadline,
-                                 frames=frames))
+                                 frames=frames, prefix=prefix))
         return fut
 
     def _expire(self, reqs):
@@ -653,6 +681,14 @@ class ContinuousBatcher(_BatcherBase):
     admit_free_pages / admit_max_queue / admit_max_wait_ms : backpressure
         thresholds (``MXTPU_ADMIT_*``): keep N pages free, bound the
         queue depth, reject while rolling queue-wait p50 breaches.
+    max_prefix_tokens : forced target-prefix budget per request (re-sent
+        multi-turn history, ``submit(prefix_ids=...)``); each slot is
+        provisioned for ``1 + max_prefix_tokens + max_new_tokens``
+        cached positions. 0 (default) rejects prefix requests.
+    prefix_cache : enable the copy-on-write prefix trie over the page
+        pool (``MXTPU_PREFIX_CACHE`` when None): retiring slots donate
+        their page chains; admission adopts matched prefixes read-only
+        and replays only the uncached suffix.
     warmup : compile the admission-prefill program per bucket plus the
         decode-iteration program at construction (inert rows — the pools
         only ever see trash-page writes).
@@ -671,6 +707,8 @@ class ContinuousBatcher(_BatcherBase):
                  admit_free_pages: Optional[int] = None,
                  admit_max_queue: Optional[int] = None,
                  admit_max_wait_ms: Optional[float] = None,
+                 max_prefix_tokens: int = 0,
+                 prefix_cache: Optional[bool] = None,
                  warmup: bool = False, start: bool = True,
                  name: Optional[str] = None, watchdog=None):
         super().__init__(engine, bucket_keys, slots=slots,
@@ -685,8 +723,9 @@ class ContinuousBatcher(_BatcherBase):
         self._sampling.pop("seed", None)  # per-iteration key schedule
         self.page_size = int(page_size) if page_size is not None \
             else _pages.page_size_default()
-        self.pages_per_slot = _pages.pages_for(1 + self.max_new,
-                                               self.page_size)
+        self.max_prefix = int(max_prefix_tokens)
+        self.pages_per_slot = _pages.pages_for(
+            1 + self.max_prefix + self.max_new, self.page_size)
         self.num_pages = int(num_pages) if num_pages is not None \
             else _pages.num_pages_default(self.slots, self.pages_per_slot)
         if self.pages_per_slot > self.num_pages:
@@ -707,6 +746,23 @@ class ContinuousBatcher(_BatcherBase):
                                     self.slots, self.pages_per_slot)
         self._state = engine.init_paged_state(
             self.slots, self.num_pages, self.page_size, self.mem_len)
+        # prefix trie over this pool: retired slots donate their page
+        # chains (refcounted, read-only) and admission adopts matched
+        # prefixes instead of recomputing them
+        self.cache = _prefix.PrefixCache(
+            self.pool, self.page_size, enabled=prefix_cache)
+        self._cache_tag = getattr(engine, "weights_version", None)
+        # compiled batched hit-adoption program (traced once by warmup)
+        self._hits_fn = None
+        # suffix-length bucket menu for the forced-prefix replay program
+        # (same powers-of-2 discipline as the admission-row menu)
+        self._suffix_menu = []
+        if self.max_prefix > 0:
+            s = 1
+            while s < self.max_prefix:
+                self._suffix_menu.append(s)
+                s *= 2
+            self._suffix_menu.append(self.max_prefix)
         self._slots = [None] * self.slots
         self._pending = collections.deque()
         self._seq = 0
@@ -723,7 +779,12 @@ class ContinuousBatcher(_BatcherBase):
                       # disaggregated serving: KV handoffs adopted into
                       # this pool / handoffs that fell back to a local
                       # re-prefill (serving.disagg)
-                      "adopted": 0, "re_prefills": 0}
+                      "adopted": 0, "re_prefills": 0,
+                      # prefix caching: trie lookups that matched, KV
+                      # tokens served from cache instead of recomputed,
+                      # and copy-on-write page copies
+                      "prefix_hits": 0, "prefix_lookups": 0,
+                      "prefix_tokens_saved": 0, "cow_copies": 0}
         if warmup:
             self._warmup()
         if start:
@@ -762,6 +823,24 @@ class ContinuousBatcher(_BatcherBase):
             _np.zeros((self.slots,), bool), steps=self.iter_tokens,
             **self._sampling)
         jax.block_until_ready(buf.data)
+        # forced-prefix replay menu (rows x suffix-length buckets): the
+        # teacher-forced suffix program serves both cache hits and cold
+        # prefix replays, so it must be steady before the first one
+        for srows in rows_menu:
+            for s_len in self._suffix_menu:
+                toks = _np.zeros((srows, s_len), _np.int32)
+                ones = _np.ones((srows,), _np.int32)
+                tokS, self._state = eng.prefill_suffix_paged(
+                    self._state, toks, ones, ones,
+                    _np.zeros((srows, self.pages_per_slot), _np.int32),
+                    _np.full((srows,), self.slots, _np.int32),
+                    _np.zeros((srows,), bool), **self._sampling)
+                jax.block_until_ready(tokS.data)
+        # the batched hit-adoption program (inert here: TRASH->TRASH
+        # COW self-copies, out-of-bounds cross rows — shapes are padded
+        # to `slots`, so this one trace covers every admission group)
+        if self.cache.enabled:
+            self._apply_prefix_hits([])
         # warm the disaggregated-handoff adoption scatters too: the
         # first `.at[].set` per pool array otherwise compiles on the
         # scheduler thread mid-serving (a ~200 ms TTFT spike on the
@@ -890,6 +969,10 @@ class ContinuousBatcher(_BatcherBase):
                 s.finished = True
             if not s.finished:
                 continue
+            # donate the retiring chain to the prefix trie BEFORE the
+            # release: the trie's cache_acquire keeps the pages alive
+            # (refcounted) while the slot's own references go away
+            self._register_prefix(i, s)
             self.pool.release(i)
             self._slots[i] = None
             if not r.future.done():
@@ -974,46 +1057,295 @@ class ContinuousBatcher(_BatcherBase):
         except Exception:  # noqa: BLE001 - torn frames = re-prefill
             return False
 
+    # ------------------------------------------------------ prefix caching
+    def _cross_frames_fit(self, mem_vl: int, ck, cv) -> bool:
+        """Host-side geometry check for a cached root's cross frames —
+        the validation half of the old per-request adoption, run at
+        staging time so the batched apply never has to fail a single
+        row. False sends the request down the cold path."""
+        try:
+            mvl = int(mem_vl)
+            st = self._state
+            if mvl < 1 or mvl > self.mem_len \
+                    or ck is None or cv is None \
+                    or len(ck) != len(st["cross_k"]) \
+                    or len(cv) != len(st["cross_v"]):
+                return False
+            for i, c_k in enumerate(st["cross_k"]):
+                want = (mvl,) + tuple(c_k.shape[2:])
+                if tuple(_np.asarray(ck[i]).shape) != want \
+                        or tuple(_np.asarray(cv[i]).shape) != want:
+                    return False
+            return True
+        except Exception:  # noqa: BLE001 - torn frames = cold prefill
+            return False
+
+    def _apply_prefix_hits(self, hits) -> None:
+        """ONE batched device update for every prefix hit admitted this
+        iteration: a single gather/scatter duplicates all COW pages
+        across every layer's K/V pool, and a single scatter lands the
+        adopted cross frames + ``mem_vl`` rows. The per-request
+        ``.at[].set`` chains this replaces ran sequentially on the
+        scheduler thread and were measured at ~9 ms per hit on the CPU
+        rig — more than the batched cold replay they were saving.
+        Rows are padded to ``slots`` (COW pads as TRASH self-copies,
+        cross rows as out-of-bounds drops), so one compiled program
+        covers every admission-group size."""
+        import jax
+        import jax.numpy as jnp
+
+        st = self._state
+        rows = self.slots
+        src = _np.zeros((rows,), _np.int32)   # TRASH -> TRASH no-ops
+        dst = _np.zeros((rows,), _np.int32)
+        sids = _np.full((rows,), rows, _np.int32)  # OOB rows dropped
+        mvl = _np.zeros((rows,), _np.int32)
+        cks = [_np.zeros((rows, self.mem_len) + tuple(c.shape[2:]),
+                         _np.dtype(c.dtype)) for c in st["cross_k"]]
+        cvs = [_np.zeros((rows, self.mem_len) + tuple(c.shape[2:]),
+                         _np.dtype(c.dtype)) for c in st["cross_v"]]
+        for i, (slot, hit) in enumerate(hits):
+            if hit.cow is not None:
+                src[i] = int(hit.cow[0])
+                dst[i] = int(self.pool.table[slot, len(hit.full_pages)])
+            sids[i] = slot
+            mvl[i] = int(hit.mem_vl)
+            for li in range(len(cks)):
+                cks[li][i, :mvl[i]] = _np.asarray(hit.ck[li])
+                cvs[li][i, :mvl[i]] = _np.asarray(hit.cv[li])
+        if self._hits_fn is None:
+            def _apply(kps, vps, c_k, c_v, mem, src, dst, sids, mvl,
+                       cks, cvs):
+                kps = tuple(kp.at[dst].set(kp[src]) for kp in kps)
+                vps = tuple(vp.at[dst].set(vp[src]) for vp in vps)
+                c_k = tuple(c.at[sids].set(f, mode="drop")
+                            for c, f in zip(c_k, cks))
+                c_v = tuple(c.at[sids].set(f, mode="drop")
+                            for c, f in zip(c_v, cvs))
+                mem = mem.at[sids].set(mvl, mode="drop")
+                return kps, vps, c_k, c_v, mem
+            self._hits_fn = jax.jit(_apply)
+        out = self._hits_fn(st["k_pools"], st["v_pools"],
+                            st["cross_k"], st["cross_v"], st["mem_vl"],
+                            jnp.asarray(src), jnp.asarray(dst),
+                            jnp.asarray(sids), jnp.asarray(mvl),
+                            [jnp.asarray(a) for a in cks],
+                            [jnp.asarray(a) for a in cvs])
+        st = dict(st)
+        (st["k_pools"], st["v_pools"], st["cross_k"], st["cross_v"],
+         st["mem_vl"]) = out
+        self._state = st
+
+    def _register_prefix(self, slot: int, s) -> None:
+        """Donate a retiring slot's page chain to the prefix trie so a
+        later request sharing the prompt + target history adopts instead
+        of recomputing. Cross frames are read back from the device only
+        when the prompt is new to the trie (one sync per new root, on
+        the retire path — never on the dispatch path)."""
+        if not self.cache.enabled or s.length < 1:
+            return
+        r = s.req
+        pre = [] if r.prefix is None else [int(t) for t in r.prefix]
+        target = ([self._engine._bos] + pre
+                  + [int(t) for t in s.emitted])[:s.length]
+        mem_vl = ck = cv = None
+        if not self.cache.has_root(r.prompt):
+            import jax
+
+            # ONE device round trip for the whole readback (mem_vl +
+            # every layer's cross row) — per-layer ``asarray`` pulls
+            # each paid a separate sync against the async dispatch queue
+            st = self._state
+            n = len(st["cross_k"])
+            got = jax.device_get(
+                [st["mem_vl"][slot]]
+                + [c[slot] for c in st["cross_k"]]
+                + [c[slot] for c in st["cross_v"]])
+            mem_vl = int(got[0])
+            if mem_vl < 1:
+                return
+            ck = [g[:mem_vl] for g in got[1:1 + n]]
+            cv = [g[:mem_vl] for g in got[1 + n:]]
+        pages = list(self.pool.owned(slot))[
+            :_pages.pages_for(s.length, self.page_size)]
+        self.cache.insert(r.prompt, target, pages, mem_vl=mem_vl,
+                          ck=ck, cv=cv)
+
+    def _seed_from_frames(self, slot: int, r, fr: dict) -> None:
+        """A disaggregated handoff just adopted prefilled KV into
+        ``slot``: register it in the prefix trie too, so later
+        same-prompt requests on this decode worker hit the cache."""
+        if not self.cache.enabled:
+            return
+        L = int(fr["length"])
+        target = ([self._engine._bos]
+                  + [int(t) for t in fr["emitted"]])[:L]
+        pages = list(self.pool.owned(slot))[
+            :_pages.pages_for(L, self.page_size)]
+        self.cache.insert(r.prompt, target, pages,
+                          mem_vl=int(fr["mem_vl"]),
+                          ck=fr["ck"], cv=fr["cv"])
+
+    def _ensure_with_evict(self, slot: int, upto: int) -> bool:
+        """``pool.ensure`` with one retry after asking the trie to evict
+        unreferenced cached pages — cached-but-idle KV yields to live
+        requests before admission is refused."""
+        if self.pool.ensure(slot, upto):
+            return True
+        need = _pages.pages_for(upto, self.page_size) \
+            - len(self.pool.owned(slot))
+        if self.cache.evict(max(need, 1)) == 0:
+            return False
+        return self.pool.ensure(slot, upto)
+
+    def _stage_slot(self, slot: int, r):
+        """Allocate (or adopt from the prefix trie) the pages ``slot``
+        needs for the request's full forced target prefix, adopting the
+        root's cross frames on a hit. Returns ``(ok, hit)``: ``ok``
+        False means the pool cannot stage this request right now (the
+        caller puts it back); ``hit`` None means the cold path — BOS
+        prefill, then a teacher-forced suffix replay if the request
+        carries a prefix."""
+        target_len = 1 + (0 if r.prefix is None
+                          else int(r.prefix.shape[0]))
+        reg = _tel.registry()
+        hit = None
+        if r.frames is None and self.cache.enabled:
+            target = [self._engine._bos] + ([] if r.prefix is None
+                                            else [int(t) for t in r.prefix])
+            hit = self.cache.match(r.prompt, target)
+            with self._stats_lock:
+                self.stats["prefix_lookups"] += 1
+            if hit is not None and hit.matched < 1:
+                # a bare root offers no adoptable pages, and the BOS
+                # prime re-runs the encoder anyway — nothing to win
+                hit = None
+        if hit is not None:
+            # geometry first: a root with torn cross frames must fall
+            # back to the cold path BEFORE it acquires any pages
+            ok = self._cross_frames_fit(hit.mem_vl, hit.ck, hit.cv)
+            if ok:
+                ok = self.pool.adopt_ref(slot, hit.full_pages)
+            if ok:
+                ok = self._ensure_with_evict(slot, target_len)
+            if ok and hit.cow is not None:
+                # the first page past the fully-adopted run becomes this
+                # slot's private copy of the donor's partial page (the
+                # replay appends into the copy, never the original); the
+                # copy itself rides in the admission group's single
+                # batched device update (``_apply_prefix_hits``)
+                with self._stats_lock:
+                    self.stats["cow_copies"] += 1
+                reg.counter("infer/prefix_cow_copies").inc()
+            if not ok:
+                self.pool.release(slot)
+                hit = None
+            else:
+                with self._stats_lock:
+                    self.stats["prefix_hits"] += 1
+                    self.stats["prefix_tokens_saved"] += \
+                        int(r.prompt.shape[0]) + hit.matched
+                reg.counter("infer/prefix_tokens_saved").inc(
+                    int(r.prompt.shape[0]) + hit.matched)
+        if hit is None:
+            ok = self.pool.alloc(slot, 1) \
+                or (self.cache.evict(1) > 0 and self.pool.alloc(slot, 1))
+            if ok:
+                ok = self._ensure_with_evict(slot, target_len)
+            if not ok:
+                self.pool.release(slot)
+                return False, None
+        return True, hit
+
+    def prefix_stats(self) -> dict:
+        """Prefix-cache snapshot (trie stats + batcher-side COW
+        counter) — the worker health verb's prefix block."""
+        out = self.cache.snapshot()
+        with self._stats_lock:
+            out["cow_copies"] = self.stats["cow_copies"]
+        return out
+
+    def prefix_digests(self, limit=None):
+        """Most-recently-used root digests — the compact advertisement
+        behind the router's prefix-affinity placement."""
+        return self.cache.digests(limit)
+
     def _admit(self) -> int:
         """Fill vacated slots from the waiting line: requests carrying
         prefilled KV frames (disaggregated handoff) are ADOPTED straight
-        into their slots, the rest go through ONE padded (slots, bucket)
-        prefill-into-pages dispatch; stream each admitted row's first
-        token. Respects the free-page watermark."""
+        into their slots; prefix-trie hits adopt their cached pages and
+        replay only the uncached suffix; the rest go through ONE padded
+        (slots, bucket) prefill-into-pages dispatch (cold rows with a
+        forced prefix join the suffix replay afterwards); stream each
+        admitted row's first token. Respects the free-page watermark,
+        evicting idle cached pages before refusing admission."""
         free = [i for i, s in enumerate(self._slots) if s is None]
         if not free or not self._pending:
             return 0
+        reg = _tel.registry()
+        version = getattr(self._engine, "weights_version", None)
+        if self.cache.enabled and version != self._cache_tag:
+            # weights hot-swapped mid-serving: every cached page holds
+            # KV from the OLD weights — serving it would silently mix
+            # model versions
+            self.cache.flush()
+            self._cache_tag = version
         picked = []
         while free and self._pending:
             if self.pool.free_pages - len(picked) <= self._admit_free_pages \
                     and self.pool.pages_in_use > 0:
-                break  # keep headroom for the requests already decoding
+                # cached-but-unreferenced pages are reclaimable
+                # headroom: the trie yields before admission stalls
+                short = self._admit_free_pages + len(picked) + 1 \
+                    - self.pool.free_pages
+                if self.cache.evict(short) == 0:
+                    break  # keep headroom for requests already decoding
             r = self._pending.popleft()
             slot = free.pop(0)
-            if not self.pool.alloc(slot, 1):
+            ok, hit = self._stage_slot(slot, r)
+            if not ok:
                 self._pending.appendleft(r)
                 free.insert(0, slot)
                 break
-            picked.append((slot, r))
-        reg = _tel.registry()
+            picked.append((slot, r, hit))
         reg.histogram("infer/admitted_per_iter").observe(len(picked))
         if not picked:
             return 0
-        version = getattr(self._engine, "weights_version", None)
-        adopt, plain = [], []
-        for slot, r in picked:
+        hit_rows = [(slot, hit) for slot, _r, hit in picked
+                    if hit is not None]
+        if hit_rows:
+            try:
+                _faults.fire("batcher.dispatch", tag=self.name)
+                self._apply_prefix_hits(hit_rows)
+            except Exception as e:  # noqa: BLE001 - fail futures, not thread
+                for _slot, r, _hit in picked:
+                    if not r.future.done():
+                        r.future._fail(e)
+                self._poison(e)
+                return 0
+        adopt, cold, suffix = [], [], []
+        for slot, r, hit in picked:
             if r.frames is not None and self._adopt(slot, r.frames):
                 adopt.append((slot, r))
+                continue
+            if r.frames is not None:
+                # handoff arrived but cannot be adopted (mismatched
+                # geometry / torn frames): fall back to a local
+                # prefill from the prompt — the request still serves
+                r.frames = None
+                with self._stats_lock:
+                    self.stats["re_prefills"] += 1
+                reg.counter("disagg/re_prefills").inc()
+            if hit is not None:
+                suffix.append((slot, r, hit))
             else:
-                if r.frames is not None:
-                    # handoff arrived but cannot be adopted (mismatched
-                    # geometry / torn frames): fall back to a local
-                    # prefill from the prompt — the request still serves
-                    r.frames = None
-                    with self._stats_lock:
-                        self.stats["re_prefills"] += 1
-                    reg.counter("disagg/re_prefills").inc()
-                plain.append((slot, r))
+                cold.append((slot, r))
+                if r.prefix is not None:
+                    # forced history, nothing cached: BOS-prime first,
+                    # then replay the whole prefix through the SAME
+                    # suffix program a cache hit uses (bit-identity)
+                    suffix.append((slot, r, None))
+        n_admitted = 0
         if adopt:
             t_admit = time.perf_counter()
             for slot, r in adopt:
@@ -1026,6 +1358,7 @@ class ContinuousBatcher(_BatcherBase):
                 s.emitted = [int(t) for t in fr["emitted"]]
                 s.version = version
                 self._slots[slot] = s
+                self._seed_from_frames(slot, r, fr)
                 r.future.queue_wait_ms = \
                     (t_admit - r.future.enqueued_at) * 1e3
                 self._note_wait(max(r.future.queue_wait_ms, 0.0))
@@ -1041,70 +1374,132 @@ class ContinuousBatcher(_BatcherBase):
                     s.finished = True
             with self._stats_lock:
                 self.stats["adopted"] += len(adopt)
-                self.stats["admitted"] += len(adopt)
+            n_admitted += len(adopt)
             reg.counter("disagg/handoffs").inc(len(adopt))
-        picked = plain
-        if not picked:
-            return len(adopt)
-        bucket = self._bucket_for(
-            max(r.prompt.shape[0] for _, r in picked))
-        # admission sub-batch menu: the prefill dispatch shape is the
-        # smallest power-of-two row count covering the admitted set, so a
-        # single-request admission costs a (1, bucket) forward, not a
-        # full (slots, bucket) one — admission-heavy (short-response)
-        # loads would otherwise spend more on prefill than on decode
-        rows = 1
-        while rows < len(picked):
-            rows *= 2
-        rows = min(rows, self.slots)
-        src = _np.full((rows, bucket), self._pad, _np.int32)
-        vl = _np.full((rows,), bucket, _np.int32)
-        slot_ids = _np.full((rows,), self.slots, _np.int32)  # OOB = inert
-        first_pages = _np.zeros((rows,), _np.int32)
-        active = _np.zeros((rows,), bool)
-        for i, (slot, r) in enumerate(picked):
-            n = r.prompt.shape[0]
-            src[i, :n] = r.prompt
-            vl[i] = n
-            slot_ids[i] = slot
-            first_pages[i] = self.pool.table[slot, 0]
-            active[i] = True
-        t0 = time.perf_counter()
-        try:
-            _faults.fire("batcher.dispatch", tag=self.name)
-            tok0, self._state = self._engine.prefill_paged(
-                self._state, src, vl, slot_ids, first_pages, active,
-                seed=self._iter, **self._sampling)
-            tok0 = tok0.asnumpy()
-        except Exception as e:  # noqa: BLE001 - fail the futures, not the thread
-            for slot, r in picked:
-                if not r.future.done():
-                    r.future._fail(e)
-            self._poison(e)
-            return 0
-        prefill_ms = (time.perf_counter() - t0) * 1e3
-        reg.histogram("infer/prefill_ms").observe(prefill_ms)
-        for i, (slot, r) in enumerate(picked):
-            s = _Slot(r, self._seq)
-            self._seq += 1
-            s.length = 1  # the BOS prime sits in the slot's first page
-            s.carry = int(tok0[i])
-            s.version = version
-            s.emitted.append(s.carry)
-            self._slots[slot] = s
-            r.future.queue_wait_ms = (t0 - r.future.enqueued_at) * 1e3
-            self._note_wait(max(r.future.queue_wait_ms, 0.0))
-            reg.histogram("infer/queue_wait_ms").observe(
-                max(r.future.queue_wait_ms, 0.0))
-            r.future._stream_tokens([s.carry])
-            ttft = (r.future.first_token_at - r.future.enqueued_at) * 1e3
-            reg.histogram("infer/ttft_ms").observe(ttft)
-            self._note_ttft(ttft)
-            if s.carry == self._engine._eos or len(s.emitted) >= r.max_new:
-                s.finished = True
+        if cold:
+            bucket = self._bucket_for(
+                max(r.prompt.shape[0] for _, r in cold))
+            # admission sub-batch menu: the prefill dispatch shape is
+            # the smallest power-of-two row count covering the admitted
+            # set, so a single-request admission costs a (1, bucket)
+            # forward, not a full (slots, bucket) one — admission-heavy
+            # (short-response) loads would otherwise spend more on
+            # prefill than on decode
+            rows = 1
+            while rows < len(cold):
+                rows *= 2
+            rows = min(rows, self.slots)
+            src = _np.full((rows, bucket), self._pad, _np.int32)
+            vl = _np.full((rows,), bucket, _np.int32)
+            slot_ids = _np.full((rows,), self.slots, _np.int32)  # OOB
+            first_pages = _np.zeros((rows,), _np.int32)
+            active = _np.zeros((rows,), bool)
+            for i, (slot, r) in enumerate(cold):
+                n = r.prompt.shape[0]
+                src[i, :n] = r.prompt
+                vl[i] = n
+                slot_ids[i] = slot
+                first_pages[i] = self.pool.table[slot, 0]
+                active[i] = True
+            t0 = time.perf_counter()
+            try:
+                _faults.fire("batcher.dispatch", tag=self.name)
+                tok0, self._state = self._engine.prefill_paged(
+                    self._state, src, vl, slot_ids, first_pages, active,
+                    seed=self._iter, **self._sampling)
+                tok0 = tok0.asnumpy()
+            except Exception as e:  # noqa: BLE001 - fail futures, not thread
+                for slot, r, _hit in picked:
+                    if not r.future.done():
+                        r.future._fail(e)
+                self._poison(e)
+                return 0
+            prefill_ms = (time.perf_counter() - t0) * 1e3
+            reg.histogram("infer/prefill_ms").observe(prefill_ms)
+            for i, (slot, r) in enumerate(cold):
+                if r.prefix is not None:
+                    # its first token comes from the suffix replay; the
+                    # BOS-prime sample is overridden by the forced
+                    # history
+                    continue
+                self._activate(slot, r, int(tok0[i]), t0, version, 1)
+                n_admitted += 1
+        if suffix:
+            srows = 1
+            while srows < len(suffix):
+                srows *= 2
+            srows = min(srows, self.slots)
+            need = 0
+            plans = []
+            for slot, r, hit in suffix:
+                target = [self._engine._bos] + [int(t) for t in r.prefix]
+                start = hit.matched if hit is not None else 1
+                plans.append((slot, r, target, start))
+                need = max(need, len(target) - start)
+            s_len = next(s for s in self._suffix_menu if s >= need)
+            toks = _np.zeros((srows, s_len), _np.int32)
+            vl_s = _np.ones((srows,), _np.int32)
+            q_off = _np.zeros((srows,), _np.int32)
+            tables = _np.zeros((srows, self.pages_per_slot), _np.int32)
+            sids = _np.full((srows,), self.slots, _np.int32)  # OOB
+            act = _np.zeros((srows,), bool)
+            for i, (slot, r, target, start) in enumerate(plans):
+                tail = target[start:]
+                toks[i, :len(tail)] = tail
+                vl_s[i] = len(tail)
+                q_off[i] = start
+                tables[i] = self.pool.table[slot]
+                sids[i] = slot
+                act[i] = True
+            t1 = time.perf_counter()
+            try:
+                _faults.fire("batcher.dispatch", tag=self.name)
+                tokS, self._state = self._engine.prefill_suffix_paged(
+                    self._state, toks, vl_s, q_off, tables, sids, act,
+                    seed=self._iter, **self._sampling)
+                tokS = tokS.asnumpy()
+            except Exception as e:  # noqa: BLE001 - fail futures, not thread
+                for slot, r, _hit in picked:
+                    if not r.future.done():
+                        r.future._fail(e)
+                self._poison(e)
+                return 0
+            reg.histogram("infer/prefill_ms").observe(
+                (time.perf_counter() - t1) * 1e3)
+            for i, (slot, r, target, start) in enumerate(plans):
+                self._activate(slot, r, int(tokS[i]), t1, version,
+                               len(target))
+                n_admitted += 1
         with self._stats_lock:
-            self.stats["admitted"] += len(picked)
-        return len(adopt) + len(picked)
+            self.stats["admitted"] += n_admitted
+        if self.cache.enabled:
+            reg.gauge("infer/prefix_hit_rate").set(self.cache.hit_rate())
+            reg.gauge("infer/pages_shared").set(self.pool.shared_pages)
+        return n_admitted
+
+    def _activate(self, slot: int, r, first_tok: int, t0: float,
+                  version, length: int) -> None:
+        """Install the freshly-prefilled request into its slot and
+        stream its first sampled token (TTFT instant): shared by the
+        cold-prefill and suffix-replay admission paths."""
+        reg = _tel.registry()
+        s = _Slot(r, self._seq)
+        self._seq += 1
+        s.length = length  # cached target positions (prime + prefix)
+        s.carry = first_tok
+        s.version = version
+        s.emitted.append(s.carry)
+        self._slots[slot] = s
+        r.future.queue_wait_ms = (t0 - r.future.enqueued_at) * 1e3
+        self._note_wait(max(r.future.queue_wait_ms, 0.0))
+        reg.histogram("infer/queue_wait_ms").observe(
+            max(r.future.queue_wait_ms, 0.0))
+        r.future._stream_tokens([s.carry])
+        ttft = (r.future.first_token_at - r.future.enqueued_at) * 1e3
+        reg.histogram("infer/ttft_ms").observe(ttft)
+        self._note_ttft(ttft)
+        if s.carry == self._engine._eos or len(s.emitted) >= r.max_new:
+            s.finished = True
 
     def _ensure_capacity(self, live):
         """Grow page allocations so every live row can cache
@@ -1118,8 +1513,14 @@ class ContinuousBatcher(_BatcherBase):
             # a row near its max_new needs less than a full burst; beyond
             # its allocation the device's surplus burst steps land in the
             # trash page, so the cap is safe
-            upto = min(s.length + self.iter_tokens, 1 + s.req.max_new)
+            base = 1 + (0 if s.req.prefix is None
+                        else int(s.req.prefix.shape[0]))
+            upto = min(s.length + self.iter_tokens, base + s.req.max_new)
             while not self.pool.ensure(i, upto):
+                # idle cached pages yield before any live row is
+                # preempted — the trie is a cache, not a tenant
+                if self.cache.evict(1) > 0:
+                    continue
                 victims = [j for j in range(self.slots)
                            if self._slots[j] is not None
                            and not self._slots[j].finished and j != i]
@@ -1230,6 +1631,7 @@ class ContinuousBatcher(_BatcherBase):
                 if not s.req.future.done():
                     s.req.future._fail(err)
                 self._slots[i] = None
+        self.cache.flush()  # the pages the trie indexed no longer exist
         self.pool.reset()
         self._state = self._engine.init_paged_state(
             self.slots, self.num_pages, self.page_size, self.mem_len)

@@ -14,6 +14,14 @@ whether admitting another request would starve the ones already decoding:
   a stale table entry can never alias a live request's pages.
 - ``alloc``/``release`` are LIFO over the free list — a retired request's
   pages are handed to the next admission, keeping the working set hot.
+- **Pages are reference-counted** (prefix caching): ``alloc`` starts a
+  page at refcount 1, ``adopt_ref`` lets another slot map an existing
+  page read-only (ref+1), ``cache_acquire``/``cache_release`` are the
+  prefix trie's ref, and a page returns to the free list only when its
+  refcount hits 0. A slot may WRITE a page only while it is the sole
+  reference (ref == 1) — shared pages are append-only history that
+  every reader replays identically, and divergence goes through a
+  copy-on-write page instead (``ContinuousBatcher`` owns that protocol).
 - ``ensure(slot, upto)`` grows a slot's allocation on demand, one page at
   a time, as its decode length crosses page boundaries — the whole point
   of paging: a request that stops at 3 tokens holds 1 page, not
@@ -122,6 +130,10 @@ class PagePool:
         self._owned: List[List[int]] = [[] for _ in range(self.slots)]
         self.table = np.full((self.slots, self.pages_per_slot), TRASH_PAGE,
                              np.int32)
+        # ref[p] = (#slots mapping p) + (1 if the prefix cache holds p);
+        # a page is free iff ref == 0 — check_invariants proves exactness
+        self._ref = np.zeros(self.num_pages + 1, np.int64)
+        self._cached: set = set()
 
     # ------------------------------------------------------------- queries
     @property
@@ -134,6 +146,25 @@ class PagePool:
 
     def owned(self, slot: int) -> tuple:
         return tuple(self._owned[slot])
+
+    def ref(self, page: int) -> int:
+        """Current reference count of ``page`` (0 = free)."""
+        return int(self._ref[page])
+
+    def shared(self, page: int) -> bool:
+        """True when more than one reference maps ``page`` — writes must
+        go through copy-on-write."""
+        return int(self._ref[page]) > 1
+
+    @property
+    def shared_pages(self) -> int:
+        """How many pages currently carry more than one reference (the
+        ``infer/pages_shared`` gauge)."""
+        return int((self._ref[1:] > 1).sum())
+
+    def cached_pages(self) -> frozenset:
+        """Pages currently referenced by the prefix cache."""
+        return frozenset(self._cached)
 
     def capacity(self, slot: int) -> int:
         """Tokens slot ``slot`` can hold with its current pages."""
@@ -151,8 +182,9 @@ class PagePool:
 
     # ----------------------------------------------------------- lifecycle
     def alloc(self, slot: int, n: int = 1) -> bool:
-        """Give ``slot`` ``n`` more pages; False (state unchanged) when
-        the free list or the slot's table row can't cover it."""
+        """Give ``slot`` ``n`` more fresh pages (refcount 1 each); False
+        (state unchanged) when the free list or the slot's table row
+        can't cover it."""
         owned = self._owned[slot]
         if len(self._free) < n or len(owned) + n > self.pages_per_slot:
             return False
@@ -160,7 +192,60 @@ class PagePool:
             p = self._free.pop()
             self.table[slot, len(owned)] = p
             owned.append(p)
+            self._ref[p] = 1
         return True
+
+    def adopt_ref(self, slot: int, pages) -> bool:
+        """Map already-live ``pages`` (in order) into ``slot``'s table
+        read-only, bumping each refcount. False (state unchanged) when
+        the slot's table row can't hold them; adopting a dead page or a
+        page the slot already maps is a caller bug and raises."""
+        pages = [int(p) for p in pages]
+        owned = self._owned[slot]
+        if len(owned) + len(pages) > self.pages_per_slot:
+            return False
+        for p in pages:
+            if p == TRASH_PAGE or not 1 <= p <= self.num_pages:
+                raise MXNetError(f"adopt_ref of invalid page {p}")
+            if int(self._ref[p]) < 1:
+                raise MXNetError(f"adopt_ref of free page {p}")
+        if set(pages) & set(owned) or len(set(pages)) != len(pages):
+            raise MXNetError(
+                f"slot {slot} adopting a page it already maps: {pages}")
+        for p in pages:
+            self.table[slot, len(owned)] = p
+            owned.append(p)
+            self._ref[p] += 1
+        return True
+
+    def cache_acquire(self, pages):
+        """The prefix cache takes one reference on each of ``pages``
+        (they must be live — typically still mapped by the inserting
+        slot). Double-acquire is a trie bug and raises."""
+        for p in pages:
+            p = int(p)
+            if p == TRASH_PAGE or int(self._ref[p]) < 1:
+                raise MXNetError(f"cache_acquire of free page {p}")
+            if p in self._cached:
+                raise MXNetError(f"cache_acquire of cached page {p}")
+            self._cached.add(p)
+            self._ref[p] += 1
+
+    def cache_release(self, pages) -> int:
+        """Drop the cache's reference on each of ``pages``; pages that
+        hit refcount 0 return to the free list. Returns how many were
+        actually freed."""
+        freed = 0
+        for p in pages:
+            p = int(p)
+            if p not in self._cached:
+                raise MXNetError(f"cache_release of uncached page {p}")
+            self._cached.discard(p)
+            self._ref[p] -= 1
+            if int(self._ref[p]) == 0:
+                self._free.append(p)
+                freed += 1
+        return freed
 
     def ensure(self, slot: int, upto: int) -> bool:
         """Grow ``slot``'s allocation to hold ``upto`` tokens; False when
@@ -172,43 +257,70 @@ class PagePool:
         return self.alloc(slot, need - have)
 
     def release(self, slot: int) -> int:
-        """Return every page ``slot`` owns to the free list and point its
-        table row back at the trash page. Returns how many were freed."""
+        """Drop ``slot``'s reference on every page it maps and point its
+        table row back at the trash page; pages that hit refcount 0
+        return to the free list. Returns how many were actually freed."""
         owned = self._owned[slot]
-        n = len(owned)
+        freed = 0
         while owned:
-            self._free.append(owned.pop())
+            p = owned.pop()
+            self._ref[p] -= 1
+            if int(self._ref[p]) == 0:
+                self._free.append(p)
+                freed += 1
         self.table[slot, :] = TRASH_PAGE
-        return n
+        return freed
 
     def reset(self):
-        for s in range(self.slots):
-            self.release(s)
+        """Hard reinit: every slot and cache reference is dropped (the
+        poison/rebuild path — callers also flush their prefix trie)."""
+        self._free = list(range(self.num_pages, 0, -1))
+        self._owned = [[] for _ in range(self.slots)]
+        self.table[:, :] = TRASH_PAGE
+        self._ref[:] = 0
+        self._cached.clear()
 
-    def check_invariants(self, live_slots=None):
-        """Exactness audit (tests + debugging, not the hot path): free
-        list + owned pages partition [1, num_pages] with no page owned by
-        two slots, and the table mirrors ownership."""
-        seen = {}
+    def check_invariants(self, live_slots=None, cache_pages=None):
+        """Exactness audit (tests + debugging, not the hot path): every
+        page's refcount equals its slot mappings plus its cache
+        membership, the free list is exactly the refcount-0 pages, and
+        the table mirrors ownership. ``cache_pages`` (the prefix trie's
+        own page set) cross-checks the pool's cache-reference ledger."""
+        owners = {}
         for s, owned in enumerate(self._owned):
+            if len(set(owned)) != len(owned):
+                raise MXNetError(f"slot {s} maps a page twice: {owned}")
             for j, p in enumerate(owned):
-                if p in seen:
-                    raise MXNetError(
-                        f"page {p} aliased by slots {seen[p]} and {s}")
                 if p == TRASH_PAGE:
                     raise MXNetError(f"slot {s} owns the trash page")
                 if int(self.table[s, j]) != p:
                     raise MXNetError(
                         f"table[{s},{j}]={self.table[s, j]} != owned {p}")
-                seen[p] = s
+                owners.setdefault(p, []).append(s)
         free = set(self._free)
         if len(free) != len(self._free):
             raise MXNetError("free list holds duplicate pages")
+        for p in range(1, self.num_pages + 1):
+            want = len(owners.get(p, ())) + (1 if p in self._cached else 0)
+            if int(self._ref[p]) != want:
+                raise MXNetError(
+                    f"page {p} refcount {int(self._ref[p])} != "
+                    f"{len(owners.get(p, ()))} slot owner(s) + "
+                    f"{int(p in self._cached)} cache ref")
+            if (p in free) != (want == 0):
+                raise MXNetError(
+                    f"page {p} ref {want} but free-list membership "
+                    f"{p in free}")
+        referenced = set(owners) | self._cached
         universe = set(range(1, self.num_pages + 1))
-        if free | set(seen) != universe or free & set(seen):
+        if free | referenced != universe:
             raise MXNetError(
-                f"free ({len(free)}) + owned ({len(seen)}) pages do not "
-                f"partition the pool of {self.num_pages}")
+                f"free ({len(free)}) + referenced ({len(referenced)}) "
+                f"pages do not cover the pool of {self.num_pages}")
+        if cache_pages is not None and set(cache_pages) != self._cached:
+            raise MXNetError(
+                f"prefix-trie pages {sorted(set(cache_pages))} != pool "
+                f"cache ledger {sorted(self._cached)}")
         if live_slots is not None:
             for s in range(self.slots):
                 if s not in live_slots and self._owned[s]:

@@ -81,9 +81,12 @@ class _RemoteBatcher:
         return self._client.dead is None
 
     def submit(self, prompt_ids, max_new_tokens=None,
-               deadline_ms=None) -> GenerationResult:
+               deadline_ms=None, prefix_ids=None) -> GenerationResult:
+        extra = None
+        if prefix_ids is not None and len(prefix_ids) > 0:
+            extra = {"prefix_ids": [int(t) for t in prefix_ids]}
         return self._client.submit(prompt_ids, max_new_tokens,
-                                   deadline_ms=deadline_ms)
+                                   deadline_ms=deadline_ms, extra=extra)
 
     def cancel_pending(self, error=None) -> int:
         err = error if error is not None else ReplicaUnavailable(
@@ -237,6 +240,12 @@ class RemoteReplica(Replica):
     @property
     def weights_version(self) -> Optional[str]:
         return self._probe_info.get("weights_version")
+
+    def prefix_digests(self) -> tuple:
+        """Worker-reported prefix-cache digests (health verb) — the
+        prefix-affinity placement signal; empty until the first probe
+        answers or when the worker's cache is disabled."""
+        return tuple(self._probe_info.get("prefix_digests") or ())
 
     # ------------------------------------------------ disaggregated serving
     @property

@@ -75,6 +75,7 @@ from ..base import MXNetError
 from .. import telemetry as _tel
 from ..telemetry.watchdog import read_heartbeat
 from . import faults as _faults
+from . import prefix as _prefix
 from .batcher import Backpressure, DeadlineExceeded, DynamicBatcher, \
     GenerationResult
 
@@ -285,15 +286,30 @@ class Replica:
         p50 = self.queue_wait_p50_ms()
         return (p50 if p50 else 1.0) * (self.load() + 1)
 
+    def prefix_digests(self) -> tuple:
+        """Compact digest of the prompts this replica's prefix cache
+        holds (``serving.prefix.prompt_digest`` per trie root) — what
+        prefix-affinity placement matches against. Empty when the local
+        batcher has no cache (remote replicas report theirs over the
+        health verb)."""
+        fn = getattr(self.batcher, "prefix_digests", None)
+        if fn is None:
+            return ()
+        try:
+            return tuple(fn(_prefix.prefix_digest_max()))
+        except Exception:  # noqa: BLE001 - affinity is advisory only
+            return ()
+
 
 class _Routed:
     """Router-side record of one request across (re)submissions."""
 
     __slots__ = ("prompt", "max_new", "deadline", "outer", "replica",
-                 "inner", "attempts", "next_try_at", "created", "klass")
+                 "inner", "attempts", "next_try_at", "created", "klass",
+                 "prefix", "digest")
 
     def __init__(self, prompt, max_new, deadline, outer,
-                 klass="interactive"):
+                 klass="interactive", prefix=None, digest=None):
         self.prompt = prompt
         self.max_new = max_new
         self.deadline = deadline  # absolute perf_counter instant or None
@@ -304,6 +320,8 @@ class _Routed:
         self.next_try_at = 0.0
         self.created = time.perf_counter()
         self.klass = klass  # SLO class: "interactive" | "batch"
+        self.prefix = prefix  # forced history for prefix-cache replay
+        self.digest = digest  # prompt digest for affinity placement
 
 
 class Router:
@@ -419,7 +437,8 @@ class Router:
     # ------------------------------------------------------------- requests
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
-               klass: str = "interactive") -> GenerationResult:
+               klass: str = "interactive",
+               prefix_ids=None) -> GenerationResult:
         """Route one prompt to a healthy replica. The returned future
         resolves even across replica failures (transparent resubmission)
         — it fails only on retry exhaustion, deadline expiry, or total
@@ -430,7 +449,15 @@ class Router:
         default (``MXTPU_SLO_INTERACTIVE_MS``/``MXTPU_SLO_BATCH_MS``)
         applies, per-class TTFT is recorded
         (``disagg/ttft_interactive_ms``/``disagg/ttft_batch_ms``), and
-        under a degraded fleet batch traffic sheds first."""
+        under a degraded fleet batch traffic sheds first.
+
+        ``prefix_ids`` is the already-generated conversation history to
+        teacher-force before decoding (multi-turn). Placement then
+        PREFERS replicas advertising this prompt's digest in their
+        prefix cache (``MXTPU_PREFIX_AFFINITY``) so the cached KV is
+        actually reused, falling back to predicted-wait placement when
+        no replica holds it; prefix requests always route to the decode
+        replica directly (the forced history makes a KV handoff moot)."""
         if klass not in REQUEST_CLASSES:
             raise MXNetError(
                 f"unknown request class {klass!r} "
@@ -443,8 +470,12 @@ class Router:
             dl_ms = slo if slo > 0 else self.default_deadline_ms
         deadline = None if dl_ms is None \
             else time.perf_counter() + float(dl_ms) / 1e3
+        prefix = digest = None
+        if prefix_ids is not None and len(prefix_ids) > 0:
+            prefix = [int(t) for t in prefix_ids]
+            digest = _prefix.prompt_digest(prompt_ids)
         r = _Routed(prompt_ids, max_new_tokens, deadline, outer,
-                    klass=klass)
+                    klass=klass, prefix=prefix, digest=digest)
         _tel.registry().counter("serve/requests").inc()
         with self._lock:
             shed = self._shed_reason_locked(r)
@@ -569,7 +600,11 @@ class Router:
         retries until ``no_replica_timeout_s``). With prefill-role
         replicas in the fleet the placement is DISAGGREGATED: the
         chosen prefill worker computes and ships the KV, the decode
-        replica adopts it (``RemoteReplica.submit_disagg``)."""
+        replica adopts it (``RemoteReplica.submit_disagg``). A request
+        carrying a prompt digest (multi-turn ``prefix_ids``) first
+        narrows the candidates to replicas ADVERTISING that digest —
+        prefix affinity — and only falls back to the whole fleet when
+        no replica holds the cached prefix."""
         now = time.perf_counter()
         candidates = [rep for rep in self._replicas
                       if rep.healthy and rep.serves_decode
@@ -587,7 +622,14 @@ class Router:
             r.inner = None
             r.next_try_at = now + self.health_interval_s
             return False
-        rep = self._pick_locked(candidates)
+        pool = candidates
+        if r.digest is not None and _prefix.prefix_affinity_enabled():
+            affine = [rep for rep in candidates
+                      if r.digest in rep.prefix_digests()]
+            if affine:
+                pool = affine
+                _tel.registry().counter("serve/prefix_affinity").inc()
+        rep = self._pick_locked(pool)
         remaining_ms = None
         if r.deadline is not None:
             remaining_ms = (r.deadline - time.perf_counter()) * 1e3
@@ -601,13 +643,17 @@ class Router:
         # split's whole point is keeping the long prefills off the
         # decode workers
         pre = None
-        if hasattr(rep, "submit_disagg") \
+        if r.prefix is None and hasattr(rep, "submit_disagg") \
                 and len(r.prompt) >= self.disagg_min_prompt:
             pre = self._pick_prefill_locked()
         if pre is not None:
             r.inner = rep.submit_disagg(pre, r.prompt, r.max_new,
                                         deadline_ms=remaining_ms,
                                         klass=r.klass)
+        elif r.prefix is not None:
+            r.inner = rep.batcher.submit(r.prompt, r.max_new,
+                                         deadline_ms=remaining_ms,
+                                         prefix_ids=r.prefix)
         else:
             r.inner = rep.batcher.submit(r.prompt, r.max_new,
                                          deadline_ms=remaining_ms)

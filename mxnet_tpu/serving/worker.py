@@ -73,6 +73,7 @@ from ..base import MXNetError
 from .. import telemetry as _tel
 from . import disagg as _disagg
 from . import faults as _faults
+from . import prefix as _prefix
 from .transport import RpcClient, RpcServer, serve_port
 
 __all__ = ["ServingWorker", "WorkerHandle", "spawn_worker", "main",
@@ -151,7 +152,8 @@ class ServingWorker:
                  warmup: bool = True, heartbeat_s: float = 0.5,
                  ckpt_dir: Optional[str] = None,
                  drain_s: Optional[float] = None,
-                 role: Optional[str] = None):
+                 role: Optional[str] = None,
+                 max_prefix: int = 0):
         from ..parallel import InferStep
         from ..telemetry.watchdog import Watchdog
         from . import make_batcher
@@ -195,7 +197,8 @@ class ServingWorker:
             self.batcher = make_batcher(
                 self.engine, tuple(bucket_keys), slots=slots,
                 max_new_tokens=max_new, warmup=bat_warmup, name=name,
-                watchdog=self.watchdog)
+                watchdog=self.watchdog,
+                max_prefix_tokens=int(max_prefix))
         self.prefiller = None
         if self.role == "prefill":
             self.prefiller = _disagg.PrefillEngine(
@@ -297,6 +300,14 @@ class ServingWorker:
             with stats_lock:
                 adopted = bat.stats.get("adopted")
                 re_prefilled = bat.stats.get("re_prefills")
+        digests = prefix_stats = None
+        fn = getattr(bat, "prefix_digests", None)
+        if fn is not None:
+            # the affinity signal: which prompts this worker's prefix
+            # cache holds, as compact digests (bounded by the env knob —
+            # the health frame must stay small)
+            digests = list(fn(_prefix.prefix_digest_max()))
+            prefix_stats = bat.prefix_stats()
         respond(healthy=bool(bat.healthy and not self._draining),
                 status="draining" if self._draining else "serving",
                 queue_depth=bat._queue.qsize() + busy,
@@ -306,6 +317,8 @@ class ServingWorker:
                 ttft_p50_ms=bat.rolling_ttft_ms(),
                 disagg_adopted=adopted,
                 disagg_re_prefills=re_prefilled,
+                prefix_digests=digests,
+                prefix_stats=prefix_stats,
                 name=self.name, pid=os.getpid())
 
     def _handle_submit(self, msg, respond):
@@ -337,7 +350,8 @@ class ServingWorker:
                 _tel.registry().counter("disagg/re_prefills").inc()
         fut = self.batcher.submit(
             prompt, msg.get("max_new_tokens"),
-            deadline_ms=msg.get("deadline_ms"), frames=frames)
+            deadline_ms=msg.get("deadline_ms"), frames=frames,
+            prefix_ids=msg.get("prefix_ids"))
         t = threading.Thread(target=self._stream_result,
                              args=(fut, respond),
                              name="mxtpu-worker-stream", daemon=True)
@@ -580,7 +594,8 @@ def spawn_worker(directory: str, name: Optional[str] = None,
                  heartbeat_s: float = 0.1,
                  extra_env: Optional[dict] = None,
                  python: Optional[str] = None,
-                 role: Optional[str] = None) -> WorkerHandle:
+                 role: Optional[str] = None,
+                 max_prefix: int = 0) -> WorkerHandle:
     """Spawn one serving worker process (``-m mxnet_tpu.serving.worker``)
     with stdout/stderr captured to ``<directory>/worker.log``. Readiness
     is ``handle.wait_ready()`` (the worker announces after warmup)."""
@@ -605,6 +620,8 @@ def spawn_worker(directory: str, name: Optional[str] = None,
         cmd += ["--batcher", batcher]
     if role:
         cmd += ["--role", role]
+    if max_prefix:
+        cmd += ["--max-prefix", str(max_prefix)]
     if not warmup:
         cmd += ["--no-warmup"]
     env = dict(os.environ)
@@ -656,6 +673,10 @@ def main(argv=None) -> int:
                     choices=["both", "prefill", "decode"],
                     help="disaggregated-fleet role (default MXTPU_ROLE "
                     "or 'both')")
+    ap.add_argument("--max-prefix", type=int, default=0,
+                    help="max forced-history tokens per request (> 0 "
+                    "sizes the suffix-replay menu and enables the "
+                    "prefix cache per MXTPU_PREFIX_CACHE)")
     ap.add_argument("--no-warmup", action="store_true")
     ap.add_argument("--heartbeat-s", type=float, default=0.5)
     ap.add_argument("--ckpt-dir", default=None,
@@ -686,7 +707,8 @@ def main(argv=None) -> int:
         slots=args.slots, max_new=args.max_new,
         batcher_kind=args.batcher, warmup=not args.no_warmup,
         heartbeat_s=args.heartbeat_s, ckpt_dir=args.ckpt_dir,
-        drain_s=args.drain_s, role=args.role)
+        drain_s=args.drain_s, role=args.role,
+        max_prefix=args.max_prefix)
 
     def _sigterm(signum, frame):
         worker.request_stop()

@@ -775,6 +775,99 @@ class TestRecompileHazard:
         assert not msgs, "\n".join(msgs)
 
 
+# ================================= prefix-caching pass extensions (ISSUE 13)
+class TestPrefixCachingPassScope:
+    """The prefix-caching surface (``serving/prefix.py``, the
+    ``prefill_suffix_paged`` replay dispatch, the ``_get_suffix_fn``
+    builder) sits inside every relevant pass's scope — coverage
+    assertions plus seeded positive/negative controls. The at-HEAD
+    cleanliness of the real modules rides the existing full-suite and
+    lock-order head tests."""
+
+    def test_new_surface_is_in_scope(self):
+        assert "mxnet_tpu/serving/prefix.py" in lock_order.MODULES
+        covered = {(os.path.basename(p), cls): set(funcs)
+                   for p, cls, funcs in no_sync.TARGETS}
+        assert "prefill_suffix_paged" in covered[("infer.py", "InferStep")]
+        assert "prefill_suffix_paged" in donation.DONATING_CALLS
+        assert "prefill_suffix_paged" in \
+            recompile.GUARDED_DISPATCHES[recompile.INFER_PY]
+        assert "_get_suffix_fn" in \
+            recompile.TRACED_BUILDERS[recompile.INFER_PY]
+
+    def test_unlocked_trie_across_health_reader_flagged(self, tmp_path):
+        """Positive: trie state shared between the scheduler and a
+        health-verb reader thread without the cache lock."""
+        _, _, shared = _analyze(tmp_path, """
+            import threading
+            class PrefixCache:
+                def __init__(self):
+                    self._roots = {}
+                    self._reader = threading.Thread(target=self._health)
+                def _health(self):
+                    self._roots = {}
+                def insert(self, key):
+                    return sorted(self._roots)
+            """)
+        assert any(attr == "_roots" for _, _, _, attr, _ in shared)
+
+    def test_locked_trie_clean(self, tmp_path):
+        """Negative: every trie touch under the cache lock (the real
+        ``PrefixCache`` shape) is clean."""
+        cycles, blocking, shared = _analyze(tmp_path, """
+            import threading
+            class PrefixCache:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._roots = {}
+                    self._reader = threading.Thread(target=self._health)
+                def _health(self):
+                    with self._lock:
+                        self._roots = {}
+                def insert(self, key):
+                    with self._lock:
+                        return sorted(self._roots)
+            """)
+        assert not cycles and not blocking and not shared
+
+    def test_sync_in_suffix_replay_flagged(self, tmp_path):
+        bad = tmp_path / "infer_suffix_bad.py"
+        bad.write_text(
+            "class InferStep:\n"
+            "    def prefill_suffix_paged(self, state, rows):\n"
+            "        buf, state = self._fn(state, rows)\n"
+            "        return buf.asnumpy(), state\n"
+        )
+        violations = no_sync.find_violations(
+            str(bad), "InferStep", ("prefill_suffix_paged",))
+        assert len(violations) == 1
+        assert "asnumpy" in violations[0][1]
+
+    def test_suffix_replay_lost_carry_flagged(self, tmp_path):
+        """Positive: dropping the donated state carry of the suffix
+        replay is a use-after-donate bug."""
+        index, name = _write_module(tmp_path, """
+            class Batcher:
+                def _replay(self, rows):
+                    buf = self._engine.prefill_suffix_paged(
+                        self._state, rows)
+                    return buf
+            """)
+        out = donation.check_use_after_donate(index.module(name))
+        assert any("lost" in key for _, key, _ in out)
+
+    def test_unaccounted_suffix_dispatch_flagged(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            class InferStep:
+                def prefill_suffix_paged(self, state, rows):
+                    fn = self._get_suffix_fn(8)
+                    return fn(self._values, state, rows)
+            """)
+        out = recompile.check_guard_accounting(
+            index.module(name), ("prefill_suffix_paged",))
+        assert any("unaccounted" in key for _, key, _ in out)
+
+
 # ===================================== collective-placement self-tests
 class TestCollectivePlacement:
     def test_decode_programs_dispatch_no_collectives(self, ctx):

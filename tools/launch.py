@@ -194,32 +194,57 @@ def scale_cooldown_s(default: float = 30.0) -> float:
         return default
 
 
+def scale_wait_ms(default: float = 0.0) -> float:
+    """``MXTPU_SCALE_WAIT_MS``: rolling queue-wait p50 (ms) above which
+    a pool counts as hot regardless of occupancy — the PREFILL pool's
+    primary pressure signal (prefill workers run one admission prefill
+    per request, so occupancy says little; the queue wait the decode
+    handoffs see says everything). 0 disables the wait gate."""
+    v = os.environ.get("MXTPU_SCALE_WAIT_MS", "").strip()
+    try:
+        return max(float(v), 0.0) if v else default
+    except ValueError:
+        return default
+
+
 class FleetScaler:
-    """Serving-fleet elasticity supervisor: grow decode workers on
-    sustained occupancy/shed pressure, drain and retire them when idle.
+    """Serving-fleet elasticity supervisor: grow a worker pool on
+    sustained pressure, drain and retire workers when idle. One scaler
+    supervises ONE role pool (``role="decode"`` default); a
+    disaggregated fleet runs a second instance with ``role="prefill"``
+    over its prefill workers — same loop, different pressure signal.
 
     The scaler is deliberately decoupled from the serving package — it
     drives three callables, so the same loop supervises an in-process
     router fleet, a ``spawn_worker`` process fleet, or a test fake:
 
     ``pressure()``
-        -> dict with ``size`` (current decode workers), ``occupancy``
-        (mean decode-batch occupancy, 0..1) and ``shed`` (CUMULATIVE
-        router shed count; the scaler differences it).
+        -> dict with ``size`` (current workers in this pool),
+        ``occupancy`` (mean decode-batch occupancy, 0..1), ``shed``
+        (CUMULATIVE router shed count; the scaler differences it) and
+        optionally ``queue_wait_ms`` (the pool's rolling queue-wait
+        p50 — for a prefill pool, the mean of the prefill replicas'
+        worker-reported p50s; occupancy is meaningless for workers
+        that run one admission prefill per request).
     ``spawn()``
-        start one decode worker and register it (e.g. ``spawn_worker``
-        + ``RemoteReplica.spawning`` + ``Router.add_replica``).
+        start one worker of this role and register it (e.g.
+        ``spawn_worker(role=...)`` + ``RemoteReplica.spawning`` +
+        ``Router.add_replica``).
     ``retire()``
-        pick one idle decode worker, ``Router.retire_replica`` it and
-        SIGTERM the process (the existing graceful drain) — return
-        False when nothing is retirable (the scaler just waits).
+        pick one idle worker of this role, ``Router.retire_replica``
+        it and SIGTERM the process (the existing graceful drain) —
+        return False when nothing is retirable (the scaler just waits).
 
-    Policy: ``sustain`` consecutive samples of occupancy >= ``high`` (or
-    ANY shed growth) scale UP; ``sustain`` samples of occupancy <=
-    ``low`` with no sheds scale DOWN; every action is separated by
-    ``cooldown_s`` (``MXTPU_SCALE_COOLDOWN_S``) and clamped to
-    [``MXTPU_SCALE_MIN``, ``MXTPU_SCALE_MAX``]. Actions are counted as
-    ``serve/scale_up``/``serve/scale_down``.
+    Policy: ``sustain`` consecutive samples of occupancy >= ``high``,
+    queue-wait p50 >= ``wait_high_ms`` (``MXTPU_SCALE_WAIT_MS``; 0
+    disables) or ANY shed growth scale UP; ``sustain`` samples of
+    occupancy <= ``low`` with no sheds and the wait below the gate
+    scale DOWN; every action is separated by ``cooldown_s``
+    (``MXTPU_SCALE_COOLDOWN_S``) and clamped to [``MXTPU_SCALE_MIN``,
+    ``MXTPU_SCALE_MAX``]. Actions are counted per role:
+    ``serve/scale_up``/``serve/scale_down`` for the decode pool,
+    ``serve/scale_up_prefill``/``serve/scale_down_prefill`` for a
+    prefill pool (the ``serve.scale`` instant carries ``role`` too).
 
     Thread shape: decisions run under the scaler lock
     (``_decide_locked``); the spawn/retire callables — which may block
@@ -234,16 +259,20 @@ class FleetScaler:
                  cooldown_s: float | None = None,
                  interval_s: float = 1.0, high: float = 0.85,
                  low: float = 0.15, sustain: int = 3,
-                 start: bool = False):
+                 start: bool = False, role: str = "decode",
+                 wait_high_ms: float | None = None):
         self._pressure = pressure
         self._spawn = spawn
         self._retire = retire
+        self.role = str(role)
         self.min_workers = min_workers if min_workers is not None \
             else scale_min()
         self.max_workers = max_workers if max_workers is not None \
             else scale_max()
         self.cooldown_s = cooldown_s if cooldown_s is not None \
             else scale_cooldown_s()
+        self.wait_high_ms = wait_high_ms if wait_high_ms is not None \
+            else scale_wait_ms()
         self.interval_s = float(interval_s)
         self.high = float(high)
         self.low = float(low)
@@ -294,8 +323,11 @@ class FleetScaler:
             if self._last_shed is not None:
                 shed_delta = max(int(shed) - self._last_shed, 0)
             self._last_shed = int(shed)
-        hot = occ >= self.high or shed_delta > 0
-        cold = occ <= self.low and shed_delta == 0
+        wait = sample.get("queue_wait_ms")
+        wait_hot = bool(self.wait_high_ms) and wait is not None \
+            and float(wait) >= self.wait_high_ms
+        hot = occ >= self.high or shed_delta > 0 or wait_hot
+        cold = occ <= self.low and shed_delta == 0 and not wait_hot
         self._hot = self._hot + 1 if hot else 0
         self._cold = self._cold + 1 if cold else 0
         if now - self._last_action_at < self.cooldown_s:
@@ -335,17 +367,22 @@ class FleetScaler:
             self._count("serve/scale_down", sample)
         return action
 
-    @staticmethod
-    def _count(counter: str, sample: dict):
+    def _count(self, counter: str, sample: dict):
         """Scaling accounting (best-effort — the launcher must run even
-        where the package is not importable)."""
+        where the package is not importable). Non-decode pools count
+        under a role-suffixed name so the prefill pool's elasticity is
+        visible separately from the decode pool's."""
+        if self.role != "decode":
+            counter = f"{counter}_{self.role}"
         try:
             from mxnet_tpu import telemetry as _tel
 
             _tel.registry().counter(counter).inc()
             _tel.instant("serve.scale", {
                 "counter": counter,
+                "role": self.role,
                 "occupancy": sample.get("occupancy"),
+                "queue_wait_ms": sample.get("queue_wait_ms"),
                 "size": sample.get("size")})
         except Exception:  # noqa: BLE001
             pass

@@ -351,6 +351,41 @@ def _print_disagg_family(report_path):
               "the fleet is paying prefill twice")
 
 
+def _print_prefix_section(report_path):
+    """Surface the prefix-caching slice of the ``infer/``/``serve/``
+    families (radix-trie hit rate, tokens served from cached KV, pages
+    shared across requests, copy-on-write copies, affinity placements)
+    from a ``report.json`` snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    names = ("infer/prefix_tokens_saved", "infer/prefix_cow_copies",
+             "serve/prefix_affinity")
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k in names}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k in ("infer/prefix_hit_rate", "infer/pages_shared")}
+    if not counters and not gauges:
+        return
+    print("\n== Prefix caching ==")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    hit_rate = gauges.get("infer/prefix_hit_rate")
+    saved = counters.get("infer/prefix_tokens_saved", 0)
+    if saved:
+        print(f"  prefill tokens served from cached KV: {saved}")
+    if hit_rate is not None and hit_rate == 0.0 and saved == 0:
+        print("  WARNING: the prefix cache is enabled but never hits — "
+              "prompts may be unique per request (disable with "
+              "MXTPU_PREFIX_CACHE=0 to reclaim pool pages)")
+
+
 def _print_shard_family(report_path):
     """Surface the ``shard/`` metric family (SPMD sharding spine: mesh
     shape, global vs per-shard parameter bytes, collective-traffic
@@ -426,6 +461,7 @@ def main(argv=None):
         _print_host_families(os.path.join(directory, "report.json"))
         _print_compile_family(os.path.join(directory, "report.json"))
         _print_infer_family(os.path.join(directory, "report.json"))
+        _print_prefix_section(os.path.join(directory, "report.json"))
         _print_shard_family(os.path.join(directory, "report.json"))
         _print_serve_family(os.path.join(directory, "report.json"))
         _print_transport_family(os.path.join(directory, "report.json"))
