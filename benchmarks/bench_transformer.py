@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
@@ -1165,6 +1166,186 @@ def disagg_main(args):
     return 0 if ok else 1
 
 
+# ------------------------------------------------- speculative decoding mode
+def speculative_main(args):
+    """Flash/paged kernel x speculative decoding ablation (the ISSUE-14
+    acceptance run, CPU-sized).
+
+    One seeded prompt batch decodes through five configurations of the
+    SAME weights:
+
+    1. ``dense`` — the PR-8 engine (``decode_n``: dense KV slab, one
+       fused while_loop program). The baseline every row gates against.
+    2. ``paged`` — the paged-KV sequential path (``decode_spec_n`` with
+       ``k=0``: one ``decode_iter`` round per token), kernels off.
+    3. ``paged+spec`` — speculative decoding (draft proposes
+       ``--spec-k`` tokens/round, ONE wide target dispatch verifies),
+       kernels off. THE GATE ROW: >= 2x the dense baseline.
+    4/5. the same two with ``MXTPU_FLASH_PAGED=force`` — the Pallas
+       paged kernels in interpret mode (CPU correctness rows; on-TPU
+       they are the perf path, here they are slower than dense math).
+
+    The draft is an ORACLE built from the target itself: the target's
+    tail ``--spec-layers - 1`` layers have their sublayer output
+    projections zeroed (pre-LN residual blocks collapse to identity), so
+    a 1-layer draft holding the surviving layer's weights computes the
+    IDENTICAL function at 1/L the depth — full acceptance, maximal
+    speedup, and the bit-identity gate still checks the real rejection
+    machinery (acceptance only decides how many tokens land per round,
+    never which). Gates: every row's transcript equals the dense
+    baseline exactly; the spec row >= 2x dense tokens/sec; zero steady-
+    state recompiles in every engine."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerModel
+    from mxnet_tpu.parallel import InferStep
+    from .common import infer_fields
+
+    V, B = args.vocab, args.batch_size
+    L, K = args.spec_layers, args.spec_k
+    # the spec ablation needs enough math per dispatch to measure: at the
+    # shared CPU defaults (units=32, T=32) per-round host overhead
+    # dominates every row equally and the comparison is noise, so this
+    # mode floors both knobs at the smallest config where the dense
+    # baseline is compute-bound
+    units = max(args.units, 128)
+    T = max(args.decode_tokens, 64)
+    rng = np.random.RandomState(args.seed)
+
+    def make_net(layers, seed):
+        mx.random.seed(seed)
+        net = TransformerModel(
+            src_vocab=V, tgt_vocab=V, units=units,
+            hidden_size=units * 2, num_layers=layers, num_heads=2,
+            max_length=args.max_len + T + K + 16, dropout=0.0)
+        net.initialize(mx.initializer.Xavier())
+        net._probe_shapes(nd.zeros((2, 8), dtype="int32"),
+                          nd.zeros((2, 8), dtype="int32"))
+        return net
+
+    target = make_net(L, args.seed)
+    # collapse the tail layers to identity (pre-LN residual blocks: a
+    # zeroed sublayer output projection contributes exactly 0)
+    zero_suffixes = (
+        "multiheadattention0_out_weight", "multiheadattention0_out_bias",
+        "multiheadattention1_out_weight", "multiheadattention1_out_bias",
+        "_ffn0_dense1_weight", "_ffn0_dense1_bias")
+    for pname, p in target.collect_params().items():
+        for li in range(1, L):
+            for tag in (f"encoderlayer{li}_", f"decoderlayer{li}_"):
+                if tag in pname and any(pname.endswith(z)
+                                        for z in zero_suffixes):
+                    p.set_data(nd.NDArray(np.zeros_like(
+                        np.asarray(p._data.data))))
+    draft = make_net(1, args.seed + 1)
+    # draft layer-0/embedding/final-norm names are a subset of the
+    # target's (indices match); copy by instance-prefix-stripped name
+    tparams = {n.split("_", 1)[1]: p
+               for n, p in target.collect_params().items()}
+    for pname, p in draft.collect_params().items():
+        p.set_data(nd.NDArray(tparams[pname.split("_", 1)[1]]._data.data))
+
+    bucket = args.max_len
+    lens = rng.randint(args.min_len, args.max_len + 1, size=B)
+    src_np = np.zeros((B, bucket), "int32")
+    for i, n in enumerate(lens):
+        src_np[i, :n] = rng.randint(3, V, size=n)
+    vl_np = lens.astype("int32")
+    max_len = bucket + T + K + 8
+    page_size = args.page_size or 16
+
+    def timed(run_fn, eng, reps):
+        out = run_fn()  # warm: compiles + caches every program
+        eng.compile_guard.mark_steady()
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = run_fn()
+        toks, lengths = out
+        toks = toks.asnumpy()
+        elapsed = (time.perf_counter() - t0) / reps
+        return toks, lengths.asnumpy(), B * T / elapsed
+
+    spec_on = args.speculative or not args.flash_paged
+    results = []
+    prior = os.environ.get("MXTPU_FLASH_PAGED")
+    try:
+        for kernel in (False, True):
+            os.environ["MXTPU_FLASH_PAGED"] = "force" if kernel else "0"
+            reps = 1 if kernel else 3  # interpret rows: correctness pace
+            if not kernel:
+                eng = InferStep(target, max_len=max_len)
+                toks_d, lens_d, dense_tps = timed(
+                    lambda: eng.decode_n(src_np, vl_np, max_new_tokens=T),
+                    eng, reps)
+                results.append(("dense", False, False, dense_tps,
+                                toks_d, lens_d, eng))
+            peng = InferStep(target, max_len=max_len)
+            peng.attach_draft(draft)
+            toks_p, lens_p, paged_tps = timed(
+                lambda: peng.decode_spec_n(
+                    src_np, vl_np, max_new_tokens=T, k=0,
+                    page_size=page_size), peng, reps)
+            results.append(("paged", kernel, False, paged_tps,
+                            toks_p, lens_p, peng))
+            if spec_on:
+                seng = InferStep(target, max_len=max_len)
+                seng.attach_draft(draft)
+                toks_s, lens_s, spec_tps = timed(
+                    lambda: seng.decode_spec_n(
+                        src_np, vl_np, max_new_tokens=T, k=K, wide=True,
+                        page_size=page_size), seng, reps)
+                results.append(("paged+spec", kernel, True, spec_tps,
+                                toks_s, lens_s, seng))
+    finally:
+        if prior is None:
+            os.environ.pop("MXTPU_FLASH_PAGED", None)
+        else:
+            os.environ["MXTPU_FLASH_PAGED"] = prior
+
+    base = next(r for r in results if r[0] == "dense")
+    base_tps, base_toks, base_lens = base[3], base[4], base[5]
+    all_equal = True
+    recompiles = 0
+    for name, kernel, spec, tps, toks, lengths, eng in results:
+        equal = bool(np.array_equal(toks, base_toks)
+                     and np.array_equal(lengths, base_lens))
+        all_equal = all_equal and equal
+        recompiles += eng.compile_guard.steady_state_recompiles
+        row = {
+            "metric": "transformer_spec_decode_tokens_per_sec",
+            "value": round(tps, 1),
+            "unit": "tokens/sec",
+            "config": name + ("+kernel" if kernel else ""),
+            "flash_paged_kernel": kernel,
+            "speculative": spec,
+            "spec_k": K if spec else 0,
+            "speedup_vs_dense": round(tps / base_tps, 2),
+            "greedy_tokens_match_dense": equal,
+            "steady_state_recompiles":
+                eng.compile_guard.steady_state_recompiles,
+            "batch": B, "prompt_bucket": bucket, "decode_tokens": T,
+            "target_layers": L, "draft_layers": 1, "units": units,
+        }
+        row.update({k: v for k, v in infer_fields().items()
+                    if k not in row})
+        print(json.dumps(row))
+    gate = next((r for r in results
+                 if r[0] == "paged+spec" and not r[1]), None)
+    for name, kernel, spec, tps, _t, _l, _e in results:
+        tag = name + ("+kernel" if kernel else "")
+        print(f"  {tag:<18} {tps:>9.1f} tok/s "
+              f"({tps / base_tps:.2f}x dense)")
+    ok = all_equal and recompiles == 0
+    if spec_on:
+        ok = ok and gate is not None and gate[3] >= 2 * base_tps
+    if not ok:
+        print("FAIL: speculative decoding must be >= 2x the dense "
+              "engine at bit-identical greedy output with zero steady-"
+              "state recompiles (and every kernel row must match too)",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
 # ------------------------------------------------------- amp/auto-batch mode
 def amp_auto_batch_main(args):
     """HBM-aware compute ablation: fp32 no-remat vs amp(+remat), each at
@@ -1287,6 +1468,22 @@ def main(argv=None):
                     help="KV-cached vs naive re-forward decode ablation")
     ap.add_argument("--decode-tokens", type=int, default=32,
                     help="tokens generated per row in --decode mode")
+    ap.add_argument("--speculative", action="store_true",
+                    help="with --decode: speculative-decoding ablation — "
+                         "dense baseline vs paged sequential vs draft+"
+                         "wide-verify, each with the Pallas paged flash "
+                         "kernels off and forced (gate: spec >= 2x dense "
+                         "at bit-identical greedy output)")
+    ap.add_argument("--flash-paged", action="store_true",
+                    help="with --decode: the kernel-only ablation rows "
+                         "(dense vs paged, kernels off vs forced) "
+                         "without the speculative rows")
+    ap.add_argument("--spec-k", type=int, default=7,
+                    help="draft tokens proposed per speculative round")
+    ap.add_argument("--spec-layers", type=int, default=8,
+                    help="target depth for --speculative (tail layers "
+                         "are zeroed to identity so the 1-layer oracle "
+                         "draft matches the target exactly)")
     ap.add_argument("--open-loop", type=float, nargs="?", const=500.0,
                     default=None, metavar="RATE",
                     help="with --decode: Poisson open-loop load at RATE "
@@ -1348,6 +1545,8 @@ def main(argv=None):
         return serve_chaos_main(args)
     if args.open_loop is not None:
         return open_loop_main(args)
+    if args.speculative or args.flash_paged:
+        return speculative_main(args)
     if args.decode:
         return decode_main(args)
     if args.auto_batch:

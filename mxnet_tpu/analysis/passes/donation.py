@@ -49,7 +49,8 @@ FORBIDDEN = {"batch", "label", "frozen_vals", "src", "vl", "values",
 
 # serving-side donating calls: callee attr -> donated positional index
 DONATING_CALLS = {"decode_iter": 0, "prefill_paged": 0,
-                  "prefill_suffix_paged": 0}
+                  "prefill_suffix_paged": 0, "spec_draft": 0,
+                  "spec_verify": 0}
 
 
 def _literal_tuple(node) -> Optional[Tuple[int, ...]]:

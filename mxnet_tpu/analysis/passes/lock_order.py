@@ -73,7 +73,8 @@ BLOCKING_ATTRS = {"result", "join", "block_until_ready", "asnumpy",
                   "item", "tolist", "acquire"}
 # engine dispatches: firing (or compiling) a device program while
 # holding a host lock couples every contending thread to device latency
-DISPATCH_ATTRS = {"decode_n", "decode_iter", "prefill_paged", "warmup"}
+DISPATCH_ATTRS = {"decode_n", "decode_iter", "prefill_paged", "warmup",
+                  "spec_draft", "spec_verify"}
 QUALIFIED_BLOCKING = {"time.sleep", "jax.block_until_ready"}
 
 PUBLIC_DUNDERS = {"__call__", "__enter__", "__exit__", "__iter__",

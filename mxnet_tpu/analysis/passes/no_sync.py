@@ -37,7 +37,8 @@ TARGETS = (
     (STEP_PY, "TrainStep", FAST_PATH_FUNCS),
     (INFER_PY, "InferStep", ("__call__", "_dispatch", "decode_n",
                              "decode_iter", "prefill_paged",
-                             "prefill_suffix_paged")),
+                             "prefill_suffix_paged", "spec_draft",
+                             "spec_verify")),
     (BATCHER_PY, "DynamicBatcher", ("_dispatch",)),
     (BATCHER_PY, "ContinuousBatcher", ("_dispatch", "_step_once")),
 )

@@ -42,13 +42,14 @@ TRACED_BUILDERS = {
     STEP_PY: ("_build",),
     INFER_PY: ("_build_forward", "_get_prefill_fn", "_get_decode_fn",
                "_get_paged_prefill_fn", "_get_decode_iter_fn",
-               "_get_suffix_fn"),
+               "_get_suffix_fn", "_get_spec_draft_fn",
+               "_get_spec_verify_fn"),
 }
 
 # dispatch methods that must account their signatures with the guard
 GUARDED_DISPATCHES = {
     INFER_PY: ("_dispatch", "decode_n", "prefill_paged", "decode_iter",
-               "prefill_suffix_paged"),
+               "prefill_suffix_paged", "spec_draft", "spec_verify"),
     STEP_PY: ("_dispatch",),
 }
 

@@ -135,6 +135,23 @@ class TransformerDecoderLayer(HybridBlock):
         y = x + self.ffn(self.ln3(x))
         return y, k_pool, v_pool
 
+    def step_window_paged(self, x, k_pool, v_pool, page_table, pos, active,
+                          cross_kv, mem_valid_length=None, window_vl=None):
+        """``step_paged`` widened to an S-token window: ``x`` (B, S,
+        units) sits at per-row absolute positions ``pos[b] + i``, all S
+        tokens scatter and attend in ONE pass (speculative verification
+        and wide suffix replay). ``window_vl`` marks per-row padding
+        tails inside the window."""
+        a, k_pool, v_pool = self.self_attn.paged_window_step(
+            self.ln1(x), k_pool, v_pool, page_table, pos, active,
+            window_vl=window_vl)
+        x = x + self.drop(a)
+        c = self.cross_attn.attend(self.ln2(x), cross_kv[0], cross_kv[1],
+                                   valid_length=mem_valid_length)
+        x = x + self.drop(c)
+        y = x + self.ffn(self.ln3(x))
+        return y, k_pool, v_pool
+
 
 class TransformerEncoder(HybridBlock):
     def __init__(self, num_layers, units, hidden_size, num_heads, dropout,
@@ -423,7 +440,7 @@ class TransformerModel(HybridBlock):
         return logits, new_state
 
     def prefill_suffix_paged(self, tokens, token_vl, q_offset, state,
-                             page_tables, slot_ids, active):
+                             page_tables, slot_ids, active, wide=False):
         """Prefix-cache suffix prefill: decode-side forward over ONLY the
         uncached tail of each admitted row's target prefix, at absolute
         positions ``q_offset[r] + j``.
@@ -447,9 +464,14 @@ class TransformerModel(HybridBlock):
         token-at-a-time stream in the last float bits. Per-step bodies
         are shape-identical no matter where the cached/uncached split
         falls, which is what makes a cache-hit replay bit-identical to
-        the cold path (asserted in tests/test_prefix.py). Returns
-        ``(last_logits, new_state)`` with row ``r``'s logits taken at
-        suffix position ``token_vl[r] - 1`` — the first new token's."""
+        the cold path (asserted in tests/test_prefix.py). ``wide=True``
+        opts out of that contract for speed: the whole suffix runs as
+        ONE ``decode_window_paged`` pass — the q_offset-aware shape the
+        Pallas paged window kernel accelerates — computing the same
+        masked-softmax math with wide-shape rounding (equal argmax in
+        practice, not bit-exact). Returns ``(last_logits, new_state)``
+        with row ``r``'s logits taken at suffix position
+        ``token_vl[r] - 1`` — the first new token's."""
         import jax
 
         tok = tokens.data if isinstance(tokens, NDArray) else \
@@ -466,6 +488,18 @@ class TransformerModel(HybridBlock):
                "cross_k": tuple(c[slot_ids] for c in state["cross_k"]),
                "cross_v": tuple(c[slot_ids] for c in state["cross_v"]),
                "mem_vl": jnp.maximum(state["mem_vl"][slot_ids], 1)}
+
+        if wide:
+            logits, sub = self.decode_window_paged(
+                NDArray(tok), q_offset, sub, page_tables, active,
+                window_vl=token_vl)
+            lg = logits.data if isinstance(logits, NDArray) else logits
+            idx = jnp.clip(token_vl - 1, 0, S - 1).astype(jnp.int32)
+            last = jnp.take_along_axis(lg, idx[:, None, None], axis=1)[:, 0]
+            new_state = dict(state)
+            new_state["k_pools"] = sub["k_pools"]
+            new_state["v_pools"] = sub["v_pools"]
+            return last, new_state
 
         def one(j, sub):
             tok_j = jax.lax.dynamic_index_in_dim(tok, j, axis=1,
@@ -488,6 +522,45 @@ class TransformerModel(HybridBlock):
         new_state["k_pools"] = sub["k_pools"]
         new_state["v_pools"] = sub["v_pools"]
         return last, new_state
+
+    def decode_window_paged(self, tokens, pos, state, page_tables, active,
+                            window_vl=None):
+        """An S-token window through the paged cache in ONE forward:
+        ``tokens`` (slots, S) int32 at per-row absolute positions
+        ``pos[r] + j``. This is the speculative-verification shape — one
+        dispatch scores a drafted window against the target model — and
+        the wide (non-bit-exact) suffix-replay shape. ``window_vl``
+        (slots,) marks tokens ``>= window_vl[r]`` as padding (their K/V
+        land in trash, their logits are garbage). Returns ``(logits
+        (slots, S, vocab), new_state)``; column ``j`` predicts position
+        ``pos[r] + j + 1``'s token, matching ``decode_step_paged`` run
+        sequentially up to attention-order float rounding."""
+        from ... import ndarray as F
+
+        tok = tokens.data if isinstance(tokens, NDArray) else \
+            jnp.asarray(tokens)
+        tok = tok.astype(jnp.int32)
+        B, S = tok.shape
+        pos = jnp.asarray(pos, jnp.int32)
+        pos_ids = pos[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+        x = self.drop(self.tgt_embed(NDArray(tok)) * (self._units ** 0.5)
+                      + self.pos_embed(NDArray(pos_ids)))
+        mem_vl_nd = NDArray(state["mem_vl"])
+        k_pools, v_pools = [], []
+        for i in range(self.decoder._n):
+            layer = getattr(self.decoder, f"layer{i}")
+            x, kp, vp = layer.step_window_paged(
+                x, state["k_pools"][i], state["v_pools"][i], page_tables,
+                pos, active, (state["cross_k"][i], state["cross_v"][i]),
+                mem_valid_length=mem_vl_nd, window_vl=window_vl)
+            k_pools.append(kp)
+            v_pools.append(vp)
+        out = self.decoder.ln(x)
+        logits = self._logits(F, out)
+        new_state = dict(state)
+        new_state["k_pools"] = tuple(k_pools)
+        new_state["v_pools"] = tuple(v_pools)
+        return logits, new_state
 
     def decode_step_paged(self, tokens, pos, state, page_tables, active):
         """One O(1) paged decode step over the SLOT batch: ``tokens``

@@ -352,12 +352,15 @@ class MultiHeadAttention(HybridBlock):
         that finished (or hold no request) write to the trash page 0, so
         their garbage never lands in another request's pages.
 
-        The new token's K/V scatter to ``(page, pos % page_size)`` and the
-        query attends causally over the GATHERED ``(B, P*page_size, H, D)``
-        view with ``q_offset=pos`` — identical masked-softmax math to the
-        dense ``step`` path, so at equal logical capacity the two are
-        bit-identical (asserted in tests/test_paged.py).
-        Returns ``(out, k_pool, v_pool)`` with the updated pools."""
+        The new token's K/V scatter to ``(page, pos % page_size)``; then
+        attention routes by ``paged_flash_attention.flash_paged_enabled()``:
+        the Pallas decode kernel walks the page table inside its grid and
+        reads the pools IN PLACE (no gather), while the fallback gathers
+        the ``(B, P*page_size, H, D)`` view and runs the dense path —
+        identical masked-softmax math to the dense ``step`` path, so at
+        equal logical capacity the two are bit-identical (asserted in
+        tests/test_paged.py). Either way inactive rows only ever touch
+        trash page 0. Returns ``(out, k_pool, v_pool)``."""
         from ... import ndarray as F
         from ...ndarray.ndarray import NDArray
 
@@ -381,11 +384,84 @@ class MultiHeadAttention(HybridBlock):
         off = jnp.where(active, pos % page_size, 0)
         k_pool = k_pool.at[page, off].set(k_t)
         v_pool = v_pool.at[page, off].set(v_t)
-        # gather the logical (B, P*page_size, H, D) view through the table
-        P = page_table.shape[1]
-        k = k_pool[page_table].reshape(B, P * page_size, self._num_heads, d)
-        v = v_pool[page_table].reshape(B, P * page_size, self._num_heads, d)
-        out = F.flash_attention(
-            q, NDArray(k), NDArray(v), None, causal=self._causal,
-            sm_scale=self._sm_scale(), layout="BSHD", q_offset=pos)
+        from ...ops.pallas import paged_flash_attention as _pfa
+
+        if self._causal and _pfa.flash_paged_enabled():
+            # Pallas decode kernel: the page table rides the grid as a
+            # scalar-prefetch operand and each step reads one pool page
+            # in place — the gather below never materializes
+            out = NDArray(_pfa.paged_decode_attention(
+                q.data[:, 0], k_pool, v_pool, page_table, pos,
+                sm_scale=self._sm_scale())[:, None])
+        else:
+            # dense fallback: gather the logical (B, P*page_size, H, D)
+            # view through the table (bitwise the pre-kernel path)
+            P = page_table.shape[1]
+            k = k_pool[page_table].reshape(B, P * page_size,
+                                           self._num_heads, d)
+            v = v_pool[page_table].reshape(B, P * page_size,
+                                           self._num_heads, d)
+            out = F.flash_attention(
+                q, NDArray(k), NDArray(v), None, causal=self._causal,
+                sm_scale=self._sm_scale(), layout="BSHD", q_offset=pos)
+        return self._finish(F, out), k_pool, v_pool
+
+    def paged_window_step(self, query, k_pool, v_pool, page_table, pos,
+                          active, window_vl=None):
+        """An S-token incremental window through the paged cache in ONE
+        pass — the q_offset-aware prefill shape that suffix-only prefix
+        replay and speculative verification both dispatch.
+
+        ``query`` (B, S, units): token ``i`` of row ``b`` sits at
+        absolute position ``pos[b] + i``. The window's K/V scatter
+        through the page table first (inactive rows to trash page 0),
+        then every query attends causally over the row's full paged
+        history INCLUDING the window's earlier tokens. ``window_vl``
+        (B,) marks tokens ``>= window_vl[b]`` as padding: their K/V go
+        to the trash page and their outputs are zeroed under the kernel
+        path (garbage-but-ignored under the dense fallback — callers
+        only read rows ``< window_vl``). Routing matches ``paged_step``:
+        Pallas window kernel when ``flash_paged_enabled()``, dense
+        gather otherwise. Returns ``(out, k_pool, v_pool)``."""
+        from ... import ndarray as F
+        from ...ndarray.ndarray import NDArray
+        from ...ops.pallas import paged_flash_attention as _pfa
+
+        if not self._self_attention:
+            raise MXNetError("paged_window_step() updates a self-attention "
+                             "cache; cross-attention uses attend()")
+        qkv = self.qkv_proj(query)
+        B, S = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(B, S, self._num_heads, 3 * self._head_dim)
+        d = self._head_dim
+        q = qkv[:, :, :, 0 * d:1 * d]
+        k_t = qkv[:, :, :, 1 * d:2 * d].data  # (B, S, H, D)
+        v_t = qkv[:, :, :, 2 * d:3 * d].data
+        pos = jnp.asarray(pos, jnp.int32)
+        page_size = k_pool.shape[1]
+        steps = jnp.arange(S, dtype=jnp.int32)[None, :]
+        abs_pos = pos[:, None] + steps                    # (B, S)
+        live = active[:, None]
+        if window_vl is not None:
+            live = jnp.logical_and(
+                live, steps < jnp.asarray(window_vl, jnp.int32)[:, None])
+        rows = jnp.arange(B)[:, None]
+        slot = jnp.where(live, abs_pos // page_size, 0)
+        page = jnp.where(live, page_table[rows, slot], 0)
+        off = jnp.where(live, abs_pos % page_size, 0)
+        k_pool = k_pool.at[page, off].set(k_t)
+        v_pool = v_pool.at[page, off].set(v_t)
+        if self._causal and _pfa.flash_paged_enabled():
+            out = NDArray(_pfa.paged_window_attention(
+                q.data, k_pool, v_pool, page_table, pos, window_vl,
+                sm_scale=self._sm_scale()))
+        else:
+            P = page_table.shape[1]
+            k = k_pool[page_table].reshape(B, P * page_size,
+                                           self._num_heads, d)
+            v = v_pool[page_table].reshape(B, P * page_size,
+                                           self._num_heads, d)
+            out = F.flash_attention(
+                q, NDArray(k), NDArray(v), None, causal=self._causal,
+                sm_scale=self._sm_scale(), layout="BSHD", q_offset=pos)
         return self._finish(F, out), k_pool, v_pool

@@ -179,6 +179,10 @@ class InferStep:
         else:
             self._data_sharding = None
 
+        # speculative decoding: attach_draft() fills these — the draft
+        # engine plus the (target, draft, version) coherent-pair snapshot
+        self.draft: Optional["InferStep"] = None
+        self._live_pair = None
         self._fwd_tree = [None]  # output treedef captured at trace time
         self._fwd_fn = self._build_forward()
         # predict mode draws no randomness: one fixed key serves every
@@ -481,19 +485,19 @@ class InferStep:
         self._paged_fns[cfg] = fn
         return fn
 
-    def _get_suffix_fn(self, method, top_k):
-        cfg = ("paged_suffix", method, top_k)
+    def _get_suffix_fn(self, method, top_k, wide=False):
+        cfg = ("paged_suffix", method, top_k, bool(wide))
         fn = self._paged_fns.get(cfg)
         if fn is not None:
             return fn
-        net = self._net
+        net, wide = self._net, bool(wide)
 
         def prefill(values, state, tokens, token_vl, q_offset,
                     page_tables, slot_ids, active, key, temperature):
             with self._net_scope(values, key):
                 logits, new_state = net.prefill_suffix_paged(
                     NDArray(tokens), token_vl, q_offset, state,
-                    page_tables, slot_ids, active)
+                    page_tables, slot_ids, active, wide=wide)
             logits = logits.data if isinstance(logits, NDArray) else logits
             key, sub = jax.random.split(key)
             tok0 = _sample_tokens(logits.astype(jnp.float32), sub, method,
@@ -581,14 +585,16 @@ class InferStep:
     def prefill_suffix_paged(self, state, tokens, token_vl, q_offset,
                              page_tables, slot_ids, active,
                              method="greedy", top_k=0, temperature=1.0,
-                             seed=0):
+                             seed=0, wide=False):
         """Prefix-cache admission dispatch: run the decode-side forward
         over ONLY each row's uncached suffix (absolute positions
         ``q_offset[r] + j``) and sample its first new token. The encoder
         never runs — cross memory comes from the adopted cache root (or
-        a prior prefill). Same staging/guard/donation contract as
-        ``prefill_paged``; sync-free by lint. Returns ``(tok0 (B,)
-        NDArray, new_state)``."""
+        a prior prefill). ``wide`` routes the replay through the ONE-pass
+        q_offset-aware window program (paged flash kernel when enabled)
+        instead of the bit-exact sequential stream. Same staging/guard/
+        donation contract as ``prefill_paged``; sync-free by lint.
+        Returns ``(tok0 (B,) NDArray, new_state)``."""
         tokens = jnp.asarray(tokens, jnp.int32)
         token_vl = jnp.asarray(token_vl, jnp.int32)
         q_offset = jnp.asarray(q_offset, jnp.int32)
@@ -596,7 +602,8 @@ class InferStep:
         slot_ids = jnp.asarray(slot_ids, jnp.int32)
         active = jnp.asarray(active, jnp.bool_)
         method, top_k, seed, _ = self._paged_cfg(method, top_k, seed)
-        cfg = (method, top_k)
+        wide = True if wide else False
+        cfg = (method, top_k, wide)
         sig = ("paged_suffix", cfg, (tokens.shape, tokens.dtype.name),
                page_tables.shape, state["k_pools"][0].shape,
                state["cross_k"][0].shape)
@@ -639,6 +646,283 @@ class InferStep:
                             active, jax.random.PRNGKey(seed),
                             jnp.float32(temperature))
         return NDArray(buf), new_state
+
+    # ---------------------------------------------------- speculative decode
+    # Speculative decoding (ISSUE 14): a small DRAFT engine proposes k
+    # greedy tokens per slot (one decode_iter dispatch of its own), then
+    # ONE target dispatch scores all k+1 positions and accepts the longest
+    # agreeing prefix in-graph. The acceptance rule — draft token j
+    # accepted iff it equals the target argmax at position j-1 — makes the
+    # emitted stream EXACTLY the target's greedy output for ANY draft
+    # proposals: the draft buys speed, never changes tokens. Draft and
+    # target share the one PagePool table; the draft keeps its own pools.
+
+    @property
+    def has_draft(self) -> bool:
+        """Whether a draft engine is attached (``attach_draft``)."""
+        return self.draft is not None
+
+    def attach_draft(self, draft_net) -> "InferStep":
+        """Attach a draft engine over ``draft_net`` (same vocab and
+        special ids; typically a shallower stack). The draft shares this
+        engine's ``RecompileGuard`` (one steady-state accounting domain)
+        and inherits its AMP/max_len config. ``spec_pair()`` snapshots
+        (target params, draft params, version) as ONE tuple, reassigned
+        atomically by ``swap_params`` — a spec round can therefore never
+        observe mixed draft/target versions."""
+        draft = InferStep(draft_net, mesh=self._mesh, amp=self._amp,
+                          max_len=self._max_len, bos_id=self._bos,
+                          eos_id=self._eos, pad_id=self._pad)
+        draft.compile_guard = self.compile_guard
+        self.draft = draft
+        self._live_pair = (self._values, draft._values,
+                           self._weights_version)
+        return draft
+
+    def spec_pair(self):
+        """One coherent ``(target_values, draft_values, version)``
+        snapshot. Spec rounds read this ONCE and thread it through both
+        dispatches; the swap plane flips the whole tuple in a single
+        reference assignment."""
+        if self._live_pair is None:
+            raise MXNetError("spec_pair() needs attach_draft() first")
+        return self._live_pair
+
+    def init_draft_state(self, slots, num_pages, page_size, mem_len):
+        """Paged decode state for the DRAFT engine with the same pool
+        geometry as the target's — both sides are indexed by the one
+        shared ``PagePool`` page table."""
+        if self.draft is None:
+            raise MXNetError("init_draft_state() needs attach_draft() "
+                             "first")
+        return self.draft.init_paged_state(slots, num_pages, page_size,
+                                           mem_len)
+
+    def _get_spec_draft_fn(self, steps, method, top_k):
+        """The draft proposal program IS the draft's ``decode_iter`` with
+        ``steps = k+1``: step j scatters token x_j at ``len+j`` and
+        samples x_{j+1}, so proposals are ``buf[:, :k]`` and the extra
+        step writes d_k's KV at ``len+k`` — a full-acceptance round
+        leaves no draft-cache hole. No new program shape: the batcher's
+        warmed draft decode_iter menu covers it."""
+        return self.draft._get_decode_iter_fn(steps, method, top_k)
+
+    def spec_draft(self, dstate, page_tables, tokens, lengths, active,
+                   k=4, pair=None, seed=0):
+        """Draft proposal dispatch: k+1 greedy draft steps per live slot
+        in ONE jitted call (the draft's donated-carry decode_iter).
+        ``tokens`` are the slots' carry tokens; returns ``(buf (slots,
+        k+1) NDArray, new_dstate)`` — proposals are ``buf[:, :k]``, the
+        last column is the hole-closing extra step. Sync-free by lint;
+        pass the whole buf to ``spec_verify``."""
+        page_tables = jnp.asarray(page_tables, jnp.int32)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        active = jnp.asarray(active, jnp.bool_)
+        method, top_k, seed, steps = self._paged_cfg("greedy", 0, seed,
+                                                     k + 1)
+        cfg = (steps, method, top_k)
+        sig = ("spec_draft", cfg, (page_tables.shape, tokens.shape),
+               dstate["k_pools"][0].shape)
+        self.compile_guard.observe(
+            sig, lambda: f"spec_draft{cfg} "
+            + _cc.aval_summary((page_tables, tokens)))
+        fn = self._get_spec_draft_fn(steps, method, top_k)
+        vals = pair[1] if pair is not None else self.draft._values
+        buf, new_dstate = fn(vals, dstate, page_tables, tokens, lengths,
+                             active, jax.random.PRNGKey(seed),
+                             jnp.float32(1.0))
+        return NDArray(buf), new_dstate
+
+    @staticmethod
+    def _spec_cfg(drafts_width, wide):
+        """Host-side spec-verify config normalization (kept out of the
+        linted dispatch — Python-value coercions, never device reads)."""
+        k = int(drafts_width) - 1
+        if k < 1:
+            raise MXNetError("spec_verify needs a (slots, k+1) draft "
+                             "buffer with k >= 1")
+        return k, bool(wide)
+
+    def _get_spec_verify_fn(self, k, wide):
+        """Target verification program: score all k+1 positions (carry +
+        k proposals), accept in-graph. ``wide=False`` (exact mode,
+        default) runs a fori_loop of the SAME ``decode_step_paged``
+        program shape as plain decoding — bit-identical logits by
+        construction; ``wide=True`` scores the window in one
+        ``decode_window_paged`` pass (the flash-kernel fast path, equal
+        argmax up to attention-order rounding). Output packs ``(slots,
+        k+2)`` int32: target argmaxes t_0..t_k then the per-row emit
+        count ``n_accepted + 1``."""
+        cfg = ("spec_verify", k, bool(wide))
+        fn = self._paged_fns.get(cfg)
+        if fn is not None:
+            return fn
+        net = self._net
+
+        def verify(values, state, page_tables, drafts, tokens, lengths,
+                   active):
+            B = drafts.shape[0]
+            # x_0 = carry, x_j = draft proposal j; the draft buffer's
+            # last column (the hole-closing extra step) is unused here
+            x = jnp.concatenate([tokens[:, None], drafts[:, :k]], axis=1)
+            if wide:
+                with self._net_scope(values, jax.random.PRNGKey(0)):
+                    logits, state = net.decode_window_paged(
+                        NDArray(x), lengths, state, page_tables, active)
+                logits = logits.data if isinstance(logits, NDArray) \
+                    else logits
+                t = jnp.argmax(logits.astype(jnp.float32),
+                               axis=-1).astype(jnp.int32)
+            else:
+                tbuf = jnp.zeros((B, k + 1), jnp.int32)
+
+                def body(j, c):
+                    st, tb = c
+                    tok_j = jax.lax.dynamic_index_in_dim(
+                        x, j, axis=1, keepdims=False)
+                    with self._net_scope(values, jax.random.PRNGKey(0)):
+                        lg, st = net.decode_step_paged(
+                            NDArray(tok_j), lengths + j, st, page_tables,
+                            active)
+                    lg = lg.data if isinstance(lg, NDArray) else lg
+                    tj = jnp.argmax(lg.astype(jnp.float32),
+                                    axis=-1).astype(jnp.int32)
+                    return st, jax.lax.dynamic_update_slice(
+                        tb, tj[:, None], (0, j))
+
+                state, t = jax.lax.fori_loop(0, k + 1, body, (state, tbuf))
+            # longest agreeing prefix: d_j accepted iff d_j == t_{j-1}
+            agree = (drafts[:, :k] == t[:, :k]).astype(jnp.int32)
+            n_acc = jnp.sum(jnp.cumprod(agree, axis=1), axis=1)
+            count = jnp.where(active, n_acc + 1, 0).astype(jnp.int32)
+            return jnp.concatenate([t, count[:, None]], axis=1), state
+
+        donate = () if jax.default_backend() == "cpu" else (1,)
+        fn = jax.jit(verify, donate_argnums=donate)
+        self._paged_fns[cfg] = fn
+        return fn
+
+    def spec_verify(self, state, page_tables, drafts, tokens, lengths,
+                    active, pair=None, wide=False):
+        """Target verification dispatch: ONE jitted call scores the carry
+        token plus k proposals and accepts the longest agreeing prefix
+        in-graph. ``drafts`` is ``spec_draft``'s whole (slots, k+1)
+        buffer (k inferred from its width). Returns ``(out (slots, k+2)
+        NDArray, new_state)``: columns 0..k are the target greedy tokens
+        t_0..t_k, column k+1 the per-row emit count — the scheduler
+        emits ``t_0..t_{count-1}`` and advances length by count.
+        Sync-free by lint; greedy only (spec never engages for sampled
+        requests)."""
+        page_tables = jnp.asarray(page_tables, jnp.int32)
+        drafts = drafts.data if isinstance(drafts, NDArray) \
+            else jnp.asarray(drafts)
+        drafts = drafts.astype(jnp.int32)
+        tokens = jnp.asarray(tokens, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        active = jnp.asarray(active, jnp.bool_)
+        k, wide = self._spec_cfg(drafts.shape[1], wide)
+        cfg = (k, wide)
+        sig = ("spec_verify", cfg, (page_tables.shape, drafts.shape),
+               state["k_pools"][0].shape)
+        self.compile_guard.observe(
+            sig, lambda: f"spec_verify{cfg} "
+            + _cc.aval_summary((page_tables, drafts)))
+        fn = self._get_spec_verify_fn(k, wide)
+        vals = pair[0] if pair is not None else self._values
+        out, new_state = fn(vals, state, page_tables, drafts, tokens,
+                            lengths, active)
+        return NDArray(out), new_state
+
+    def decode_spec_n(self, src, src_valid_length=None, max_new_tokens=32,
+                      k=4, wide=False, seed=0, page_size=16):
+        """Speculative twin of ``decode_n``: one paged prefill, then host
+        rounds of draft-propose + target-verify until every row finishes.
+        ``k=0`` degenerates to sequential paged decoding (one
+        ``decode_iter`` step per round) — the bench ablation baseline on
+        the same program set. Greedy only; the acceptance rule emits
+        exactly the target's greedy stream, so output matches the
+        non-speculative engine token for token. Returns ``(tokens (B,
+        max_new), lengths (B,))`` NDArrays (pad-filled past EOS)."""
+        import numpy as _np
+
+        max_new, _, _, seed = self._decode_cfg(max_new_tokens, "greedy",
+                                               0, seed)
+        k = max(int(k), 0)
+        if k and self.draft is None:
+            raise MXNetError("decode_spec_n(k>0) needs attach_draft()")
+        src, vl = self._stage_src(src, src_valid_length)
+        B, L = int(src.shape[0]), int(src.shape[1])
+        page_size = int(page_size)
+        cap = 1 + max_new + k + 1  # BOS + emitted + one drafted window
+        pps = -(-cap // page_size)
+        table = _np.zeros((B, pps), _np.int32)
+        for r in range(B):
+            table[r] = 1 + r * pps + _np.arange(pps)
+        pair = self.spec_pair() if self.draft is not None else None
+        state = self.init_paged_state(B, B * pps, page_size, L)
+        slot_ids = _np.arange(B, dtype=_np.int32)
+        ones = _np.ones((B,), bool)
+        tok0, state = self.prefill_paged(state, src, vl, slot_ids,
+                                         table[:, 0], ones, seed=seed)
+        dstate = None
+        if k:
+            dstate = self.init_draft_state(B, B * pps, page_size, L)
+            _, dstate = self.draft.prefill_paged(
+                dstate, src, vl, slot_ids, table[:, 0], ones, seed=seed)
+        carry = tok0.asnumpy().astype(_np.int32)
+        lengths = _np.ones((B,), _np.int32)
+        emitted = [[int(carry[r])] for r in range(B)]
+        done = _np.array([t[0] == self._eos for t in emitted])
+        while True:
+            live = _np.array([not done[r] and len(emitted[r]) < max_new
+                              for r in range(B)])
+            if not live.any():
+                break
+            if k:
+                dbuf, dstate = self.spec_draft(
+                    dstate, table, carry, lengths, live, k=k, pair=pair,
+                    seed=seed)
+                out, state = self.spec_verify(
+                    state, table, dbuf, carry, lengths, live, pair=pair,
+                    wide=wide)
+                toks = out.asnumpy()
+                for r in range(B):
+                    if not live[r]:
+                        continue
+                    adv = 0
+                    for j in range(int(toks[r, k + 1])):
+                        t = int(toks[r, j])
+                        emitted[r].append(t)
+                        carry[r] = t
+                        adv += 1
+                        if t == self._eos:
+                            done[r] = True
+                            break
+                        if len(emitted[r]) >= max_new:
+                            break
+                    lengths[r] += adv
+            else:
+                buf, state = self.decode_iter(state, table, carry,
+                                              lengths, live, steps=1,
+                                              seed=seed)
+                toks = buf.asnumpy()
+                for r in range(B):
+                    if not live[r]:
+                        continue
+                    t = int(toks[r, 0])
+                    emitted[r].append(t)
+                    carry[r] = t
+                    lengths[r] += 1
+                    if t == self._eos:
+                        done[r] = True
+        out_t = _np.full((B, max_new), self._pad, _np.int32)
+        out_l = _np.zeros((B,), _np.int32)
+        for r in range(B):
+            n = min(len(emitted[r]), max_new)
+            out_t[r, :n] = emitted[r][:n]
+            out_l[r] = n
+        return NDArray(jnp.asarray(out_t)), NDArray(jnp.asarray(out_l))
 
     def generate(self, src, src_valid_length=None, max_new_tokens=32,
                  **kwargs):
@@ -774,6 +1058,18 @@ class InferStep:
             if self._param_sharding is not None:
                 v = jax.device_put(v, self._param_sharding(name, v.shape))
             vals[name] = v
+        if self.draft is not None:
+            # draft params ride the same checkpoint under a "draft/"
+            # prefix; staging both here lets swap_params flip the pair
+            # in one barrier step
+            sub = {}
+            for key, val in arrays.items():
+                if key.startswith("draft/"):
+                    sub[key[len("draft/"):]] = val
+                elif key.startswith("values/draft/"):
+                    sub["values/" + key[len("values/draft/"):]] = val
+            if sub:
+                vals["__draft_staged__"] = self.draft.stage_params(sub)
         return vals
 
     def swap_params(self, arrays=None, *, staged: Optional[dict] = None,
@@ -790,9 +1086,19 @@ class InferStep:
             if arrays is None:
                 raise MXNetError("swap_params needs arrays= or staged=")
             staged = self.stage_params(arrays)
-        elif set(staged) != {n for n, _ in self._params}:
+        dstaged = staged.pop("__draft_staged__", None)
+        if set(staged) != {n for n, _ in self._params}:
             raise MXNetError(
                 "staged param set does not cover the engine's params "
                 "(use stage_params())")
         self._values = staged
-        return self._bump_version(version)
+        ver = self._bump_version(version)
+        if self.draft is not None:
+            if dstaged is not None:
+                self.draft._values = dstaged
+                self.draft._weights_version = ver
+            # flip the PAIR last and as one tuple: spec rounds snapshot
+            # it once (spec_pair), so a concurrent round sees either the
+            # old (target, draft) pair or the new one — never a mix
+            self._live_pair = (self._values, self.draft._values, ver)
+        return ver

@@ -49,7 +49,7 @@ from . import prefix as _prefix
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
            "DeadlineExceeded", "Backpressure", "batcher_slots",
            "batcher_timeout_ms", "batcher_kind", "iter_tokens_default",
-           "make_batcher"]
+           "spec_k_default", "spec_draft_enabled", "make_batcher"]
 
 
 class DeadlineExceeded(MXNetError):
@@ -104,6 +104,29 @@ def iter_tokens_default(default: int = 4) -> int:
         return default
 
 
+def spec_k_default(default: int = 0) -> int:
+    """``MXTPU_SPEC_K``: draft tokens proposed per speculative-decoding
+    round. 0 (the default) disables speculation; a positive k makes the
+    scheduler draft k tokens per live slot and verify them in ONE target
+    dispatch (greedy output stays bit-identical to non-speculative)."""
+    v = os.environ.get("MXTPU_SPEC_K", "").strip()
+    try:
+        return max(int(v), 0) if v else default
+    except ValueError:
+        return default
+
+
+def spec_draft_enabled(default: bool = True) -> bool:
+    """``MXTPU_SPEC_DRAFT``: master enable for the speculative-decoding
+    draft path — ``0``/``false``/``off`` force-disables speculation even
+    when a draft model is attached and ``MXTPU_SPEC_K`` is positive (the
+    operator kill switch)."""
+    v = os.environ.get("MXTPU_SPEC_DRAFT", "").strip().lower()
+    if not v:
+        return default
+    return v not in ("0", "false", "off")
+
+
 def make_batcher(engine, bucket_keys, **kwargs):
     """Build the process-default batcher over ``engine``:
     ``ContinuousBatcher`` unless ``MXTPU_BATCHER=fixed`` (or the net
@@ -114,7 +137,8 @@ def make_batcher(engine, bucket_keys, **kwargs):
         kwargs.pop("timeout_ms", None)
         return ContinuousBatcher(engine, bucket_keys, **kwargs)
     for k in ("page_size", "num_pages", "iter_tokens",
-              "max_prefix_tokens", "prefix_cache"):
+              "max_prefix_tokens", "prefix_cache", "spec_k",
+              "spec_wide", "suffix_wide"):
         kwargs.pop(k, None)
     return DynamicBatcher(engine, bucket_keys, **kwargs)
 
@@ -689,6 +713,17 @@ class ContinuousBatcher(_BatcherBase):
         pool (``MXTPU_PREFIX_CACHE`` when None): retiring slots donate
         their page chains; admission adopts matched prefixes read-only
         and replays only the uncached suffix.
+    spec_k : draft tokens per speculative round (``MXTPU_SPEC_K`` when
+        None; 0 disables). Speculation engages only when the engine has
+        an attached draft (``InferStep.attach_draft``), sampling is
+        greedy, and ``MXTPU_SPEC_DRAFT`` isn't force-off — greedy output
+        stays BIT-IDENTICAL to the non-speculative scheduler; only the
+        tokens-per-dispatch ratio changes.
+    spec_wide : verify drafts with the one-pass windowed target program
+        (the shape the paged flash kernel accelerates) instead of the
+        bit-exact sequential verifier.
+    suffix_wide : replay prefix-cache suffixes through the one-pass
+        q_offset-aware window program instead of the sequential stream.
     warmup : compile the admission-prefill program per bucket plus the
         decode-iteration program at construction (inert rows — the pools
         only ever see trash-page writes).
@@ -709,6 +744,8 @@ class ContinuousBatcher(_BatcherBase):
                  admit_max_wait_ms: Optional[float] = None,
                  max_prefix_tokens: int = 0,
                  prefix_cache: Optional[bool] = None,
+                 spec_k: Optional[int] = None, spec_wide: bool = False,
+                 suffix_wide: bool = False,
                  warmup: bool = False, start: bool = True,
                  name: Optional[str] = None, watchdog=None):
         super().__init__(engine, bucket_keys, slots=slots,
@@ -724,8 +761,23 @@ class ContinuousBatcher(_BatcherBase):
         self.page_size = int(page_size) if page_size is not None \
             else _pages.page_size_default()
         self.max_prefix = int(max_prefix_tokens)
+        # speculative decoding resolves BEFORE pool geometry: a spec
+        # round writes up to k target entries past a row's emitted
+        # length, and ACCEPTED entries must land in real pages (a
+        # trash-page overflow would silently lose cached KV), so every
+        # slot is provisioned k positions deeper
+        self.spec_k = int(spec_k) if spec_k is not None \
+            else spec_k_default()
+        self._spec_on = (self.spec_k > 0
+                         and getattr(engine, "has_draft", False)
+                         and spec_draft_enabled()
+                         and self._sampling.get("method",
+                                                "greedy") == "greedy")
+        self.spec_wide = bool(spec_wide)
+        self.suffix_wide = bool(suffix_wide)
         self.pages_per_slot = _pages.pages_for(
-            1 + self.max_prefix + self.max_new, self.page_size)
+            1 + self.max_prefix + self.max_new
+            + (self.spec_k if self._spec_on else 0), self.page_size)
         self.num_pages = int(num_pages) if num_pages is not None \
             else _pages.num_pages_default(self.slots, self.pages_per_slot)
         if self.pages_per_slot > self.num_pages:
@@ -746,6 +798,14 @@ class ContinuousBatcher(_BatcherBase):
                                     self.slots, self.pages_per_slot)
         self._state = engine.init_paged_state(
             self.slots, self.num_pages, self.page_size, self.mem_len)
+        # the draft model decodes against its OWN pools but the SAME
+        # page table — one allocator, two KV caches
+        self._dstate = engine.init_draft_state(
+            self.slots, self.num_pages, self.page_size,
+            self.mem_len) if self._spec_on else None
+        from ..ops.pallas import paged_flash_attention as _pfa
+        _tel.registry().gauge("infer/flash_kernel").set(
+            1.0 if _pfa.flash_paged_enabled() else 0.0)
         # prefix trie over this pool: retired slots donate their page
         # chains (refcounted, read-only) and admission adopts matched
         # prefixes instead of recomputing them
@@ -817,12 +877,32 @@ class ContinuousBatcher(_BatcherBase):
                     _np.zeros((rows,), _np.int32),
                     _np.zeros((rows,), bool), **self._sampling)
                 jax.block_until_ready(tok0.data)
+                if self._spec_on:
+                    # draft admission shares every shape bucket with the
+                    # target so cold admits never trace mid-serving
+                    tokD, self._dstate = eng.draft.prefill_paged(
+                        self._dstate, src, vl, inert,
+                        _np.zeros((rows,), _np.int32),
+                        _np.zeros((rows,), bool), **self._sampling)
+                    jax.block_until_ready(tokD.data)
         zeros = _np.zeros((self.slots,), _np.int32)
         buf, self._state = eng.decode_iter(
             self._state, self.pool.table, zeros, zeros,
             _np.zeros((self.slots,), bool), steps=self.iter_tokens,
             **self._sampling)
         jax.block_until_ready(buf.data)
+        if self._spec_on:
+            # one inert speculative round compiles BOTH spec programs
+            # (draft k-token proposal + target k+1 verification)
+            inactive = _np.zeros((self.slots,), bool)
+            pair = eng.spec_pair()
+            dbuf, self._dstate = eng.spec_draft(
+                self._dstate, self.pool.table, zeros, zeros, inactive,
+                k=self.spec_k, pair=pair)
+            vbuf, self._state = eng.spec_verify(
+                self._state, self.pool.table, dbuf, zeros, zeros,
+                inactive, pair=pair, wide=self.spec_wide)
+            jax.block_until_ready(vbuf.data)
         # forced-prefix replay menu (rows x suffix-length buckets): the
         # teacher-forced suffix program serves both cache hits and cold
         # prefix replays, so it must be steady before the first one
@@ -834,7 +914,8 @@ class ContinuousBatcher(_BatcherBase):
                     self._state, toks, ones, ones,
                     _np.zeros((srows, self.pages_per_slot), _np.int32),
                     _np.full((srows,), self.slots, _np.int32),
-                    _np.zeros((srows,), bool), **self._sampling)
+                    _np.zeros((srows,), bool), wide=self.suffix_wide,
+                    **self._sampling)
                 jax.block_until_ready(tokS.data)
         # the batched hit-adoption program (inert here: TRASH->TRASH
         # COW self-copies, out-of-bounds cross rows — shapes are padded
@@ -1407,6 +1488,14 @@ class ContinuousBatcher(_BatcherBase):
                 tok0, self._state = self._engine.prefill_paged(
                     self._state, src, vl, slot_ids, first_pages, active,
                     seed=self._iter, **self._sampling)
+                if self._spec_on:
+                    # prime the draft's KV over the same prompt rows;
+                    # best-effort — prefix-hit/adopted rows skip this
+                    # (an unprimed draft only lowers acceptance, never
+                    # correctness: verification is always the target)
+                    _, self._dstate = self._engine.draft.prefill_paged(
+                        self._dstate, src, vl, slot_ids, first_pages,
+                        active, seed=self._iter, **self._sampling)
                 tok0 = tok0.asnumpy()
             except Exception as e:  # noqa: BLE001 - fail futures, not thread
                 for slot, r, _hit in picked:
@@ -1456,7 +1545,8 @@ class ContinuousBatcher(_BatcherBase):
                 _faults.fire("batcher.dispatch", tag=self.name)
                 tokS, self._state = self._engine.prefill_suffix_paged(
                     self._state, toks, vl_s, q_off, tables, sids, act,
-                    seed=self._iter, **self._sampling)
+                    seed=self._iter, wide=self.suffix_wide,
+                    **self._sampling)
                 tokS = tokS.asnumpy()
             except Exception as e:  # noqa: BLE001 - fail futures, not thread
                 for slot, r, _hit in picked:
@@ -1512,10 +1602,18 @@ class ContinuousBatcher(_BatcherBase):
                 continue  # preempted/bounced by an earlier row's fight
             # a row near its max_new needs less than a full burst; beyond
             # its allocation the device's surplus burst steps land in the
-            # trash page, so the cap is safe
+            # trash page, so the cap is safe. A speculative round writes
+            # up to spec_k entries ahead and ACCEPTED entries must land
+            # in real pages, so the cap stretches by spec_k too.
             base = 1 + (0 if s.req.prefix is None
                         else int(s.req.prefix.shape[0]))
-            upto = min(s.length + self.iter_tokens, base + s.req.max_new)
+            if self._spec_on:
+                grow = self.spec_k + 1
+                cap = base + s.req.max_new + self.spec_k
+            else:
+                grow = self.iter_tokens
+                cap = base + s.req.max_new
+            upto = min(s.length + grow, cap)
             while not self.pool.ensure(i, upto):
                 # idle cached pages yield before any live row is
                 # preempted — the trie is a cache, not a tenant
@@ -1559,7 +1657,13 @@ class ContinuousBatcher(_BatcherBase):
         """One decode-iteration dispatch over the slot batch: pure
         staging + the jitted ``InferStep.decode_iter`` call — linted
         sync-free (``tools/check_no_sync_in_step.py``); the host reads
-        happen in ``_collect`` after the device work is in flight."""
+        happen in ``_collect`` after the device work is in flight.
+
+        With speculation on, the iteration is one draft proposal burst
+        (k tokens per live slot against the draft's pools) plus ONE
+        target verification dispatch scoring all k+1 positions; both
+        engines' weights come from one coherent ``spec_pair()`` snapshot
+        so a concurrent hot swap can never mix draft/target versions."""
         _faults.fire("batcher.hang", tag=self.name)
         _faults.fire("batcher.dispatch", tag=self.name)
         tokens = _np.zeros((self.slots,), _np.int32)
@@ -1570,8 +1674,19 @@ class ContinuousBatcher(_BatcherBase):
             tokens[i] = s.carry
             lengths[i] = s.length
             active[i] = True
-        version = getattr(self._engine, "weights_version", None)
         self._iter += 1
+        if self._spec_on:
+            pair = self._engine.spec_pair()
+            t_d = time.perf_counter()
+            dbuf, self._dstate = self._engine.spec_draft(
+                self._dstate, self.pool.table, tokens, lengths, active,
+                k=self.spec_k, pair=pair, seed=self._iter)
+            draft_ms = (time.perf_counter() - t_d) * 1e3
+            buf, self._state = self._engine.spec_verify(
+                self._state, self.pool.table, dbuf, tokens, lengths,
+                active, pair=pair, wide=self.spec_wide)
+            return buf, pair[2], draft_ms
+        version = getattr(self._engine, "weights_version", None)
         buf, self._state = self._engine.decode_iter(
             self._state, self.pool.table, tokens, lengths, active,
             steps=self.iter_tokens, seed=self._iter, **self._sampling)
@@ -1581,16 +1696,33 @@ class ContinuousBatcher(_BatcherBase):
         """Read back the iteration's token block — the scheduler's ONE
         sync point — then stream, account lengths, and mark retirements
         for the next iteration's safe point."""
-        buf, version = out
+        if self._spec_on:
+            buf, version, draft_ms = out
+        else:
+            buf, version = out
+            draft_ms = None
         toks = buf.asnumpy()
         iter_ms = (time.perf_counter() - t0) * 1e3
         reg = _tel.registry()
         emitted_total = 0
         eos = self._engine._eos
+        if draft_ms is not None:
+            reg.histogram("infer/spec_draft_ms").observe(draft_ms)
         for i in live:
             s = self._slots[i]
             fresh = []
-            for j in range(self.iter_tokens):
+            if self._spec_on:
+                # row layout: [t_0..t_k, count]; count = accepted
+                # drafts + the bonus token (0 for inactive rows).
+                # Every emitted token is the target's own greedy
+                # argmax — acceptance only decides how many land per
+                # round, never which.
+                burst = int(toks[i, self.spec_k + 1])
+                reg.histogram("infer/spec_accept_len").observe(
+                    max(burst - 1, 0))
+            else:
+                burst = self.iter_tokens
+            for j in range(burst):
                 tok = int(toks[i, j])
                 s.length += 1  # this step cached the previous carry
                 s.carry = tok
@@ -1635,6 +1767,9 @@ class ContinuousBatcher(_BatcherBase):
         self.pool.reset()
         self._state = self._engine.init_paged_state(
             self.slots, self.num_pages, self.page_size, self.mem_len)
+        if self._spec_on:
+            self._dstate = self._engine.init_draft_state(
+                self.slots, self.num_pages, self.page_size, self.mem_len)
 
     @property
     def sustained_occupancy(self) -> float:

@@ -448,6 +448,120 @@ class TestInferStep:
             mx.telemetry.reset()
 
 
+# -------------------------------------------------- speculative decoding
+class TestSpeculativeDecode:
+    """ISSUE 14: draft-proposes / target-verifies greedy speculation.
+    The acceptance rule (draft token j lands iff it equals the target
+    argmax at its position) makes the emitted stream the target's greedy
+    output BIT-identically for ANY draft — these tests pin that down for
+    the degenerate (k=0), oracle (full acceptance), and garbage
+    (full rejection) drafts, plus the swap plane's pair coherence."""
+
+    def _prompts(self, seed=11, B=3, Ls=8):
+        rng = np.random.RandomState(seed)
+        src = rng.randint(3, 61, (B, Ls)).astype(np.int32)
+        vl = np.array([5, 7, 8], np.int32)
+        return src, vl
+
+    def _ref(self, tmodel, src, vl, T):
+        eng = InferStep(tmodel, max_len=32)
+        toks, lens = eng.decode_n(src, vl, max_new_tokens=T)
+        return toks.asnumpy(), lens.asnumpy()
+
+    def _oracle_draft(self, tmodel):
+        np.random.seed(0)
+        draft = _make_transformer()
+        tp = {n.split("_", 1)[1]: p
+              for n, p in tmodel.collect_params().items()}
+        for name, p in draft.collect_params().items():
+            p.set_data(nd.NDArray(tp[name.split("_", 1)[1]]._data.data))
+        return draft
+
+    def test_k0_bit_identical_to_decode_n(self, tmodel):
+        src, vl = self._prompts()
+        T = 6
+        toks_d, lens_d = self._ref(tmodel, src, vl, T)
+        eng = InferStep(tmodel, max_len=32)
+        eng.attach_draft(self._oracle_draft(tmodel))
+        toks, lens = eng.decode_spec_n(src, vl, max_new_tokens=T, k=0,
+                                       page_size=4)
+        np.testing.assert_array_equal(lens.asnumpy(), lens_d)
+        np.testing.assert_array_equal(toks.asnumpy(), toks_d)
+        eng.compile_guard.mark_steady()
+        eng.decode_spec_n(src, vl, max_new_tokens=T, k=0, page_size=4)
+        assert eng.compile_guard.steady_state_recompiles == 0
+
+    @pytest.mark.parametrize("wide", [False, True])
+    def test_oracle_draft_bit_identical(self, tmodel, wide):
+        src, vl = self._prompts()
+        T = 6
+        toks_d, lens_d = self._ref(tmodel, src, vl, T)
+        eng = InferStep(tmodel, max_len=32)
+        eng.attach_draft(self._oracle_draft(tmodel))
+        toks, lens = eng.decode_spec_n(src, vl, max_new_tokens=T, k=3,
+                                       wide=wide, page_size=4)
+        np.testing.assert_array_equal(lens.asnumpy(), lens_d)
+        np.testing.assert_array_equal(toks.asnumpy(), toks_d)
+        eng.compile_guard.mark_steady()
+        eng.decode_spec_n(src, vl, max_new_tokens=T, k=3, wide=wide,
+                          page_size=4)
+        assert eng.compile_guard.steady_state_recompiles == 0
+
+    def test_garbage_draft_full_rejection_still_exact(self, tmodel):
+        """A draft with unrelated weights rejects (almost) every
+        proposal; the output must STILL be the target's greedy stream —
+        acceptance only sets the per-round burst length."""
+        np.random.seed(9)
+        garbage = _make_transformer()
+        src, vl = self._prompts()
+        T = 6
+        toks_d, lens_d = self._ref(tmodel, src, vl, T)
+        eng = InferStep(tmodel, max_len=32)
+        eng.attach_draft(garbage)
+        for wide in (False, True):
+            toks, lens = eng.decode_spec_n(src, vl, max_new_tokens=T,
+                                           k=3, wide=wide, page_size=4)
+            np.testing.assert_array_equal(lens.asnumpy(), lens_d)
+            np.testing.assert_array_equal(toks.asnumpy(), toks_d)
+
+    def test_spec_pair_swap_coherence(self, tmodel):
+        """swap_params flips (target, draft, version) as ONE tuple:
+        draft/ checkpoint keys land on the draft engine, the pair
+        version tracks weights_version, and the pre-swap snapshot keeps
+        serving the OLD pair."""
+        eng = InferStep(tmodel, max_len=32)
+        draft = self._oracle_draft(tmodel)
+        eng.attach_draft(draft)
+        pair0 = eng.spec_pair()
+        assert pair0[2] == eng.weights_version
+        arrays = {n: np.asarray(p._data.data)
+                  for n, p in tmodel.collect_params().items()}
+        np.random.seed(13)
+        other = _make_transformer()
+        # draft/ keys use the DRAFT engine's own param names; map the
+        # donor net's params over by instance-prefix-stripped name
+        donor = {n.split("_", 1)[1]: np.asarray(p._data.data)
+                 for n, p in other.collect_params().items()}
+        for n in eng.draft._values:
+            arrays["draft/" + n] = donor[n.split("_", 1)[1]]
+        ver = eng.swap_params(arrays)
+        pair1 = eng.spec_pair()
+        assert pair1[2] == ver == eng.weights_version
+        assert pair1 is not pair0 and pair0[2] != ver
+        # draft values actually flipped to the staged draft/ arrays
+        name = next(iter(eng.draft._values))
+        np.testing.assert_array_equal(
+            np.asarray(pair1[1][name]), arrays["draft/" + name])
+        # the old snapshot still holds the old values (in-flight safety)
+        assert pair0[1] is not pair1[1]
+
+    def test_spec_requires_attach_draft(self, tmodel):
+        eng = InferStep(tmodel, max_len=32)
+        assert not eng.has_draft
+        with pytest.raises(MXNetError, match="attach_draft"):
+            eng.spec_pair()
+
+
 # ------------------------------------------------------- DynamicBatcher
 class TestDynamicBatcher:
     def _batcher(self, tmodel, **kw):

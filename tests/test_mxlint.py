@@ -868,6 +868,101 @@ class TestPrefixCachingPassScope:
         assert any("unaccounted" in key for _, key, _ in out)
 
 
+# =============================== speculative-decoding pass extensions (ISSUE 14)
+class TestSpeculativePassScope:
+    """The speculative surface (``spec_draft``/``spec_verify`` dispatches,
+    their traced builders, the batcher's spec round) sits inside every
+    relevant pass's scope — coverage assertions plus seeded positive/
+    negative controls. At-HEAD cleanliness of the real modules rides the
+    existing full-suite and per-pass head tests."""
+
+    def test_new_surface_is_in_scope(self):
+        covered = {(os.path.basename(p), cls): set(funcs)
+                   for p, cls, funcs in no_sync.TARGETS}
+        infer = covered[("infer.py", "InferStep")]
+        assert {"spec_draft", "spec_verify"} <= infer
+        assert {"spec_draft", "spec_verify"} <= \
+            set(donation.DONATING_CALLS)
+        assert {"spec_draft", "spec_verify"} <= \
+            set(recompile.GUARDED_DISPATCHES[recompile.INFER_PY])
+        assert {"_get_spec_draft_fn", "_get_spec_verify_fn"} <= \
+            set(recompile.TRACED_BUILDERS[recompile.INFER_PY])
+        assert {"spec_draft", "spec_verify"} <= lock_order.DISPATCH_ATTRS
+
+    def test_sync_in_spec_round_flagged(self, tmp_path):
+        """Positive: host syncs inside the draft/verify dispatches."""
+        bad = tmp_path / "infer_spec_bad.py"
+        bad.write_text(
+            "class InferStep:\n"
+            "    def spec_draft(self, dstate, tables, tokens):\n"
+            "        buf, dstate = self._fn(dstate, tables, tokens)\n"
+            "        return buf.asnumpy(), dstate\n"
+            "    def spec_verify(self, state, tables, drafts):\n"
+            "        buf, state = self._fn(state, tables, drafts)\n"
+            "        return int(buf[0, -1]), state\n"
+        )
+        violations = no_sync.find_violations(
+            str(bad), "InferStep", ("spec_draft", "spec_verify"))
+        assert len(violations) == 2
+        assert any("asnumpy" in m for _, m in violations)
+        assert any("int" in m for _, m in violations)
+
+    def test_clean_spec_dispatch_passes(self, tmp_path):
+        """Negative: the real shape — dispatch returns device buffers,
+        carry rebinds in the same statement — is sync-free."""
+        good = tmp_path / "infer_spec_good.py"
+        good.write_text(
+            "class InferStep:\n"
+            "    def spec_verify(self, state, tables, drafts):\n"
+            "        fn = self._get_spec_verify_fn(4)\n"
+            "        self.compile_guard.observe(('spec_verify', 4))\n"
+            "        buf, state = fn(self._values, state, tables, drafts)\n"
+            "        return buf, state\n"
+        )
+        assert not no_sync.find_violations(
+            str(good), "InferStep", ("spec_verify",))
+
+    def test_spec_lost_carry_flagged(self, tmp_path):
+        """Positive: dropping the donated draft-state carry of
+        spec_draft is a use-after-donate bug."""
+        index, name = _write_module(tmp_path, """
+            class Batcher:
+                def _spec_round(self, tokens):
+                    dbuf = self._engine.spec_draft(
+                        self._dstate, self.tables, tokens)
+                    return dbuf
+            """)
+        out = donation.check_use_after_donate(index.module(name))
+        assert any("lost" in key for _, key, _ in out)
+
+    def test_unaccounted_spec_dispatch_flagged(self, tmp_path):
+        index, name = _write_module(tmp_path, """
+            class InferStep:
+                def spec_verify(self, state, tables, drafts):
+                    fn = self._get_spec_verify_fn(4)
+                    return fn(self._values, state, tables, drafts)
+            """)
+        out = recompile.check_guard_accounting(
+            index.module(name), ("spec_verify",))
+        assert any("unaccounted" in key for _, key, _ in out)
+
+    def test_shape_branch_in_spec_builder_flagged(self, tmp_path):
+        """Positive: a data-dependent shape branch inside the traced
+        verify closure is a per-round recompile."""
+        index, name = _write_module(tmp_path, """
+            class InferStep:
+                def _get_spec_verify_fn(self, k):
+                    def verify(values, state, drafts):
+                        if len(drafts) > 2:
+                            return state
+                        return values
+                    return verify
+            """)
+        out = recompile.check_traced_closures(
+            index.module(name), ("_get_spec_verify_fn",))
+        assert any("shape-branch" in key for _, key, _ in out)
+
+
 # ===================================== collective-placement self-tests
 class TestCollectivePlacement:
     def test_decode_programs_dispatch_no_collectives(self, ctx):

@@ -196,6 +196,140 @@ class TestPagedParity:
         bat.pool.check_invariants(set())
 
 
+# ------------------------------------------- Pallas kernels & speculation
+class TestFlashPagedKernel:
+    """ISSUE 14: the Pallas paged flash kernels (interpret mode on the
+    CPU rig) against their dense references, and speculative decoding
+    through the batcher against the dense engine."""
+
+    def _pools(self, rng, num_pages=5, ps=4, H=2, D=8):
+        kp = jnp.asarray(rng.randn(num_pages, ps, H, D).astype(np.float32))
+        vp = jnp.asarray(rng.randn(num_pages, ps, H, D).astype(np.float32))
+        return kp, vp
+
+    def test_decode_kernel_matches_reference(self):
+        from mxnet_tpu.ops.pallas import paged_flash_attention as pfa
+        rng = np.random.RandomState(0)
+        kp, vp = self._pools(rng)
+        q = jnp.asarray(rng.randn(2, 2, 8).astype(np.float32))
+        table = jnp.asarray(np.array([[1, 2], [3, 4]], np.int32))
+        pos = jnp.asarray(np.array([2, 6], np.int32))  # mid-page tails
+        got = pfa.paged_decode_attention(q, kp, vp, table, pos,
+                                         sm_scale=8 ** -0.5)
+        want = pfa.paged_decode_reference(q, kp, vp, table, pos,
+                                          sm_scale=8 ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_window_kernel_matches_reference_offset_and_padding(self):
+        from mxnet_tpu.ops.pallas import paged_flash_attention as pfa
+        rng = np.random.RandomState(1)
+        kp, vp = self._pools(rng)
+        S = 3
+        q = jnp.asarray(rng.randn(2, S, 2, 8).astype(np.float32))
+        table = jnp.asarray(np.array([[1, 2], [3, 4]], np.int32))
+        off = jnp.asarray(np.array([0, 5], np.int32))  # suffix replay row
+        vl = jnp.asarray(np.array([3, 2], np.int32))   # row 1 pads query 2
+        got = pfa.paged_window_attention(q, kp, vp, table, off, vl,
+                                         sm_scale=8 ** -0.5)
+        want = pfa.paged_window_reference(q, kp, vp, table, off, vl,
+                                          sm_scale=8 ** -0.5)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        # padded query rows finalize to exact zero in both
+        assert np.abs(np.asarray(got)[1, 2]).sum() == 0.0
+
+    def test_forced_kernel_paged_step_matches_fallback(self, monkeypatch):
+        """Layer level: ``paged_step`` with the kernel forced (interpret
+        mode here) == the dense gather fallback to fp tolerance."""
+        mha = MultiHeadAttention(16, 2, dropout=0.0, causal=True)
+        mha.initialize()
+        rng = np.random.RandomState(2)
+        table = jnp.asarray(np.array([[1, 2], [3, 4]], np.int32))
+        x = nd.array(rng.randn(2, 1, 16).astype(np.float32))
+        x0 = nd.array(rng.randn(2, 1, 16).astype(np.float32))
+        outs = {}
+        for mode in ("0", "force"):
+            monkeypatch.setenv("MXTPU_FLASH_PAGED", mode)
+            kp, vp = mha.init_page_pool(5, 4)
+            _, k, v = mha.prefill(x0)
+            kp = kp.at[table[:, 0], 0].set(k[:, 0])
+            vp = vp.at[table[:, 0], 0].set(v[:, 0])
+            o, _, _ = mha.paged_step(x, kp, vp, table,
+                                     jnp.ones((2,), jnp.int32),
+                                     jnp.ones((2,), bool))
+            outs[mode] = o.asnumpy()
+        np.testing.assert_allclose(outs["force"], outs["0"],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_kernel_active_rows_isolated_from_trash_page(self, monkeypatch):
+        """Inactive rows park their table on trash page 0; the kernel's
+        in-place page walk must give active rows identical output no
+        matter what garbage page 0 holds."""
+        monkeypatch.setenv("MXTPU_FLASH_PAGED", "force")
+        mha = MultiHeadAttention(16, 2, dropout=0.0, causal=True)
+        mha.initialize()
+        rng = np.random.RandomState(3)
+        table = jnp.asarray(np.array([[1, 2], [0, 0]], np.int32))
+        active = jnp.asarray(np.array([True, False]))
+        x = nd.array(rng.randn(2, 1, 16).astype(np.float32))
+        kp, vp = mha.init_page_pool(5, 4)
+        _, k, v = mha.prefill(nd.array(
+            rng.randn(2, 1, 16).astype(np.float32)))
+        kp = kp.at[table[:, 0], 0].set(k[:, 0])
+        vp = vp.at[table[:, 0], 0].set(v[:, 0])
+        o_clean, kp2, _ = mha.paged_step(x, kp, vp, table,
+                                         jnp.ones((2,), jnp.int32), active)
+        # poison the trash page with huge values and replay
+        kp_bad = kp.at[0].set(1e9)
+        vp_bad = vp.at[0].set(-1e9)
+        o_bad, _, _ = mha.paged_step(x, kp_bad, vp_bad, table,
+                                     jnp.ones((2,), jnp.int32), active)
+        np.testing.assert_array_equal(o_clean.asnumpy()[0],
+                                      o_bad.asnumpy()[0])
+        # the inactive row's write landed on trash page 0 only: every
+        # page beyond the active row's current one is untouched
+        np.testing.assert_array_equal(np.asarray(kp[2:]),
+                                      np.asarray(kp2[2:]))
+
+    def test_spec_batcher_bitwise_vs_decode_n(self, tmodel):
+        """End to end: speculative rounds through the scheduler emit the
+        SAME greedy tokens as the dense engine — with an oracle draft
+        (weight copy, full acceptance) AND a garbage draft (near-zero
+        acceptance): the acceptance rule only sets the burst length."""
+        rng = np.random.RandomState(5)
+        B, Ls, T = 3, 8, 6
+        src = rng.randint(3, 61, (B, Ls)).astype(np.int32)
+        vl = np.array([4, 7, 8], np.int32)
+        ref_eng = InferStep(tmodel, max_len=24)
+        toks_d, lens_d = ref_eng.decode_n(src, vl, max_new_tokens=T)
+        toks_d, lens_d = toks_d.asnumpy(), lens_d.asnumpy()
+        ref = [toks_d[i, :int(lens_d[i])].tolist() for i in range(B)]
+
+        oracle = _make_transformer(seed=0)   # same seed = same weights
+        tp = {n.split("_", 1)[1]: p
+              for n, p in tmodel.collect_params().items()}
+        for name, p in oracle.collect_params().items():
+            p.set_data(nd.NDArray(tp[name.split("_", 1)[1]]._data.data))
+        garbage = _make_transformer(seed=7)
+        for draft, tag in ((oracle, "oracle"), (garbage, "garbage")):
+            eng = InferStep(tmodel, max_len=24)
+            eng.attach_draft(draft)
+            bat = ContinuousBatcher(eng, bucket_keys=(Ls,), slots=2,
+                                    max_new_tokens=T, page_size=4,
+                                    iter_tokens=2, spec_k=3, warmup=True)
+            assert bat._spec_on
+            try:
+                futs = [bat.submit(src[i, :vl[i]]) for i in range(B)]
+                got = [f.result(timeout=120) for f in futs]
+            finally:
+                bat.stop()
+            assert got == ref, tag
+            assert eng.compile_guard.steady_state_recompiles == 0, tag
+            assert bat.pool.free_pages == bat.pool.num_pages, tag
+            bat.pool.check_invariants(set())
+
+
 # ------------------------------------------------- scheduler behaviour
 class TestContinuousBatcher:
     def _batcher(self, tmodel, **kw):

@@ -379,6 +379,35 @@ class TestEndToEnd:
         assert engine.compile_guard.steady
         assert engine.compile_guard.steady_state_recompiles == 0
 
+    def test_suffix_wide_replay_bit_identical(self, cold_batcher):
+        """ISSUE 14 follow-up: a batcher routing the prefix-hit suffix
+        replay through the ONE-pass q_offset window program
+        (``suffix_wide=True``) serves the same transcripts as the
+        per-token teacher-forced replay, hit for hit."""
+        eng = InferStep(_make_net(0, prefix="pfx_wide_"), max_len=64)
+        bat = ContinuousBatcher(eng, (8,), slots=2, max_new_tokens=6,
+                                page_size=4, iter_tokens=2,
+                                max_prefix_tokens=16, prefix_cache=True,
+                                suffix_wide=True, warmup=True,
+                                name="pfx-wide")
+        try:
+            prompt = [4, 12, 9, 33, 6]
+            turn1 = _serve(bat, prompt)
+            assert bat.cache.has_root(prompt)
+            base = bat.prefix_stats()
+            turn2 = _serve(bat, prompt, prefix=turn1)
+            assert bat.prefix_stats()["hits"] == base["hits"] + 1
+            # same weights, wide replay vs the cold teacher-forced path
+            assert turn1 == _serve(cold_batcher, prompt)
+            assert turn2 == _serve(cold_batcher, prompt, prefix=turn1)
+            hist = turn1 + turn2
+            assert _serve(bat, prompt, prefix=hist) \
+                == _serve(cold_batcher, prompt, prefix=hist)
+            _settled_audit(bat)
+            assert eng.compile_guard.steady_state_recompiles == 0
+        finally:
+            bat.stop()
+
 
 class _StubBatcher:
     """Placement-only batcher stub: no engine, records submits."""

@@ -386,6 +386,40 @@ def _print_prefix_section(report_path):
               "MXTPU_PREFIX_CACHE=0 to reclaim pool pages)")
 
 
+def _print_spec_section(report_path):
+    """Surface the speculative-decoding slice of the ``infer/`` family
+    (per-round accepted-draft length, draft-dispatch latency, and
+    whether the Pallas paged flash kernels are active) from a
+    ``report.json`` snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    hists = {k: v for k, v in report.get("histograms", {}).items()
+             if k in ("infer/spec_accept_len", "infer/spec_draft_ms")}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k == "infer/flash_kernel"}
+    if not hists and not gauges:
+        return
+    print("\n== Speculative decoding ==")
+    for k in sorted(gauges):
+        on = "on (Pallas paged flash)" if gauges[k] else "off (dense)"
+        print(f"  {k:<38} {on}")
+    for k in sorted(hists):
+        h = hists[k]
+        print(f"  {k:<38} p50={h.get('p50')} p95={h.get('p95')} "
+              f"n={h.get('count')}")
+    acc = hists.get("infer/spec_accept_len")
+    if acc and acc.get("count") and acc.get("sum", 0.0) == 0.0:
+        print("  WARNING: the draft model's proposals are NEVER accepted "
+              "— the target re-scores every token and speculation only "
+              "adds draft latency; check that the draft tracks the "
+              "target (same tokenizer/data) or lower MXTPU_SPEC_K")
+
+
 def _print_shard_family(report_path):
     """Surface the ``shard/`` metric family (SPMD sharding spine: mesh
     shape, global vs per-shard parameter bytes, collective-traffic
@@ -462,6 +496,7 @@ def main(argv=None):
         _print_compile_family(os.path.join(directory, "report.json"))
         _print_infer_family(os.path.join(directory, "report.json"))
         _print_prefix_section(os.path.join(directory, "report.json"))
+        _print_spec_section(os.path.join(directory, "report.json"))
         _print_shard_family(os.path.join(directory, "report.json"))
         _print_serve_family(os.path.join(directory, "report.json"))
         _print_transport_family(os.path.join(directory, "report.json"))
