@@ -1,0 +1,369 @@
+"""Project call graph + per-function summaries — mxlint's interprocedural
+layer (the jump lock-order made per-class, generalized across classes).
+
+PR 9's passes are per-function/per-module; the serving plane's hardest
+bug class is cross-function: a page acquired in ``_stage_slot`` leaking
+on an exception three frames up, a worker-verb error path that never
+fails the future it registered. In the spirit of compositional analyses
+(RacerD/Pulse), this module computes cheap per-function *summaries* and
+composes them over a resolved call graph instead of exploring paths:
+
+- ``TypeTable`` — best-effort nominal types: ``self.X = ClassName(...)``
+  constructor assignments, ``self.X = param`` where the ``__init__``
+  parameter is annotated, and ``-> ClassName`` return annotations. Enough
+  to resolve ``self._client.submit(...)`` -> ``RpcClient.submit`` and
+  ``self._peer(addr).call(...)`` -> ``RpcClient.call``.
+- ``FnInfo`` — per-function exception structure: every node's enclosing
+  ``try`` chain (try-body nesting only: handlers/else/finally re-raise
+  past their own clauses) and whether a raise at a node is consumed
+  inside the function (a handler "consumes" only if its clause matches —
+  broadly, or by exception class name — AND its body never re-raises).
+- ``ProjectGraph`` — the composition: one node per (class, method) over
+  ``AstIndex.classes_in`` (plus module-level functions), call edges
+  resolved through the type table, ``threading.Thread(target=self.X)``
+  worker entries, and a ``may_raise`` interprocedural fixed point whose
+  base facts are explicit ``raise`` statements plus attribute-matched
+  contract raisers (``adopt_ref``/``cache_acquire``/fault-point
+  ``fire``/``Thread.start``/...). ``escaping_points`` lists the concrete
+  statements where an exception can leave a function — the exception
+  edges the resource-leak dataflow runs over.
+
+Resolution limits (deliberate, documented): locals are untyped unless
+bound by an annotated parameter; unresolved external calls are assumed
+non-raising unless attribute-matched. Precise enough for this package's
+code shapes, cheap enough for tier-1.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from . import ast_driver as _ad
+
+# (owner, function): owner is a class name, or the module's repo-relative
+# path for module-level functions (class names never contain "/").
+NodeKey = Tuple[str, str]
+
+# attribute-name-matched calls that raise by contract, receiver-agnostic:
+# PagePool adoption and prefix-trie refcounts raise on misuse, frame
+# unpack raises on torn pushes, sharded checkpoint load raises on missing
+# shards, Thread.start raises at spawn limits, and armed fault points
+# raise FaultInjected — the deterministic "this can fail here" markers
+# the serving plane is built around.
+RAISING_ATTRS = frozenset({
+    "adopt_ref", "cache_acquire", "cache_release", "unpack_frames",
+    "load_sharded", "start",
+})
+RAISING_DOTTED_SUFFIXES = ("faults.fire",)
+
+BUILTIN_ITER_FNS = frozenset({"zip", "enumerate", "list", "sorted",
+                              "reversed", "tuple", "set"})
+
+
+def str_arg(call: ast.Call, i: int = 0) -> Optional[str]:
+    """The i-th positional argument when it is a string literal."""
+    if len(call.args) > i and isinstance(call.args[i], ast.Constant) \
+            and isinstance(call.args[i].value, str):
+        return call.args[i].value
+    return None
+
+
+def kwarg(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def receiver_name(expr) -> Optional[str]:
+    """Normalized receiver of a method call: ``self.pool`` -> "pool",
+    ``self.a.b`` -> "a.b", bare ``name`` -> "name"."""
+    d = _ad.dotted(expr)
+    if d is None:
+        return None
+    return d[5:] if d.startswith("self.") else d
+
+
+def handler_catches(handler: ast.ExceptHandler,
+                    exc_name: Optional[str]) -> bool:
+    """True when this handler fully consumes an exception of (possibly
+    unknown) class ``exc_name``: the clause matches — broadly, or by the
+    raised class's bare name — AND the body never re-raises."""
+    for stmt in _ad.walk_statements(handler.body):
+        if isinstance(stmt, ast.Raise):
+            return False
+    t = handler.type
+    if t is None:
+        return True
+    elts = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in elts:
+        d = _ad.dotted(e)
+        base = d.rsplit(".", 1)[-1] if d else None
+        if base in ("Exception", "BaseException"):
+            return True
+        if exc_name is not None and base == exc_name:
+            return True
+    return False
+
+
+class FnInfo:
+    """Per-function exception structure: the enclosing-``try`` chain of
+    every node in THIS frame (nested def/lambda bodies raise at call
+    time, not here, and are excluded)."""
+
+    def __init__(self, fn):
+        self.fn = fn
+        self.nodes: List[ast.AST] = []
+        self.enclosing: Dict[int, Tuple[ast.Try, ...]] = {}
+        self._visit(fn, ())
+
+    def _visit(self, node, stack):
+        self.nodes.append(node)
+        self.enclosing[id(node)] = stack
+        if isinstance(node, ast.Try):
+            for c in node.body:
+                self._visit(c, stack + (node,))
+            for c in node.orelse:
+                self._visit(c, stack)
+            for h in node.handlers:
+                for c in h.body:
+                    self._visit(c, stack)
+            for c in node.finalbody:
+                self._visit(c, stack)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not self.fn:
+            return
+        for c in ast.iter_child_nodes(node):
+            self._visit(c, stack)
+
+    def tries_of(self, node) -> Tuple[ast.Try, ...]:
+        return self.enclosing.get(id(node), ())
+
+    def caught(self, node, exc_name: Optional[str] = None) -> bool:
+        """True when an exception raised at ``node`` is consumed inside
+        this function (some enclosing try has a matching, non-re-raising
+        handler)."""
+        return any(handler_catches(h, exc_name)
+                   for t in self.tries_of(node) for h in t.handlers)
+
+    def calls(self) -> List[ast.Call]:
+        return [n for n in self.nodes if isinstance(n, ast.Call)]
+
+
+class TypeTable:
+    """Nominal attr/return types over a class set (see module doc)."""
+
+    def __init__(self, classes: Dict[str, _ad.ClassModel]):
+        self.classes = classes
+        self.attr_class: Dict[Tuple[str, str], str] = {}
+        self.attr_ctor: Dict[Tuple[str, str], ast.Call] = {}
+        self.returns: Dict[Tuple[str, str], str] = {}
+        for cname, model in classes.items():
+            for mname, (fn, _mod) in model.methods.items():
+                self._scan_method(cname, mname, fn)
+
+    def _known(self, expr) -> Optional[str]:
+        d = _ad.dotted(expr) if expr is not None else None
+        base = d.rsplit(".", 1)[-1] if d else None
+        return base if base in self.classes else None
+
+    def _scan_method(self, cname, mname, fn):
+        ret = self._known(fn.returns)
+        if ret:
+            self.returns[(cname, mname)] = ret
+        ann: Dict[str, str] = {}
+        for a in fn.args.args + fn.args.kwonlyargs:
+            t = self._known(a.annotation)
+            if t:
+                ann[a.arg] = t
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                attr = _ad.self_attr(t)
+                if attr is None:
+                    continue
+                if isinstance(node.value, ast.Call):
+                    self.attr_ctor.setdefault((cname, attr), node.value)
+                    known = self._known(node.value.func)
+                    if known:
+                        self.attr_class.setdefault((cname, attr), known)
+                elif isinstance(node.value, ast.Name) and \
+                        node.value.id in ann:
+                    self.attr_class.setdefault((cname, attr),
+                                               ann[node.value.id])
+
+    def expr_class(self, owner: Optional[str], expr) -> Optional[str]:
+        """Best-effort class of ``expr`` inside ``owner``'s methods."""
+        if isinstance(expr, ast.Name):
+            return owner if expr.id == "self" else None
+        if isinstance(expr, ast.Attribute):
+            base = self.expr_class(owner, expr.value)
+            if base is None:
+                return None
+            return self.attr_class.get((base, expr.attr))
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute):
+                fowner = self.expr_class(owner, f.value)
+                if fowner is not None:
+                    return self.returns.get((fowner, f.attr))
+            return None
+        return None
+
+    def ctor_dotted(self, cls: str, attr: str) -> Optional[str]:
+        call = self.attr_ctor.get((cls, attr))
+        return _ad.dotted(call.func) if call is not None else None
+
+
+class FnNode:
+    """One call-graph node: a method (owner = class name) or module
+    function (owner = module path)."""
+
+    __slots__ = ("key", "owner", "name", "fn", "module", "info", "calls")
+
+    def __init__(self, owner: str, name: str, fn, module):
+        self.key: NodeKey = (owner, name)
+        self.owner = owner
+        self.name = name
+        self.fn = fn
+        self.module = module
+        self.info = FnInfo(fn)
+        # (ast.Call, resolved callee NodeKey or None), filled by the graph
+        self.calls: List[Tuple[ast.Call, Optional[NodeKey]]] = []
+
+
+class ProjectGraph:
+    """The composed interprocedural model over a module set."""
+
+    def __init__(self, index: _ad.AstIndex, rel_paths: Sequence[str],
+                 raising_attrs=RAISING_ATTRS):
+        self.index = index
+        self.rel_paths = [p.replace("\\", "/") for p in rel_paths]
+        self.modules = [index.module(p) for p in self.rel_paths]
+        self.classes = index.classes_in(self.rel_paths)
+        self.types = TypeTable(self.classes)
+        self.raising_attrs = set(raising_attrs)
+        self.nodes: Dict[NodeKey, FnNode] = {}
+        for cname, model in self.classes.items():
+            for mname, (fn, mod) in model.methods.items():
+                self.nodes[(cname, mname)] = FnNode(cname, mname, fn, mod)
+        for mod in self.modules:
+            for fname, fn in mod.functions.items():
+                self.nodes[(mod.path, fname)] = FnNode(mod.path, fname,
+                                                       fn, mod)
+        self.callers: Dict[NodeKey, List[Tuple[NodeKey, ast.Call]]] = {}
+        self.thread_entries: Set[NodeKey] = set()
+        self._resolve()
+        self._base_escapes: Dict[NodeKey, list] = {}
+        self._may_raise: Dict[NodeKey, bool] = {}
+        self._fixed_point()
+
+    # ------------------------------------------------------------ resolution
+    def resolve_call(self, owner_cls: Optional[str], module,
+                     call: ast.Call) -> Optional[NodeKey]:
+        f = call.func
+        if isinstance(f, ast.Name):
+            if f.id in module.functions:
+                return (module.path, f.id)
+            return None
+        if isinstance(f, ast.Attribute):
+            if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and owner_cls is not None:
+                model = self.classes.get(owner_cls)
+                if model is not None and f.attr in model.methods:
+                    return (owner_cls, f.attr)
+                return None
+            t = self.types.expr_class(owner_cls, f.value)
+            if t is not None:
+                model = self.classes.get(t)
+                if model is not None and f.attr in model.methods:
+                    return (t, f.attr)
+        return None
+
+    def _resolve(self):
+        for node in self.nodes.values():
+            owner_cls = node.owner if node.owner in self.classes else None
+            for c in node.info.calls():
+                callee = self.resolve_call(owner_cls, node.module, c)
+                node.calls.append((c, callee))
+                if callee is not None:
+                    self.callers.setdefault(callee, []).append(
+                        (node.key, c))
+                if _ad.dotted(c.func) == "threading.Thread":
+                    tgt = kwarg(c, "target")
+                    t = _ad.self_attr(tgt) if tgt is not None else None
+                    if t is not None and owner_cls is not None and \
+                            (owner_cls, t) in self.nodes:
+                        self.thread_entries.add((owner_cls, t))
+                    elif isinstance(tgt, ast.Name) and \
+                            (node.module.path, tgt.id) in self.nodes:
+                        self.thread_entries.add((node.module.path, tgt.id))
+
+    def callers_of(self, key: NodeKey):
+        return self.callers.get(key, [])
+
+    # ------------------------------------------------------------ may-raise
+    def _raise_sources(self, node: FnNode):
+        """(ast node, exc class name or None, description) for every
+        potential raise point in the function's own frame."""
+        out = []
+        for n in node.info.nodes:
+            if isinstance(n, ast.Raise):
+                e = n.exc
+                d = None
+                if isinstance(e, ast.Call):
+                    d = _ad.dotted(e.func)
+                elif e is not None:
+                    d = _ad.dotted(e)
+                name = d.rsplit(".", 1)[-1] if d else None
+                out.append((n, name,
+                            f"raise {name}" if name else "re-raise"))
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute):
+                d = _ad.dotted(n.func) or ""
+                if n.func.attr in self.raising_attrs:
+                    out.append((n, None,
+                                f".{n.func.attr}(...) raises by contract"))
+                elif d.endswith(RAISING_DOTTED_SUFFIXES):
+                    out.append((n, None, f"{d}(...) fault point"))
+        return out
+
+    def _fixed_point(self):
+        for key, node in self.nodes.items():
+            self._base_escapes[key] = [
+                (n, name, desc) for n, name, desc
+                in self._raise_sources(node)
+                if not node.info.caught(n, name)]
+            self._may_raise[key] = bool(self._base_escapes[key])
+        changed = True
+        while changed:
+            changed = False
+            for key, node in self.nodes.items():
+                if self._may_raise[key]:
+                    continue
+                for c, callee in node.calls:
+                    if callee is not None and self._may_raise.get(callee) \
+                            and not node.info.caught(c):
+                        self._may_raise[key] = True
+                        changed = True
+                        break
+
+    def may_raise(self, key: NodeKey) -> bool:
+        return self._may_raise.get(key, False)
+
+    def escaping_points(self, key: NodeKey):
+        """Concrete points where an exception may leave this function:
+        [(lineno, description, ast node)], source order. Own raise
+        sources plus un-caught calls into may-raise callees."""
+        node = self.nodes[key]
+        out = [(n.lineno, desc, n)
+               for n, _name, desc in self._base_escapes.get(key, [])]
+        for c, callee in node.calls:
+            if callee is not None and self.may_raise(callee) \
+                    and not node.info.caught(c):
+                out.append((c.lineno,
+                            f"{callee[0]}.{callee[1]}() may raise", c))
+        return sorted(out, key=lambda e: e[0])

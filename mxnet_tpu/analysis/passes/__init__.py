@@ -1,6 +1,6 @@
 """Pass modules: importing this package registers every pass.
 
-Current roster (3 ported + 4 new + 2 consistency):
+Current roster (3 ported + 4 new + 2 consistency + 3 interprocedural):
 
 ========================  =====  ==========================================
 pass                      IR     what it guards
@@ -23,7 +23,21 @@ pass                      IR     what it guards
                                  docs/ENV_VARS.md (and vice versa)
 ``telemetry-names``       meta   every emitted metric family known to
                                  tools/telemetry_report.py
+``resource-leak``         ast    pool pages / trie refcounts / disagg
+                                 baton / futures released on every path
+                                 incl. exception edges; stash entries
+                                 expire (interprocedural)
+``rpc-protocol``          ast    worker verb table vs every call site:
+                                 handlers exist, reply keys cover reads,
+                                 timeouts everywhere, fault reachability
+``swap-barrier``          ast    stage-all dominates every flip over the
+                                 same engine set; no registration window
+                                 between stage and flip
 ========================  =====  ==========================================
+
+The last three share the interprocedural layer in
+``mxnet_tpu/analysis/callgraph.py`` (project call graph + per-function
+exception summaries over ``AstIndex``).
 """
 
 from . import no_sync  # noqa: F401
@@ -35,3 +49,6 @@ from . import recompile  # noqa: F401
 from . import collectives  # noqa: F401
 from . import env_vars  # noqa: F401
 from . import telemetry_names  # noqa: F401
+from . import resource_leak  # noqa: F401
+from . import rpc_protocol  # noqa: F401
+from . import swap_barrier  # noqa: F401

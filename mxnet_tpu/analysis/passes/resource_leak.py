@@ -1,0 +1,378 @@
+"""resource-leak pass: a linear must-release dataflow over the serving
+plane's exception edges, composed interprocedurally (callgraph.py).
+
+Resources tracked (the PR 11/12 bug shapes):
+
+- **pool pages** — ``PagePool.alloc``/``adopt_ref``/``ensure`` acquire
+  pages into a slot; ``release``/``reset`` give them back. An exception
+  that escapes between acquire and release — across any number of
+  calls — leaves the pages owned by a dead admission (the PR 12 re-key
+  refcount bug shape).
+- **prefix-trie refcounts** — ``cache_acquire`` pins a page for the
+  radix trie; ``cache_release``/``flush`` unpin.
+- **the disagg baton** — a ``queue.Queue(maxsize=1)`` ownership token:
+  a ``get`` that an exception can bypass before the matching ``put``
+  deadlocks every later prefill (the PR 11 baton protocol).
+- **futures** — a ``GenerationResult`` bound from ``.submit(...)`` or
+  constructed directly must be failed on every error path after it
+  exists; an escaping raise that no handler converts into
+  ``fut._fail(...)`` strands the caller until its deadline
+  (rule ``future-path``).
+- **HandoffStash entries** — structural: a ``*Stash`` buffer with
+  ``put``/``pop`` must consult a clock (TTL) somewhere, or entries whose
+  ``kv_push`` landed but whose ``submit`` never arrives survive until
+  capacity eviction (rule ``stash-expiry``).
+
+Rules ``leak-on-raise`` (pool/cache/baton): an acquire followed — before
+the matching same-receiver release — by a statement where an exception
+escapes the function creates an *obligation* unless an enclosing
+``finally``/handler releases the receiver. Obligations propagate up the
+resolved call graph; a call site consumed by a broad non-re-raising
+handler (e.g. ``except Exception: self._poison(e)``) discharges them.
+Obligations still held at a root — a thread entry or a function with no
+in-graph callers — are findings, fingerprinted at the ACQUIRE site.
+
+Limitations (deliberate): linear statement order per function (no path
+sensitivity); a named handler that releases discharges even though it
+may not catch every class; broad handlers without an explicit release
+discharge too (the error path was designed — ``_adopt``'s re-prefill
+fallback keeps its slot pages on purpose). Violations the model cannot
+prove safe belong in the baseline with a reason.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisPass, register
+from .. import ast_driver as _ad
+from .. import callgraph as _cg
+
+MODULES = (
+    "mxnet_tpu/serving/batcher.py",
+    "mxnet_tpu/serving/pages.py",
+    "mxnet_tpu/serving/prefix.py",
+    "mxnet_tpu/serving/router.py",
+    "mxnet_tpu/serving/watcher.py",
+    "mxnet_tpu/serving/worker.py",
+    "mxnet_tpu/serving/remote.py",
+    "mxnet_tpu/serving/disagg.py",
+    "mxnet_tpu/serving/transport.py",
+    "mxnet_tpu/serving/faults.py",
+    "tools/launch.py",
+)
+
+# kind -> (acquire attrs, release attrs)
+KINDS = {
+    "pool-page": (("alloc", "adopt_ref", "ensure"),
+                  ("release", "reset")),
+    "cache-ref": (("cache_acquire",),
+                  ("cache_release", "flush", "reset")),
+    "baton": (("get", "get_nowait"), ("put", "put_nowait")),
+}
+
+FUTURE_CTORS = {"GenerationResult"}
+CLOCK_MARKS = ("monotonic", "perf_counter", "time.time")
+
+
+class Obligation:
+    """One unreleased acquire that an exception edge can bypass."""
+
+    __slots__ = ("kind", "recv", "origin", "acquire_line", "escape_line",
+                 "why")
+
+    def __init__(self, kind, recv, origin, acquire_line, escape_line,
+                 why):
+        self.kind = kind
+        self.recv = recv
+        self.origin = origin          # NodeKey of the acquiring function
+        self.acquire_line = acquire_line
+        self.escape_line = escape_line
+        self.why = why
+
+    def ident(self):
+        return (self.kind, self.recv, self.origin, self.acquire_line)
+
+
+def _release_calls(node, kind, recv):
+    rel = KINDS[kind][1]
+    return [n for n in node.info.calls()
+            if isinstance(n.func, ast.Attribute) and n.func.attr in rel
+            and _cg.receiver_name(n.func.value) == recv]
+
+
+def _acquire_sites(graph, node):
+    """(kind, recv, call) acquire sites in one function, excluding the
+    resource-defining class's own internals (receiver ``self``)."""
+    owner = node.owner if node.owner in graph.classes else None
+    out = []
+    for n in node.info.calls():
+        f = n.func
+        if not isinstance(f, ast.Attribute):
+            continue
+        if isinstance(f.value, ast.Name) and f.value.id == "self":
+            continue  # PagePool/PrefixCache internals manage themselves
+        recv = _cg.receiver_name(f.value)
+        if recv is None:
+            continue
+        if f.attr in KINDS["pool-page"][0]:
+            t = graph.types.expr_class(owner, f.value)
+            if t == "PagePool" or recv.split(".")[-1].endswith("pool"):
+                out.append(("pool-page", recv, n))
+        elif f.attr in KINDS["cache-ref"][0]:
+            out.append(("cache-ref", recv, n))
+        elif f.attr in KINDS["baton"][0] and owner is not None \
+                and "." not in recv:
+            ctor = graph.types.attr_ctor.get((owner, recv))
+            if ctor is None or _ad.dotted(ctor.func) != "queue.Queue":
+                continue
+            size = _cg.kwarg(ctor, "maxsize")
+            if size is None and ctor.args:
+                size = ctor.args[0]
+            if isinstance(size, ast.Constant) and size.value == 1:
+                out.append(("baton", recv, n))
+    return out
+
+
+def _protected(node, src, kind, recv):
+    """True when some enclosing try's ``finally`` (or a handler) releases
+    the receiver — the raise still escapes, but the resource does not."""
+    rel = KINDS[kind][1]
+    for t in node.info.tries_of(src):
+        bodies = [t.finalbody] + [h.body for h in t.handlers]
+        for body in bodies:
+            for stmt in _ad.walk_statements(body):
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Attribute) and \
+                            n.func.attr in rel and \
+                            _cg.receiver_name(n.func.value) == recv:
+                        return True
+    return False
+
+
+def _function_obligations(graph, node):
+    out = []
+    acquires = _acquire_sites(graph, node)
+    if not acquires:
+        return out
+    points = graph.escaping_points(node.key)
+    for kind, recv, call in acquires:
+        rels = sorted(n.lineno for n in _release_calls(node, kind, recv)
+                      if n.lineno > call.lineno)
+        window_end = rels[0] if rels else float("inf")
+        for ln, desc, src in points:
+            if src is call or ln <= call.lineno or ln > window_end:
+                continue
+            if _protected(node, src, kind, recv):
+                continue
+            out.append(Obligation(kind, recv, node.key, call.lineno,
+                                  ln, desc))
+            break
+    return out
+
+
+def _propagate(graph, seeds):
+    """Push obligations up the caller graph; a call site whose enclosing
+    handler consumes the exception discharges them. Returns
+    {NodeKey: {Obligation ident: Obligation}}."""
+    held: Dict[_cg.NodeKey, Dict[tuple, Obligation]] = {}
+    for key, obs in seeds.items():
+        held.setdefault(key, {})
+        for ob in obs:
+            held[key][ob.ident()] = ob
+    changed = True
+    while changed:
+        changed = False
+        for key in list(held):
+            for caller_key, call in graph.callers_of(key):
+                caller = graph.nodes[caller_key]
+                if caller.info.caught(call):
+                    continue  # handled edge: obligation discharged
+                bucket = held.setdefault(caller_key, {})
+                for ident, ob in held[key].items():
+                    if ident not in bucket:
+                        bucket[ident] = ob
+                        changed = True
+    return held
+
+
+def _leak_findings(graph, held):
+    """Obligations still held at a root (thread entry / no callers)."""
+    out = {}
+    for key, obs in held.items():
+        is_root = key in graph.thread_entries \
+            or not graph.callers_of(key)
+        if not is_root:
+            continue
+        for ob in obs.values():
+            origin = graph.nodes[ob.origin]
+            entry = out.setdefault(ob.ident(), (ob, origin, []))
+            entry[2].append(f"{key[0]}.{key[1]}")
+    findings = []
+    for ob, origin, roots in out.values():
+        findings.append((
+            origin.module.path, ob.acquire_line,
+            f"{origin.owner}.{origin.name}", ob.kind, ob.recv,
+            f"{ob.kind} acquired via {ob.recv!r} at "
+            f"{origin.owner}.{origin.name}:{ob.acquire_line} can leak: "
+            f"an exception escaping at line {ob.escape_line} "
+            f"({ob.why}) reaches {', '.join(sorted(set(roots)))} with "
+            f"no release on the unwind path"))
+    return findings
+
+
+def _fails_future(node, src, futname):
+    """True when some enclosing try has a handler that resolves the
+    future (``fut._fail``/``set_exception``) — re-raising after is fine,
+    the caller-visible contract is kept."""
+    for t in node.info.tries_of(src):
+        for h in t.handlers:
+            for stmt in _ad.walk_statements(h.body):
+                for n in ast.walk(stmt):
+                    if isinstance(n, ast.Call) and \
+                            isinstance(n.func, ast.Attribute) and \
+                            n.func.attr in ("_fail", "set_exception") and \
+                            isinstance(n.func.value, ast.Name) and \
+                            n.func.value.id == futname:
+                        return True
+    return False
+
+
+def _benign_raises(fn, futname):
+    """Raise statements inside an except-handler that already resolved
+    the future (``fut._fail(e); raise``): the caller-visible contract is
+    kept — propagating the error upward on top of it is fine."""
+    out = set()
+    for h in (n for n in ast.walk(fn)
+              if isinstance(n, ast.ExceptHandler)):
+        fails = any(
+            isinstance(n, ast.Call)
+            and isinstance(n.func, ast.Attribute)
+            and n.func.attr in ("_fail", "set_exception")
+            and isinstance(n.func.value, ast.Name)
+            and n.func.value.id == futname
+            for n in ast.walk(h))
+        if fails:
+            out.update(id(n) for n in ast.walk(h)
+                       if isinstance(n, ast.Raise))
+    return out
+
+
+def _future_findings(graph):
+    out = []
+    for key, node in graph.nodes.items():
+        binds = []  # (name, line)
+        for n in node.info.nodes:
+            if isinstance(n, ast.Assign) and \
+                    isinstance(n.value, ast.Call) and \
+                    len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name):
+                f = n.value.func
+                d = _ad.dotted(f) or ""
+                is_submit = isinstance(f, ast.Attribute) \
+                    and f.attr == "submit" \
+                    and (graph.types.expr_class(
+                        key[0] if key[0] in graph.classes else None,
+                        f.value) == "RpcClient"
+                        or (_cg.receiver_name(f.value) or "")
+                        .split(".")[-1].endswith("client")
+                        or (_cg.receiver_name(f.value) or "")
+                        .split(".")[-1].endswith("batcher"))
+                is_ctor = d.rsplit(".", 1)[-1] in FUTURE_CTORS
+                if is_submit or is_ctor:
+                    binds.append((n.targets[0].id, n.lineno))
+        if key in graph.thread_entries:
+            for a in node.fn.args.args:
+                if a.arg in ("fut", "future"):
+                    binds.append((a.arg, node.fn.lineno))
+        if not binds:
+            continue
+        points = graph.escaping_points(key)
+        for futname, bline in binds:
+            benign = _benign_raises(node.fn, futname)
+            for ln, desc, src in points:
+                if ln <= bline:
+                    continue
+                if id(src) in benign or node.info.caught(src) or \
+                        _fails_future(node, src, futname):
+                    continue
+                out.append((
+                    node.module.path, ln,
+                    f"{node.owner}.{node.name}", futname,
+                    f"{node.owner}.{node.name} holds future "
+                    f"{futname!r} (bound at line {bline}) but an "
+                    f"exception escaping at line {ln} ({desc}) never "
+                    f"fails it — the caller waits until its deadline"))
+                break
+    return out
+
+
+def _stash_findings(graph, rel_set):
+    out = []
+    for cname, model in sorted(graph.classes.items()):
+        if not cname.endswith("Stash") or \
+                model.module.path not in rel_set:
+            continue
+        put = model.method("put")
+        pop = model.method("pop")
+        if put is None or pop is None:
+            continue
+        clocked = False
+        for fn in (put, pop, model.method("__init__")):
+            if fn is None:
+                continue
+            for n in ast.walk(fn):
+                d = _ad.dotted(n) if isinstance(n, (ast.Attribute,
+                                                    ast.Name)) else None
+                if d and any(m in d for m in CLOCK_MARKS):
+                    clocked = True
+        if not clocked:
+            out.append((
+                model.module.path, model.node.lineno, cname,
+                f"{cname}.put/pop never consult a clock: an entry whose "
+                f"consumer died survives until capacity eviction — add "
+                f"a TTL purge (expired entries are re-prefilled "
+                f"anyway)"))
+    return out
+
+
+def analyze(index: _ad.AstIndex, rel_paths=MODULES):
+    """Returns (leaks, futures, stashes); see the tuple layouts in the
+    ``_*_findings`` helpers. The seeded-control entry point."""
+    graph = _cg.ProjectGraph(index, rel_paths)
+    seeds = {}
+    for key, node in graph.nodes.items():
+        obs = _function_obligations(graph, node)
+        if obs:
+            seeds[key] = obs
+    held = _propagate(graph, seeds)
+    return (_leak_findings(graph, held), _future_findings(graph),
+            _stash_findings(graph, set(graph.rel_paths)))
+
+
+@register
+class ResourceLeakPass(AnalysisPass):
+    name = "resource-leak"
+    ir = "ast"
+    description = ("pool pages / trie refcounts / disagg baton / futures "
+                   "released on every path incl. exception edges; stash "
+                   "entries expire")
+
+    def run(self, ctx):
+        findings = []
+        leaks, futures, stashes = analyze(ctx.ast)
+        for path, line, where, kind, recv, msg in leaks:
+            findings.append(self.finding(
+                "leak-on-raise", path, line,
+                key=f"{where}:{kind}:{recv}", message=msg))
+        for path, line, where, futname, msg in futures:
+            findings.append(self.finding(
+                "future-path", path, line, key=f"{where}:{futname}",
+                message=msg))
+        for path, line, cname, msg in stashes:
+            findings.append(self.finding(
+                "stash-expiry", path, line, key=f"{cname}:no-expiry",
+                message=msg))
+        return findings

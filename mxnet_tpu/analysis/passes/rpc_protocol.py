@@ -1,0 +1,459 @@
+"""rpc-protocol pass: the worker wire protocol checked as data.
+
+The verb table is EXTRACTED from ``serving/worker.py``'s ``RpcServer``
+dispatch dict, the reply schemas from every ``respond(...)`` reachable
+from each handler (following ``respond`` through self-calls and
+``threading.Thread(target=self.X, args=(..., respond))`` relay threads),
+and the consumption side from every ``RpcClient.call``/``submit`` site
+in the serving plane — then the two sides are checked against each
+other:
+
+- **orphan-verb** — a production call site sends a verb no handler
+  serves (the error surfaces at runtime as an ``unknown verb`` frame).
+- **dead-verb** — a handler no caller anywhere (serving, tools,
+  benchmarks, tests) exercises: dead protocol surface.
+- **missing-reply-key** — a caller subscripts/``get``\\ s a key the
+  handler never responds (including reads through a stored probe dict,
+  e.g. ``self._probe_info``). The ``submit`` stream's consumer is the
+  transport's ``_route``, so its reads come from there. The converse
+  direction — keys responded but never read — is computed (``unread``)
+  for tests/tools but NOT reported: ack fields (``pushed``,
+  ``drained``...) are deliberate wire documentation.
+- **missing-timeout** — a ``.call(...)`` site with no ``timeout_s=``
+  whose receiver does not resolve to a client class carrying a default
+  timeout (``self.timeout_s`` in ``__init__``): a hung peer would hang
+  the caller forever.
+- **unreachable-fault** — every verb must be reachable from a fault
+  point: the shared ``transport.send``/``transport.recv`` pair on the
+  frame path, or a verb-specific one (``transport.kv_push``) —
+  otherwise the chaos suite cannot kill it, so its failure path is
+  untested by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..core import AnalysisPass, register
+from .. import ast_driver as _ad
+from .. import callgraph as _cg
+
+SERVER_MODULES = (
+    "mxnet_tpu/serving/worker.py",
+    "mxnet_tpu/serving/transport.py",
+)
+CLIENT_MODULES = (
+    "mxnet_tpu/serving/worker.py",
+    "mxnet_tpu/serving/transport.py",
+    "mxnet_tpu/serving/remote.py",
+    "mxnet_tpu/serving/router.py",
+    "mxnet_tpu/serving/watcher.py",
+    "mxnet_tpu/serving/disagg.py",
+    "tools/launch.py",
+)
+
+# frame-envelope keys owned by the transport, not the verb payloads
+PROTOCOL_KEYS = frozenset({"id", "ok", "done", "error", "nbin", "verb"})
+
+
+def _dict_str_keys(d: ast.Dict) -> Optional[Set[str]]:
+    keys = set()
+    for k in d.keys:
+        if isinstance(k, ast.Constant) and isinstance(k.value, str):
+            keys.add(k.value)
+        else:
+            return None  # **spread or computed key: open schema
+    return keys
+
+
+def _verb_table(classes, rel_set):
+    """{verb: (owner class, handler method, path, line)} from every
+    ``RpcServer({...})`` dict-literal construction in the module set."""
+    verbs = {}
+    for cname, model in classes.items():
+        if model.module.path not in rel_set:
+            continue
+        for mname, (fn, mod) in model.methods.items():
+            for call in (n for n in ast.walk(fn)
+                         if isinstance(n, ast.Call)):
+                d = _ad.dotted(call.func) or ""
+                if d.rsplit(".", 1)[-1] != "RpcServer" or not call.args \
+                        or not isinstance(call.args[0], ast.Dict):
+                    continue
+                for k, v in zip(call.args[0].keys, call.args[0].values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)):
+                        continue
+                    h = _ad.self_attr(v)
+                    if h is not None and h in model.methods:
+                        verbs[k.value] = (cname, h, mod.path, k.lineno)
+    return verbs
+
+
+def _respond_keys(model, start) -> Optional[Set[str]]:
+    """Reply keys a handler (and the self-calls / relay threads it hands
+    ``respond`` to) can send; None = open schema (``respond(**opaque)``)."""
+    keys: Set[str] = set()
+    seen: Set[str] = set()
+    stack = [start]
+    while stack:
+        mname = stack.pop()
+        if mname in seen:
+            continue
+        seen.add(mname)
+        fn = model.method(mname)
+        if fn is None:
+            continue
+        local_dicts = {}
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                    isinstance(n.targets[0], ast.Name) and \
+                    isinstance(n.value, ast.Dict):
+                local_dicts[n.targets[0].id] = n.value
+        for call in (n for n in ast.walk(fn) if isinstance(n, ast.Call)):
+            f = call.func
+            if isinstance(f, ast.Name) and f.id == "respond":
+                for kw in call.keywords:
+                    if kw.arg is not None:
+                        keys.add(kw.arg)
+                        continue
+                    v = kw.value
+                    if isinstance(v, ast.Name) and v.id in local_dicts:
+                        v = local_dicts[v.id]
+                    got = _dict_str_keys(v) \
+                        if isinstance(v, ast.Dict) else None
+                    if got is None:
+                        return None
+                    keys |= got
+                continue
+            # forwarding: respond handed to a self-call or relay thread
+            mentions = any(
+                isinstance(n, ast.Name) and n.id == "respond"
+                for a in (list(call.args)
+                          + [kw.value for kw in call.keywords])
+                for n in ast.walk(a))
+            if not mentions:
+                continue
+            cand = _ad.self_attr(f)
+            if cand is not None and cand in model.methods:
+                stack.append(cand)
+            tgt = _cg.kwarg(call, "target")
+            t = _ad.self_attr(tgt) if tgt is not None else None
+            if t is not None and t in model.methods:
+                stack.append(t)
+    return keys
+
+
+def _route_reads(classes) -> Set[str]:
+    """Keys the transport's response router reads from reply frames —
+    the consumer of the ``submit`` verb's stream."""
+    out: Set[str] = set()
+    model = classes.get("RpcClient")
+    fn = model.method("_route") if model is not None else None
+    if fn is None:
+        return out
+    args = fn.args.args
+    msg = args[1].arg if len(args) > 1 else None
+    if msg is None:
+        return out
+    for key, _ln in _reads_of_name(fn, msg):
+        out.add(key)
+    return out - PROTOCOL_KEYS
+
+
+def _reads_of_name(fn, name) -> List[Tuple[str, int]]:
+    """String-keyed reads of local ``name``: ``name["k"]`` and
+    ``name.get("k", ...)``."""
+    out = []
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Subscript) and \
+                isinstance(n.value, ast.Name) and n.value.id == name and \
+                isinstance(n.slice, ast.Constant) and \
+                isinstance(n.slice.value, str):
+            out.append((n.slice.value, n.lineno))
+        elif isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "get" and \
+                isinstance(n.func.value, ast.Name) and \
+                n.func.value.id == name:
+            k = _cg.str_arg(n)
+            if k is not None:
+                out.append((k, n.lineno))
+    return out
+
+
+def _attr_reads(model) -> Dict[str, List[Tuple[str, int]]]:
+    """Class-wide string-keyed reads of ``self.X`` dicts (the stored
+    health-probe pattern: ``self._probe_info.get("queue_depth")``)."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for mname, (fn, _mod) in model.methods.items():
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Subscript) and \
+                    isinstance(n.slice, ast.Constant) and \
+                    isinstance(n.slice.value, str):
+                attr = _ad.self_attr(n.value)
+                if attr is not None:
+                    out.setdefault(attr, []).append(
+                        (n.slice.value, n.lineno))
+            elif isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr == "get":
+                attr = _ad.self_attr(n.func.value)
+                k = _cg.str_arg(n)
+                if attr is not None and k is not None:
+                    out.setdefault(attr, []).append((k, n.lineno))
+    return out
+
+
+def _timeout_ok(types, owner, call) -> bool:
+    if _cg.kwarg(call, "timeout_s") is not None or \
+            _cg.kwarg(call, "timeout") is not None:
+        return True
+    recv = call.func.value
+    t = types.expr_class(owner, recv)
+    if t is not None:
+        model = types.classes.get(t)
+        init = model.method("__init__") if model is not None else None
+        if init is not None:
+            for n in ast.walk(init):
+                if isinstance(n, ast.Assign) and any(
+                        _ad.self_attr(tg) == "timeout_s"
+                        for tg in n.targets):
+                    return True
+        return False
+    # unresolved receiver: trust the repo's naming convention — RPC
+    # clients are held in attrs/properties named *client
+    name = (_cg.receiver_name(recv) or "").split(".")[-1]
+    return name.endswith("client")
+
+
+def _send_sites(graph, rel_set):
+    """Every verb send in the client scope:
+    (verb, path, line, where, timeout_ok, ast.Call)."""
+    out = []
+    for key, node in graph.nodes.items():
+        if node.module.path not in rel_set:
+            continue
+        owner = key[0] if key[0] in graph.classes else None
+        if owner == "RpcClient":
+            continue  # the protocol plumbing itself
+        for call in node.info.calls():
+            f = call.func
+            if not isinstance(f, ast.Attribute):
+                continue
+            if f.attr == "call":
+                verb = _cg.str_arg(call)
+                if verb is None:
+                    continue
+                out.append((verb, node.module.path, call.lineno,
+                            f"{key[0]}.{key[1]}",
+                            _timeout_ok(graph.types, owner, call), call))
+            elif f.attr == "submit":
+                t = graph.types.expr_class(owner, f.value)
+                name = (_cg.receiver_name(f.value) or "").split(".")[-1]
+                if t == "RpcClient" or name.endswith("client"):
+                    out.append(("submit", node.module.path, call.lineno,
+                                f"{key[0]}.{key[1]}", True, call))
+    return out
+
+
+def _reads_for_sends(graph, node, sends_in_fn, class_attr_reads):
+    """Reply keys each verb-send's result is read for, within the
+    sending function — through a local binding and through a stored
+    ``self.X = result`` dict."""
+    fn = graph.nodes[node].fn if isinstance(node, tuple) else node
+    reads: Dict[str, List[Tuple[str, int]]] = {}
+    calls_by_id = {id(c): v for v, c in sends_in_fn}
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.Assign) or len(n.targets) != 1:
+            continue
+        if id(n.value) in calls_by_id and \
+                isinstance(n.targets[0], ast.Name):
+            verb = calls_by_id[id(n.value)]
+            local = n.targets[0].id
+            for key, ln in _reads_of_name(fn, local):
+                reads.setdefault(verb, []).append((key, ln))
+            # stored result: self.X = local -> class-wide reads of X
+            for m in ast.walk(fn):
+                if isinstance(m, ast.Assign) and \
+                        isinstance(m.value, ast.Name) and \
+                        m.value.id == local:
+                    for tg in m.targets:
+                        attr = _ad.self_attr(tg)
+                        if attr is not None:
+                            for key, ln in class_attr_reads.get(attr, []):
+                                reads.setdefault(verb, []).append(
+                                    (key, ln))
+    # direct subscript on the call result: X.call("v")["k"]
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Subscript) and id(n.value) in calls_by_id \
+                and isinstance(n.slice, ast.Constant) \
+                and isinstance(n.slice.value, str):
+            reads.setdefault(calls_by_id[id(n.value)], []).append(
+                (n.slice.value, n.lineno))
+    return reads
+
+
+def _fault_points(index, rel_paths) -> Set[str]:
+    fires = set()
+    for p in rel_paths:
+        mod = index.module(p)
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call):
+                d = _ad.dotted(n.func) or ""
+                if d.endswith("faults.fire") or d == "_faults.fire" or \
+                        (isinstance(n.func, ast.Attribute)
+                         and n.func.attr == "fire"
+                         and "fault" in d):
+                    tag = _cg.str_arg(n)
+                    if tag is not None:
+                        fires.add(tag)
+    return fires
+
+
+def _verbs_sent_in(index, rel_paths) -> Set[str]:
+    """Verbs sent anywhere in extra module sets (tests/benchmarks) —
+    the liveness scan for dead-verb."""
+    out = set()
+    for p in rel_paths:
+        try:
+            mod = index.module(p)
+        except (OSError, SyntaxError):
+            continue
+        for n in ast.walk(mod.tree):
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("call", "submit"):
+                v = _cg.str_arg(n) if n.func.attr == "call" else "submit"
+                if v is not None:
+                    out.add(v)
+    return out
+
+
+def analyze(index: _ad.AstIndex, server_paths=SERVER_MODULES,
+            client_paths=CLIENT_MODULES, liveness_paths=()):
+    """Cross-check the protocol; returns a dict of facts + violations
+    (the seeded-control entry point)."""
+    all_paths = list(dict.fromkeys(list(server_paths)
+                                   + list(client_paths)))
+    graph = _cg.ProjectGraph(index, all_paths)
+    server_set = set(server_paths)
+    client_set = set(client_paths)
+
+    verbs = _verb_table(graph.classes, server_set)
+    responds: Dict[str, Optional[Set[str]]] = {}
+    for verb, (cname, handler, _p, _ln) in verbs.items():
+        responds[verb] = _respond_keys(graph.classes[cname], handler)
+
+    sends = _send_sites(graph, client_set)
+    route_reads = _route_reads(graph.classes)
+
+    # reads per verb, attributed to concrete sites
+    reads: Dict[str, List[Tuple[str, str, int]]] = {}
+    per_fn: Dict[_cg.NodeKey, list] = {}
+    for verb, path, line, where, tok, call in sends:
+        key = tuple(where.split(".", 1))
+        per_fn.setdefault(key, []).append((verb, call))
+    attr_reads_cache: Dict[str, Dict] = {}
+    for key, pairs in per_fn.items():
+        node = graph.nodes.get(key)
+        if node is None:
+            continue
+        owner = key[0]
+        if owner in graph.classes and owner not in attr_reads_cache:
+            attr_reads_cache[owner] = _attr_reads(graph.classes[owner])
+        got = _reads_for_sends(graph, node.fn, pairs,
+                               attr_reads_cache.get(owner, {}))
+        for verb, pairs2 in got.items():
+            for k, ln in pairs2:
+                reads.setdefault(verb, []).append(
+                    (k, node.module.path, ln))
+    if "submit" in {v for v, *_ in sends} or "submit" in verbs:
+        for k in sorted(route_reads):
+            reads.setdefault("submit", []).append(
+                (k, "mxnet_tpu/serving/transport.py", 0))
+
+    sent_verbs = {v for v, *_ in sends}
+    live_verbs = sent_verbs | _verbs_sent_in(index, liveness_paths)
+    fires = _fault_points(index, all_paths)
+
+    orphans = [(v, p, ln, where) for v, p, ln, where, _t, _c in sends
+               if v not in verbs]
+    dead = sorted(v for v in verbs if v not in live_verbs)
+    missing_timeout = [(v, p, ln, where)
+                       for v, p, ln, where, tok, _c in sends if not tok]
+    missing_reply = []
+    unread: Dict[str, List[str]] = {}
+    for verb, keys in responds.items():
+        got = {k for k, _p, _ln in reads.get(verb, [])} - PROTOCOL_KEYS
+        if keys is None:
+            continue  # open schema: nothing to prove
+        for k, p, ln in reads.get(verb, []):
+            if k not in keys and k not in PROTOCOL_KEYS:
+                missing_reply.append((verb, k, p, ln))
+        extra = sorted(keys - got - PROTOCOL_KEYS)
+        if extra:
+            unread[verb] = extra
+    transport_faults = {"transport.send", "transport.recv"} <= fires
+    unreachable_fault = sorted(
+        v for v in verbs
+        if not transport_faults and f"transport.{v}" not in fires)
+
+    return {
+        "verbs": verbs, "responds": responds, "reads": reads,
+        "sends": [(v, p, ln, where, tok)
+                  for v, p, ln, where, tok, _c in sends],
+        "orphans": orphans, "dead": dead,
+        "missing_reply": missing_reply, "unread": unread,
+        "missing_timeout": missing_timeout,
+        "unreachable_fault": unreachable_fault, "fault_points": fires,
+    }
+
+
+@register
+class RpcProtocolPass(AnalysisPass):
+    name = "rpc-protocol"
+    ir = "ast"
+    description = ("worker verb table vs every call site: handlers "
+                   "exist, reply keys cover reads, timeouts everywhere, "
+                   "fault-point reachability")
+
+    def run(self, ctx):
+        facts = analyze(
+            ctx.ast,
+            liveness_paths=tuple(ctx.ast.package_files("tests",
+                                                       "benchmarks")))
+        findings = []
+        table_path = next(iter(facts["verbs"].values()))[2] \
+            if facts["verbs"] else SERVER_MODULES[0]
+        for verb, path, ln, where in facts["orphans"]:
+            findings.append(self.finding(
+                "orphan-verb", path, ln, key=f"{where}:{verb}",
+                message=f"{where} sends verb {verb!r} but no RpcServer "
+                        f"handler serves it"))
+        for verb in facts["dead"]:
+            _c, _h, path, ln = facts["verbs"][verb]
+            findings.append(self.finding(
+                "dead-verb", path, ln, key=verb,
+                message=f"verb {verb!r} has a handler but no caller "
+                        f"anywhere (serving plane, tools, benchmarks, "
+                        f"tests): dead protocol surface"))
+        for verb, k, path, ln in facts["missing_reply"]:
+            findings.append(self.finding(
+                "missing-reply-key", path, ln, key=f"{verb}:{k}",
+                message=f"a {verb!r} caller reads reply key {k!r} that "
+                        f"the handler never responds — schema drift"))
+        for verb, path, ln, where in facts["missing_timeout"]:
+            findings.append(self.finding(
+                "missing-timeout", path, ln, key=f"{where}:{verb}",
+                message=f"{where} sends {verb!r} with no timeout_s= and "
+                        f"no client-default timeout: a hung peer hangs "
+                        f"the caller forever"))
+        for verb in facts["unreachable_fault"]:
+            findings.append(self.finding(
+                "unreachable-fault", table_path, 1, key=verb,
+                message=f"verb {verb!r} is not reachable from any fault "
+                        f"point (transport.send/recv or its own): its "
+                        f"failure path cannot be chaos-tested"))
+        return findings

@@ -1,0 +1,264 @@
+"""swap-barrier pass: stage-all must dominate every flip.
+
+The two-phase weight swap (PR 4/8/11) has one invariant: a flip —
+``eng.swap_params(staged=...)``, ``eng.swap_staged(version)``, or the
+``swap`` verb — may only execute after the **stage** phase completed
+over the **same engine set**, and if any stage fails, no engine flips
+(else replicas diverge mid-fleet and batches mix weight versions).
+
+Checked on the watcher/worker/router call graph:
+
+- **flip-before-stage** — a coordinator function (one containing both
+  stage and flip sites) whose first flip precedes its last stage in
+  program order: the barrier is structurally inverted.
+- **stage-fallthrough** — a stage site inside a ``try`` whose handler
+  neither returns nor raises: a stage failure falls through into the
+  flip phase and flips a partially-staged fleet.
+- **stale-engine-set** — a flip loop iterating a sequence that is not
+  provably the staged snapshot: the iterable must be assigned from an
+  expression containing a stage call (or be a builtin re-iteration such
+  as ``zip(local, staged)`` over such names) after function entry; a
+  re-read of ``self._engines_fn()`` between stage and flip would admit
+  a replica registered mid-swap without re-staging.
+- **barrier-unlocked** — a coordinator that is neither ``*_locked``
+  (caller-holds-lock convention, PR 9) nor holds a ``with self.<lock>``
+  around both phases: registration can interleave between the phases.
+- **unguarded-flip** — a non-coordinator method that flips without
+  proof of prior staging: no ``staged is None -> raise/return`` guard
+  on the value it flips with. Protocol forwarders (``swap_staged`` /
+  ``swap_params`` themselves) and boot-time adoption
+  (``swap_params(arrays=...)``) are exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..core import AnalysisPass, register
+from .. import ast_driver as _ad
+from .. import callgraph as _cg
+
+MODULES = (
+    "mxnet_tpu/serving/watcher.py",
+    "mxnet_tpu/serving/worker.py",
+    "mxnet_tpu/serving/remote.py",
+    "mxnet_tpu/serving/router.py",
+    "tools/launch.py",
+)
+
+STAGE_ATTRS = frozenset({"stage_params", "stage_checkpoint"})
+FLIP_ATTRS = frozenset({"swap_staged"})
+FORWARDERS = frozenset({"swap_staged", "swap_params"})
+LOCK_CTORS = frozenset({"Lock", "RLock", "Condition"})
+
+
+def _is_stage(call: ast.Call) -> bool:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        if f.attr in STAGE_ATTRS:
+            return True
+        if f.attr == "call" and _cg.str_arg(call) == "stage":
+            return True
+    return False
+
+
+def _is_flip(call: ast.Call) -> Optional[str]:
+    """None, or why this call is a flip (for messages)."""
+    f = call.func
+    if not isinstance(f, ast.Attribute):
+        return None
+    if f.attr in FLIP_ATTRS:
+        return f.attr
+    if f.attr == "call" and _cg.str_arg(call) == "swap":
+        return 'call("swap")'
+    if f.attr == "swap_params":
+        # staged= is a flip of pre-staged values; arrays= is boot-time
+        # adoption (stage+flip fused on a fresh process, exempt); bare
+        # calls are forwarding shims handled by the forwarder exemption.
+        if _cg.kwarg(call, "staged") is not None:
+            return "swap_params(staged=...)"
+        return None
+    return None
+
+
+def _stage_names(fn) -> Set[str]:
+    """Local names bound (directly or via builtin re-iteration) to a
+    stage result or to the engine snapshot a stage loop consumed."""
+    out: Set[str] = set()
+    # names assigned FROM an expression containing a stage call
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign):
+            has_stage = any(_is_stage(c) for c in ast.walk(n.value)
+                            if isinstance(c, ast.Call))
+            if has_stage:
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    # names a stage for-loop iterated over: `for eng in local:
+    #     eng.stage_params(...)` marks `local` as staged
+    for n in ast.walk(fn):
+        if isinstance(n, ast.For):
+            has_stage = any(_is_stage(c) for c in ast.walk(n)
+                            if isinstance(c, ast.Call))
+            if has_stage and isinstance(n.iter, ast.Name):
+                out.add(n.iter.id)
+    # comprehension form: `staged = [e.stage_params(...) for e in local]`
+    for n in ast.walk(fn):
+        if isinstance(n, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            has_stage = any(_is_stage(c) for c in ast.walk(n)
+                            if isinstance(c, ast.Call))
+            if has_stage:
+                for gen in n.generators:
+                    if isinstance(gen.iter, ast.Name):
+                        out.add(gen.iter.id)
+    return out
+
+
+def _iter_names(expr) -> Optional[List[str]]:
+    """The Name components of a flip loop's iterable; None if it calls
+    anything that could refresh the engine set (non-builtin call)."""
+    names: List[str] = []
+    fn_names = set()
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Call):
+            f = n.func
+            ok = isinstance(f, ast.Name) and f.id in _cg.BUILTIN_ITER_FNS
+            if not ok:
+                return None
+            fn_names.add(id(f))
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and id(n) not in fn_names:
+            names.append(n.id)
+    return names
+
+
+def _holds_lock(fn, types, owner) -> bool:
+    if fn.name.endswith("_locked"):
+        return True
+    for n in ast.walk(fn):
+        if isinstance(n, ast.With):
+            for item in n.items:
+                attr = _ad.self_attr(item.context_expr)
+                if attr is None:
+                    continue
+                d = types.ctor_dotted(owner, attr) if owner else None
+                if d is not None and d.rsplit(".", 1)[-1] in LOCK_CTORS:
+                    return True
+    return False
+
+
+def _guarded(fn, value_expr, flip_line) -> bool:
+    """Is there an `if <value> is None: raise/return` (or truthiness
+    equivalent) before the flip line, over the flipped value?"""
+    d = _ad.dotted(value_expr)
+    if d is None:
+        return False
+    for n in ast.walk(fn):
+        if not isinstance(n, ast.If) or n.lineno > flip_line:
+            continue
+        test = n.test
+        names = {_ad.dotted(c) for c in ast.walk(test)}
+        if d not in names:
+            continue
+        for stmt in _ad.walk_statements(n.body):
+            if isinstance(stmt, (ast.Raise, ast.Return)):
+                return True
+    return False
+
+
+def analyze(index: _ad.AstIndex, rel_paths: Sequence[str] = MODULES):
+    """Returns [(rule, path, line, key, message)] — the seeded-control
+    entry point."""
+    graph = _cg.ProjectGraph(index, rel_paths)
+    out: List[Tuple[str, str, int, str, str]] = []
+
+    for key, node in graph.nodes.items():
+        fn = node.fn
+        owner = key[0] if key[0] in graph.classes else None
+        where = f"{key[0]}.{key[1]}"
+        path = node.module.path
+        stages = [c for c in node.info.calls() if _is_stage(c)]
+        flips = [(c, why) for c in node.info.calls()
+                 if (why := _is_flip(c))]
+        if not flips:
+            continue
+
+        if stages:  # coordinator: owns the barrier
+            first_flip = min(c.lineno for c, _w in flips)
+            last_stage = max(c.lineno for c in stages)
+            if first_flip < last_stage:
+                out.append((
+                    "flip-before-stage", path, first_flip, where,
+                    f"{where} flips at line {first_flip} before the "
+                    f"stage phase completes (last stage at line "
+                    f"{last_stage}): barrier inverted"))
+            for c in stages:
+                for t in node.info.tries_of(c):
+                    for h in t.handlers:
+                        aborts = any(
+                            isinstance(s, (ast.Return, ast.Raise))
+                            for s in _ad.walk_statements(h.body))
+                        if not aborts:
+                            out.append((
+                                "stage-fallthrough", path, h.lineno,
+                                f"{where}:{h.lineno}",
+                                f"{where}: stage failure handler at "
+                                f"line {h.lineno} neither returns nor "
+                                f"raises — a failed stage falls "
+                                f"through to the flip phase"))
+            staged = _stage_names(fn)
+            for n in ast.walk(fn):
+                if not isinstance(n, ast.For):
+                    continue
+                loop_flips = [c for c in ast.walk(n)
+                              if isinstance(c, ast.Call) and _is_flip(c)]
+                if not loop_flips:
+                    continue
+                names = _iter_names(n.iter)
+                bad = names is None or any(nm not in staged
+                                           for nm in names)
+                if bad:
+                    out.append((
+                        "stale-engine-set", path, n.lineno,
+                        f"{where}:{n.lineno}",
+                        f"{where}: flip loop at line {n.lineno} "
+                        f"iterates a set not provably the staged "
+                        f"snapshot — an engine registered mid-swap "
+                        f"would flip without staging"))
+            if not _holds_lock(fn, graph.types, owner):
+                out.append((
+                    "barrier-unlocked", path, fn.lineno, where,
+                    f"{where} coordinates stage+flip without holding "
+                    f"a lock (and is not *_locked): registration can "
+                    f"interleave between the phases"))
+        else:  # flip with no local stage: forwarder or guarded shim
+            if key[1] in FORWARDERS:
+                continue
+            for c, why in flips:
+                val = _cg.kwarg(c, "staged")
+                if val is not None and _guarded(fn, val, c.lineno):
+                    continue
+                if val is None and isinstance(c.func, ast.Attribute) \
+                        and _guarded(fn, c.func.value, c.lineno):
+                    continue
+                out.append((
+                    "unguarded-flip", path, c.lineno,
+                    f"{where}:{why}",
+                    f"{where} flips ({why}) with no local stage and no "
+                    f"`is None -> raise/return` guard on the staged "
+                    f"value: nothing proves staging happened"))
+    return out
+
+
+@register
+class SwapBarrierPass(AnalysisPass):
+    name = "swap-barrier"
+    ir = "ast"
+    description = ("no flip unless dominated by stage-all over the "
+                   "same engine set; no registration between stage "
+                   "and flip")
+
+    def run(self, ctx):
+        return [self.finding(rule, path, line, key=key, message=msg)
+                for rule, path, line, key, msg in analyze(ctx.ast)]

@@ -1012,24 +1012,28 @@ class ContinuousBatcher(_BatcherBase):
         if self._pending:
             self._pending = collections.deque(
                 self._expire(list(self._pending)))
-        self._retire()
-        admitted = self._admit()
-        live = [i for i, s in enumerate(self._slots)
-                if s is not None and not s.finished]
-        if not live:
-            return admitted > 0
-        self._ensure_capacity(live)
-        live = [i for i, s in enumerate(self._slots)
-                if s is not None and not s.finished]
-        if not live:
-            return True
-        t0 = time.perf_counter()
         try:
+            # the whole iteration is one poison domain: an exception
+            # anywhere (a partial admit that staged pages, a prefix
+            # insert mid-refcount, a collect on poisoned state) must
+            # release every page and fail every slot, not kill the
+            # scheduler thread with pages still referenced.
+            self._retire()
+            admitted = self._admit()
+            live = [i for i, s in enumerate(self._slots)
+                    if s is not None and not s.finished]
+            if not live:
+                return admitted > 0
+            self._ensure_capacity(live)
+            live = [i for i, s in enumerate(self._slots)
+                    if s is not None and not s.finished]
+            if not live:
+                return True
+            t0 = time.perf_counter()
             out = self._dispatch(live)
+            self._collect(live, out, t0)
         except Exception as e:  # noqa: BLE001 - fail the slots, not the thread
             self._poison(e)
-            return True
-        self._collect(live, out, t0)
         return True
 
     def _retire(self):

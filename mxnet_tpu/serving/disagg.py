@@ -55,11 +55,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..base import MXNetError
+from .. import telemetry as _tel
 from . import pages as _pages
 
-__all__ = ["worker_role", "kv_spill_dir", "PrefillEngine", "pack_frames",
-           "unpack_frames", "spill_frames", "load_spilled", "HandoffStash",
-           "frame_bytes"]
+__all__ = ["worker_role", "kv_spill_dir", "handoff_ttl_s", "PrefillEngine",
+           "pack_frames", "unpack_frames", "spill_frames", "load_spilled",
+           "HandoffStash", "frame_bytes"]
 
 ROLES = ("both", "prefill", "decode")
 
@@ -83,6 +84,20 @@ def kv_spill_dir() -> Optional[str]:
     fleets where workers cannot dial each other."""
     v = os.environ.get("MXTPU_KV_SPILL_DIR", "").strip()
     return v or None
+
+
+def handoff_ttl_s(default: float = 120.0) -> float:
+    """``MXTPU_HANDOFF_TTL_S``: how long pushed KV frames may sit in the
+    decode worker's ``HandoffStash`` before they expire (seconds; 0
+    disables the TTL). A push whose matching ``submit`` never arrives —
+    router died between push and submit, caller gave up — would
+    otherwise pin its KV bytes until capacity eviction; expiry costs
+    nothing (an expired handoff re-prefills from the prompt)."""
+    v = os.environ.get("MXTPU_HANDOFF_TTL_S", "").strip()
+    try:
+        return float(v) if v else default
+    except ValueError:
+        return default
 
 
 # ------------------------------------------------------------------ frames
@@ -185,33 +200,57 @@ class HandoffStash:
 
     ``kv_push`` handlers (transport connection threads) ``put`` frames
     keyed by handoff id; the matching ``submit`` handler ``pop``s them.
-    Bounded: past ``capacity`` entries the OLDEST is dropped (its request
-    re-prefills — a stash can never grow without bound behind a router
-    that crashed between push and submit). Every touch holds the stash
-    lock; nothing blocking runs under it."""
+    Bounded two ways: past ``capacity`` entries the OLDEST is dropped,
+    and an entry older than ``ttl_s`` (``MXTPU_HANDOFF_TTL_S``) expires
+    on the next touch — a push whose submit never arrives (router died
+    between the two, caller abandoned the request) must not pin KV
+    bytes until 64 later pushes shove it out. Either way the request
+    re-prefills; nothing is lost. Every touch holds the stash lock;
+    nothing blocking runs under it."""
 
-    def __init__(self, capacity: int = 64):
+    def __init__(self, capacity: int = 64, ttl_s: Optional[float] = None):
         self.capacity = int(capacity)
+        self.ttl_s = handoff_ttl_s() if ttl_s is None else float(ttl_s)
         self._lock = threading.Lock()
         self._frames: Dict[str, dict] = {}
+        self._stamp: Dict[str, float] = {}
         self._order: List[str] = []
         self.dropped = 0
+        self.expired = 0
+
+    def _expire_locked(self, now: float) -> None:
+        if self.ttl_s <= 0:
+            return
+        stale = [h for h in self._order
+                 if now - self._stamp.get(h, now) > self.ttl_s]
+        for h in stale:
+            self._order.remove(h)
+            self._frames.pop(h, None)
+            self._stamp.pop(h, None)
+            self.expired += 1
+            _tel.registry().counter("disagg/stash_expired").inc()
 
     def put(self, handoff: str, frames: dict) -> None:
+        now = time.monotonic()
         with self._lock:
+            self._expire_locked(now)
             if handoff not in self._frames:
                 self._order.append(handoff)
             self._frames[handoff] = frames
+            self._stamp[handoff] = now
             while len(self._order) > self.capacity:
                 old = self._order.pop(0)
                 self._frames.pop(old, None)
+                self._stamp.pop(old, None)
                 self.dropped += 1
 
     def pop(self, handoff: str) -> Optional[dict]:
         with self._lock:
+            self._expire_locked(time.monotonic())
             frames = self._frames.pop(handoff, None)
             if frames is not None:
                 self._order.remove(handoff)
+                self._stamp.pop(handoff, None)
             return frames
 
     def __len__(self) -> int:

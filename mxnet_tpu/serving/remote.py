@@ -275,11 +275,16 @@ class RemoteReplica(Replica):
         fut = GenerationResult()
         deadline_at = None if deadline_ms is None \
             else time.perf_counter() + float(deadline_ms) / 1e3
-        threading.Thread(
-            target=self._disagg_handoff,
-            args=(prefill_rep, prompt_ids, max_new_tokens, deadline_at,
-                  klass, fut),
-            name=f"mxtpu-disagg-{self.name}", daemon=True).start()
+        try:
+            threading.Thread(
+                target=self._disagg_handoff,
+                args=(prefill_rep, prompt_ids, max_new_tokens,
+                      deadline_at, klass, fut),
+                name=f"mxtpu-disagg-{self.name}", daemon=True).start()
+        except Exception as e:  # noqa: BLE001 - no thread, no handoff
+            if not fut.done():
+                fut._fail(e)
+            raise
         return fut
 
     def _disagg_handoff(self, prefill_rep, prompt_ids, max_new,
@@ -312,9 +317,18 @@ class RemoteReplica(Replica):
                 fut._fail(self._dead_error_instance(
                     "deadline passed during the KV handoff"))
                 return
-        self._client.submit(prompt_ids, max_new,
-                            deadline_ms=remaining_ms, extra=extra,
-                            future=fut)
+        try:
+            self._client.submit(prompt_ids, max_new,
+                                deadline_ms=remaining_ms, extra=extra,
+                                future=fut)
+        except BaseException as e:  # noqa: BLE001 - last holder of fut
+            # this thread is the only code that will ever touch `fut`
+            # again: if the wire submit itself dies (dead socket, frame
+            # encode error), failing the future here is the difference
+            # between an immediate caller error and a silent hang until
+            # the caller's deadline.
+            if not fut.done():
+                fut._fail(e)
 
     def _dead_error_instance(self, msg: str):
         from .batcher import DeadlineExceeded

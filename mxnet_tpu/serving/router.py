@@ -477,19 +477,28 @@ class Router:
         r = _Routed(prompt_ids, max_new_tokens, deadline, outer,
                     klass=klass, prefix=prefix, digest=digest)
         _tel.registry().counter("serve/requests").inc()
-        with self._lock:
-            shed = self._shed_reason_locked(r)
-            if shed is not None:
-                kind, parts = shed
-            elif not self._assign_locked(r) \
-                    and not self._may_recover_locked():
-                outer._fail(RuntimeError(
-                    "no healthy replicas and no replica_factory — "
-                    "request cannot be placed"))
-                return outer
-            else:
-                self._inflight.append(r)
-                return outer
+        try:
+            with self._lock:
+                shed = self._shed_reason_locked(r)
+                if shed is not None:
+                    kind, parts = shed
+                elif not self._assign_locked(r) \
+                        and not self._may_recover_locked():
+                    outer._fail(RuntimeError(
+                        "no healthy replicas and no replica_factory — "
+                        "request cannot be placed"))
+                    return outer
+                else:
+                    self._inflight.append(r)
+                    return outer
+        except Exception as e:  # noqa: BLE001 - r may already be placed
+            # _assign_locked can raise AFTER handing r to a replica: the
+            # replica's relay thread then holds `outer` and would feed a
+            # future whose submit-side caller never saw — fail it so
+            # every holder observes the same error instead of a hang.
+            if not outer.done():
+                outer._fail(e)
+            raise
         msg = "; ".join(parts)  # formatted OUTSIDE the router lock
         reg = _tel.registry()
         reg.counter(f"serve/shed_{kind}").inc()

@@ -352,15 +352,23 @@ class ServingWorker:
             prompt, msg.get("max_new_tokens"),
             deadline_ms=msg.get("deadline_ms"), frames=frames,
             prefix_ids=msg.get("prefix_ids"))
-        t = threading.Thread(target=self._stream_result,
-                             args=(fut, respond),
-                             name="mxtpu-worker-stream", daemon=True)
-        with self._lock:
-            self._streamers.append(t)
-            if len(self._streamers) > 64:
-                self._streamers = [s for s in self._streamers
-                                   if s.is_alive()]
-        t.start()
+        try:
+            t = threading.Thread(target=self._stream_result,
+                                 args=(fut, respond),
+                                 name="mxtpu-worker-stream", daemon=True)
+            with self._lock:
+                self._streamers.append(t)
+                if len(self._streamers) > 64:
+                    self._streamers = [s for s in self._streamers
+                                       if s.is_alive()]
+            t.start()
+        except Exception as e:  # noqa: BLE001 - fail the row, answer the peer
+            # without this, a thread-spawn failure leaves a future whose
+            # tokens nobody will ever stream and the caller camped on
+            # its deadline: fail it, then let _dispatch answer ok=False.
+            if not fut.done():
+                fut._fail(e)
+            raise
 
     def _stream_result(self, fut, respond):
         """Relay one request's token stream, then its final frame — runs

@@ -171,6 +171,56 @@ class TestFrames:
         assert stash.pop("c") == {"x": 3}
         assert stash.dropped == 1 and len(stash) == 0
 
+    def test_stash_ttl_expires_unclaimed_entries(self, monkeypatch):
+        """ISSUE 15 regression (mxlint resource-leak.stash-expiry): a
+        push whose submit never arrives must expire on the next touch,
+        not pin KV bytes until 64 later pushes shove it out."""
+        import types
+
+        from mxnet_tpu.serving import disagg as _disagg_mod
+        now = [100.0]
+        monkeypatch.setattr(_disagg_mod, "time",
+                            types.SimpleNamespace(monotonic=lambda: now[0]))
+        stash = HandoffStash(capacity=8, ttl_s=5.0)
+        stash.put("a", {"x": 1})
+        now[0] += 2.0
+        stash.put("b", {"x": 2})
+        now[0] += 4.0  # "a" is now 6 s old (expired); "b" 4 s (alive)
+        assert stash.pop("a") is None
+        assert stash.pop("b") == {"x": 2}
+        assert stash.expired == 1 and len(stash) == 0
+        # a re-put refreshes the stamp: the entry survives a further wait
+        stash.put("c", {"x": 3})
+        now[0] += 4.0
+        stash.put("c", {"x": 33})
+        now[0] += 4.0  # 8 s since first put, 4 s since refresh
+        assert stash.pop("c") == {"x": 33}
+        assert stash.expired == 1
+
+    def test_stash_ttl_zero_disables_expiry(self, monkeypatch):
+        import types
+
+        from mxnet_tpu.serving import disagg as _disagg_mod
+        now = [0.0]
+        monkeypatch.setattr(_disagg_mod, "time",
+                            types.SimpleNamespace(monotonic=lambda: now[0]))
+        stash = HandoffStash(capacity=4, ttl_s=0)
+        stash.put("a", {"x": 1})
+        now[0] += 1e9
+        assert stash.pop("a") == {"x": 1}
+        assert stash.expired == 0
+
+    def test_stash_ttl_env_knob(self, monkeypatch):
+        from mxnet_tpu.serving.disagg import handoff_ttl_s
+        monkeypatch.delenv("MXTPU_HANDOFF_TTL_S", raising=False)
+        assert handoff_ttl_s() == 120.0
+        assert HandoffStash().ttl_s == 120.0
+        monkeypatch.setenv("MXTPU_HANDOFF_TTL_S", "7.5")
+        assert handoff_ttl_s() == 7.5
+        assert HandoffStash().ttl_s == 7.5
+        monkeypatch.setenv("MXTPU_HANDOFF_TTL_S", "not-a-number")
+        assert handoff_ttl_s() == 120.0
+
 
 # --------------------------------------------------------------- adoption
 class TestAdoption:

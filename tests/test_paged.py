@@ -608,6 +608,43 @@ class TestPagedResilience:
             rep.batcher.pool.check_invariants(set())
         mx.telemetry.reset()
 
+    def test_admission_failure_poisons_not_kills(self, tmodel):
+        """ISSUE 15 regression (mxlint resource-leak.leak-on-raise): an
+        exception during admission — a partial ``_stage_slot`` that
+        already adopted prefix pages — must hit the poison path (fail
+        slots, reset the pool, keep the scheduler alive), not unwind the
+        thread with pages still referenced. Before the fix, _retire and
+        _admit ran OUTSIDE _step_once's try and the scheduler died."""
+        eng = InferStep(tmodel, max_len=24)
+        bat = ContinuousBatcher(eng, bucket_keys=(8,), slots=2,
+                                max_new_tokens=4, page_size=4,
+                                iter_tokens=2, warmup=True)
+        try:
+            armed = [True]
+            orig_admit = bat._admit
+
+            def flaky_admit():
+                if armed[0] and bat._pending:
+                    armed[0] = False
+                    raise RuntimeError("admission blew up")
+                return orig_admit()
+
+            bat._admit = flaky_admit
+            src = np.arange(3, 9, dtype=np.int32)
+            # first request trips the fault; poison keeps it pending, so
+            # the surviving scheduler re-admits and serves it
+            f1 = bat.submit(src)
+            r1 = f1.result(timeout=120)
+            assert isinstance(r1, list) and len(r1) == 4
+            assert not armed[0]  # the fault really fired
+            # thread survived: a second request decodes normally
+            f2 = bat.submit(src)
+            assert f2.result(timeout=120) == r1
+        finally:
+            bat.stop()
+        assert bat.pool.free_pages == bat.pool.num_pages
+        bat.pool.check_invariants(set())
+
     def test_hot_swap_with_paged_requests_in_flight(self, tmodel):
         """A weight swap between iterations: zero lost requests and both
         versions appear in the served stream."""

@@ -1125,6 +1125,143 @@ class TestCrossProcess:
             rep.batcher.stop(drain=False)
 
 
+# ----------------------------------------------- future-path regressions
+class TestFuturePathRegressions:
+    """ISSUE 15 host-level regressions for the mxlint
+    ``resource-leak.future-path`` findings: every error path that can
+    strand a ``GenerationResult`` nobody will ever resolve must fail it
+    instead — a stranded future is a caller camped on its deadline."""
+
+    def test_disagg_handoff_wire_failure_fails_the_future(self):
+        """``RemoteReplica._disagg_handoff``: the tail ``submit`` (after
+        the prefill fallback) dying on the wire must fail the future the
+        router holds, not leave it unresolved forever."""
+        import types
+
+        from mxnet_tpu.serving.batcher import GenerationResult
+
+        fut = GenerationResult()
+
+        class _DeadClient:
+            address = ("127.0.0.1", 9)
+
+            def submit(self, *a, **k):
+                raise TransportError("dead socket")
+
+        prefill_rep = types.SimpleNamespace(client=types.SimpleNamespace(
+            call=lambda *a, **k: (_ for _ in ()).throw(
+                TransportError("prefill worker gone"))))
+        me = types.SimpleNamespace(_client=_DeadClient(), name="r-dec")
+        # thread body called directly: it must swallow-and-fail, the
+        # real thread has nobody above it to catch
+        RemoteReplica._disagg_handoff(me, prefill_rep, [3, 4, 5], 4,
+                                      None, "interactive", fut)
+        assert fut.done()
+        with pytest.raises(TransportError, match="dead socket"):
+            fut.result(timeout=0)
+
+    def test_submit_disagg_thread_spawn_failure_fails_the_future(
+            self, monkeypatch):
+        """``RemoteReplica.submit_disagg``: if the handoff thread cannot
+        even start, the returned future must carry the error."""
+        import types
+
+        from mxnet_tpu.serving import remote as remote_mod
+
+        class _BoomThread:
+            def __init__(self, *a, **k):
+                pass
+
+            def start(self):
+                raise RuntimeError("can't fork")
+
+        monkeypatch.setattr(
+            remote_mod, "threading",
+            types.SimpleNamespace(Thread=_BoomThread))
+        created = []
+        real_fut = remote_mod.GenerationResult
+
+        def _capturing():
+            f = real_fut()
+            created.append(f)
+            return f
+
+        monkeypatch.setattr(remote_mod, "GenerationResult", _capturing)
+        me = types.SimpleNamespace(
+            name="r-dec",
+            _disagg_handoff=lambda *a, **k: None)
+        with pytest.raises(RuntimeError, match="can't fork"):
+            RemoteReplica.submit_disagg(me, object(), [3, 4, 5], 4)
+        assert created and created[0].done()
+        with pytest.raises(RuntimeError, match="can't fork"):
+            created[0].result(timeout=0)
+
+    def test_worker_submit_thread_spawn_failure_fails_the_future(
+            self, monkeypatch):
+        """``ServingWorker._handle_submit``: a stream-thread spawn
+        failure must fail the batcher future (and propagate so the
+        dispatch wrapper answers ok=False), not strand the row."""
+        import types
+
+        from mxnet_tpu.serving import worker as worker_mod
+
+        failed = []
+
+        class _Fut:
+            def done(self):
+                return False
+
+            def _fail(self, e):
+                failed.append(e)
+
+        fut = _Fut()
+
+        class _BoomThread:
+            def __init__(self, *a, **k):
+                pass
+
+            def start(self):
+                raise RuntimeError("no threads left")
+
+        monkeypatch.setattr(
+            worker_mod, "threading",
+            types.SimpleNamespace(Thread=_BoomThread))
+        me = types.SimpleNamespace(
+            _draining=False, role="both", name="w0",
+            batcher=types.SimpleNamespace(
+                healthy=True, submit=lambda *a, **k: fut),
+            _lock=threading.Lock(), _streamers=[],
+            _stream_result=lambda *a, **k: None)
+        with pytest.raises(RuntimeError, match="no threads left"):
+            worker_mod.ServingWorker._handle_submit(
+                me, {"prompt": [3, 4, 5], "max_new_tokens": 4},
+                lambda **k: True)
+        assert len(failed) == 1
+        assert "no threads left" in str(failed[0])
+
+    def test_router_submit_placement_raise_fails_the_future(self):
+        """``Router.submit``: ``_assign_locked`` raising AFTER the
+        request was handed to a replica must fail the outer future every
+        holder shares, not strand it."""
+        class _StubReplica:
+            name = "stub"
+
+        router = Router([_StubReplica()], start=False)
+        seen = []
+
+        def _boom(r):
+            seen.append(r)  # the replica now "holds" r (and r.outer)
+            raise RuntimeError("placement exploded")
+
+        router._shed_reason_locked = lambda r: None
+        router._assign_locked = _boom
+        with pytest.raises(RuntimeError, match="placement exploded"):
+            router.submit(np.array([3, 4, 5], np.int32), 4)
+        assert seen and seen[0].outer.done()
+        with pytest.raises(RuntimeError, match="placement exploded"):
+            seen[0].outer.result(timeout=0)
+
+
 # ------------------------------------------------------------ chaos smoke
 @pytest.mark.chaos
 def test_chaos_smoke_swap_and_failover_end_to_end(tmp_path, monkeypatch,
