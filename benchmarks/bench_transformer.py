@@ -698,6 +698,19 @@ def serve_chaos_main(args):
         except Exception:  # noqa: BLE001 - counted as lost
             errors += 1
     wall_s = time.perf_counter() - t0
+
+    # distributed-tracing tax on this same serving path: identical
+    # load with tracing forced off vs on, gated <= 2%
+    from .common import trace_overhead_fields
+
+    def _overhead_load():
+        fs = [router.submit(
+            rng.randint(3, V, (bucket,)).astype("int32"),
+            max_new_tokens=T) for _ in range(4)]
+        for f in fs:
+            f.result(timeout=120)
+
+    overhead = trace_overhead_fields(_overhead_load)
     router.stop()
     faults.clear()
 
@@ -724,14 +737,17 @@ def serve_chaos_main(args):
         "steady_state_recompiles": recompiles,
         "batch": B, "prompt_bucket": bucket, "decode_tokens": T,
     }
+    row.update(overhead)
     print(json.dumps(row))
     print(f"{n_requests} requests through swap+replica-kill: "
           f"{errors} lost, versions {versions}, "
           f"{row['serve_failovers']} failover(s), "
           f"{row['serve_retries']} retries, p99 "
-          f"{row['latency_ms_p99']} ms, {recompiles} steady recompiles")
+          f"{row['latency_ms_p99']} ms, {recompiles} steady recompiles, "
+          f"trace overhead {row['trace_overhead_pct']}%")
     ok = (errors == 0 and len(versions) >= 2 and
-          row["serve_failovers"] >= 1 and recompiles == 0)
+          row["serve_failovers"] >= 1 and recompiles == 0 and
+          row["trace_overhead_ok"] is not False)
     if not ok:
         print("FAIL: swap+failover under load must lose zero requests, "
               "serve both weight versions, evict the killed replica and "
@@ -865,6 +881,21 @@ def serve_chaos_procs_main(args):
             shed += 1
         except Exception:  # noqa: BLE001 - deadline/drop = lost
             flood_lost += 1
+
+    # trace-overhead measurement on the surviving fleet: restore the
+    # open admission phases 1+2 ran under, then identical load with
+    # tracing forced off vs on (router-side spans; gate <= 2%)
+    router.shed_queue_depth = 10 ** 6
+    from .common import trace_overhead_fields
+
+    def _overhead_load():
+        fs = [router.submit(
+            rng.randint(3, V, (bucket,)).astype("int32"),
+            max_new_tokens=T) for _ in range(4)]
+        for f in fs:
+            f.result(timeout=240)
+
+    overhead = trace_overhead_fields(_overhead_load)
     router.stop()
     reg = mx.telemetry.registry()
     shed_counted = sum(
@@ -917,6 +948,7 @@ def serve_chaos_procs_main(args):
         "steady_state_recompiles": local_recompiles,
         "batch": B, "prompt_bucket": bucket, "decode_tokens": T,
     }
+    row.update(overhead)
     print(json.dumps(row))
     print(f"{n_requests} requests through cross-process swap+SIGKILL: "
           f"{errors} lost, versions {versions}, "
@@ -924,14 +956,16 @@ def serve_chaos_procs_main(args):
           f"{row['serve_replica_restarts']} respawn(s), live fleet on "
           f"{live_versions}; flood: {served} served / {shed} shed "
           f"({shed_counted} counted), backlog max {max_backlog} <= "
-          f"{router.shed_max_queue}, drain rcs {rcs}")
+          f"{router.shed_max_queue}, drain rcs {rcs}, trace overhead "
+          f"{row['trace_overhead_pct']}%")
     ok = (errors == 0 and len(versions) >= 2
           and row["serve_failovers"] >= 1
           and live_versions == [swap_version]
           and flood_lost == 0
           and shed >= 1 and shed_counted >= shed
           and max_backlog <= router.shed_max_queue
-          and all(rc == 0 for rc in rcs))
+          and all(rc == 0 for rc in rcs)
+          and row["trace_overhead_ok"] is not False)
     shutil.rmtree(root, ignore_errors=True)
     if not ok:
         print("FAIL: cross-process chaos must lose zero requests, "
@@ -1111,6 +1145,17 @@ def disagg_main(args):
             re_prefilled += info.get("disagg_re_prefills") or 0
         out["worker_adopted"] = adopted
         out["worker_re_prefills"] = re_prefilled
+
+        # tracing tax on this fleet: identical load forced off vs on
+        from .common import trace_overhead_fields
+
+        def _overhead_load():
+            fs = [router.submit(stream[i % len(stream)]["prompt"],
+                                max_new_tokens=4) for i in range(4)]
+            for f in fs:
+                f.result(timeout=600)
+
+        out.update(trace_overhead_fields(_overhead_load))
         router.stop()
         for h in handles:
             if h.alive():
@@ -1142,6 +1187,8 @@ def disagg_main(args):
         "router_re_prefills": reg.counter("disagg/re_prefills").value,
         "slots": args.batch_size, "prompt_buckets":
             [short_bucket, bucket], "decode_tokens": T,
+        "trace_overhead_pct": disagg["trace_overhead_pct"],
+        "trace_overhead_ok": disagg["trace_overhead_ok"],
     }
     row.update(disagg_fields())
     print(json.dumps(row))
@@ -1158,7 +1205,8 @@ def disagg_main(args):
           and cosched["ttft_interactive_p95"] is not None
           and disagg["ttft_interactive_p95"]
           <= cosched["ttft_interactive_p95"]
-          and tps_ratio >= 0.9)
+          and tps_ratio >= 0.9
+          and disagg["trace_overhead_ok"] is not False)
     if not ok:
         print("FAIL: disaggregation must lose zero requests, adopt "
               "handoffs, improve interactive TTFT p95 and hold "

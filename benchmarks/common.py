@@ -173,6 +173,44 @@ def disagg_fields():
     return fields
 
 
+def trace_overhead_fields(run_fn, gate_pct=2.0, pairs=3):
+    """Measure the distributed-tracing tax on a serving workload.
+
+    Runs ``run_fn`` (a zero-arg callable driving one fixed batch of
+    load) ``pairs`` times each with tracing forced OFF and forced ON
+    (``serving.tracing.force`` — overrides ``MXTPU_TRACE`` for this
+    process), interleaved so drift hits both arms equally, and reports
+    the median-over-median overhead. Negative deltas (noise) clamp to
+    0. Null-safe: any failure returns None columns rather than killing
+    the bench row. ``trace_overhead_ok`` is the ≤``gate_pct`` gate the
+    serving rows are accepted on."""
+    fields = {"trace_overhead_pct": None, "trace_overhead_ok": None}
+    try:
+        from mxnet_tpu.serving import tracing as _tracing
+
+        offs, ons = [], []
+        try:
+            for _ in range(pairs):
+                _tracing.force(False)
+                t0 = time.perf_counter()
+                run_fn()
+                offs.append(time.perf_counter() - t0)
+                _tracing.force(True)
+                t0 = time.perf_counter()
+                run_fn()
+                ons.append(time.perf_counter() - t0)
+        finally:
+            _tracing.force(None)
+        off = statistics.median(offs)
+        on = statistics.median(ons)
+        pct = max(0.0, (on - off) / off * 100.0) if off > 0 else 0.0
+        fields["trace_overhead_pct"] = round(pct, 2)
+        fields["trace_overhead_ok"] = pct <= gate_pct
+    except Exception:  # noqa: BLE001 - tracing must never kill a bench
+        pass
+    return fields
+
+
 def run_bench(metric, unit, ceiling, step_fn, sync_fn, items_per_step,
               warmup=3, steps=20, windows=4):
     """Time ``step_fn`` and print the driver JSON line.

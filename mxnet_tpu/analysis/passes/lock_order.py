@@ -53,6 +53,7 @@ MODULES = (
     "mxnet_tpu/serving/worker.py",
     "mxnet_tpu/serving/remote.py",
     "mxnet_tpu/serving/disagg.py",
+    "mxnet_tpu/serving/tracing.py",
     "mxnet_tpu/telemetry/watchdog.py",
     "tools/launch.py",
 )

@@ -50,6 +50,7 @@ CLIENT_MODULES = (
     "mxnet_tpu/serving/router.py",
     "mxnet_tpu/serving/watcher.py",
     "mxnet_tpu/serving/disagg.py",
+    "mxnet_tpu/serving/tracing.py",
     "tools/launch.py",
 )
 

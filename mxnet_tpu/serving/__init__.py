@@ -60,6 +60,15 @@ and replica failures:
   (prefix-affinity placement, ``MXTPU_PREFIX_AFFINITY``).
 - ``faults`` plants deterministic failure points in all of the above
   (``MXTPU_FAULT_*``), so the failure paths are testable in tier-1.
+- ``tracing`` is the fleet-scope observability plane: distributed
+  request tracing (a ``request_id`` minted at ``Router.submit`` rides
+  every RPC frame; each process appends parent-linked spans to its own
+  events JSONL; ``tools/fleet_trace.py`` merges them into one
+  clock-aligned Chrome trace), a telemetry scrape/aggregation loop
+  (``FleetTelemetry`` polls each worker's ``telemetry`` verb on
+  ``MXTPU_SCRAPE_S``), and per-request SLO attribution
+  (``GenerationResult.phases`` — queue/handoff/prefill/decode/retry
+  breakdown summing to the observed end-to-end latency).
 
 Env knobs: ``MXTPU_BATCHER`` (scheduler kind, default ``continuous``),
 ``MXTPU_PAGE_SIZE``/``MXTPU_PAGES`` (KV pool geometry),
@@ -77,13 +86,16 @@ backoff base, shared with ``tools/launch.py``), ``MXTPU_SERVE_PORT`` /
 ``MXTPU_PREFIX_MAX_PAGES`` / ``MXTPU_PREFIX_MAX_ROOTS`` /
 ``MXTPU_PREFIX_AFFINITY`` / ``MXTPU_PREFIX_DIGEST_MAX`` (prefix cache +
 affinity — see ``serving.prefix``), ``MXTPU_FAULT_*`` (fault-injection
-specs — see ``serving.faults``).
+specs — see ``serving.faults``), ``MXTPU_TRACE`` / ``MXTPU_TRACE_DIR`` /
+``MXTPU_SCRAPE_S`` (fleet tracing + telemetry scraping — see
+``serving.tracing``).
 """
 
 from . import disagg
 from . import faults
 from . import pages
 from . import prefix
+from . import tracing
 from .batcher import Backpressure, ContinuousBatcher, DeadlineExceeded, \
     DynamicBatcher, GenerationResult, batcher_kind, batcher_slots, \
     batcher_timeout_ms, iter_tokens_default, make_batcher
@@ -97,6 +109,8 @@ from .router import REQUEST_CLASSES, Replica, ReplicaUnavailable, \
     Router, restart_backoff_s, retry_max, shed_max_queue, \
     shed_queue_depth, shed_wait_ms, slo_batch_ms, slo_interactive_ms
 from .remote import RemoteEngineHandle, RemoteReplica
+from .tracing import FleetTelemetry, aggregate_snapshots, \
+    estimate_offset, replay_scrapes, scrape_interval_s, trace_enabled
 from .transport import RpcClient, RpcServer, TransportError, \
     rpc_connect_s, rpc_timeout_s, serve_port
 from .watcher import CheckpointWatcher, swap_poll_s, version_for
@@ -115,4 +129,6 @@ __all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
            "slo_interactive_ms", "slo_batch_ms", "prefix", "PrefixCache",
            "prompt_digest", "prefix_cache_enabled", "prefix_max_pages",
            "prefix_max_roots", "prefix_affinity_enabled",
-           "prefix_digest_max"]
+           "prefix_digest_max", "tracing", "FleetTelemetry",
+           "aggregate_snapshots", "estimate_offset", "replay_scrapes",
+           "scrape_interval_s", "trace_enabled"]

@@ -45,6 +45,14 @@ from .. import telemetry as _tel
 from . import faults as _faults
 from . import pages as _pages
 from . import prefix as _prefix
+from . import tracing as _tracing
+
+
+def _evus(t_pc: float) -> float:
+    """Event-clock µs for a ``perf_counter`` instant (telemetry events
+    share the ``perf_counter`` timebase, so the two clocks differ only
+    by the process's event-log origin)."""
+    return _tracing.clock_us() - (time.perf_counter() - t_pc) * 1e6
 
 __all__ = ["DynamicBatcher", "ContinuousBatcher", "GenerationResult",
            "DeadlineExceeded", "Backpressure", "batcher_slots",
@@ -160,7 +168,8 @@ class GenerationResult:
 
     __slots__ = ("_event", "_tokens", "_error", "enqueued_at",
                  "queue_wait_ms", "weights_version", "replica",
-                 "_cond", "_stream", "first_token_at")
+                 "_cond", "_stream", "first_token_at",
+                 "request_id", "phases")
 
     def __init__(self):
         self._event = threading.Event()
@@ -173,6 +182,13 @@ class GenerationResult:
         self._cond = threading.Condition()
         self._stream = []
         self.first_token_at = None
+        # fleet tracing/SLO attribution: the request id minted at the
+        # router (or adopted from the RPC trace context) and the
+        # per-phase latency breakdown — every ``*_ms`` entry names a
+        # phase; the router adds ``other_ms`` so the sum equals the
+        # observed end-to-end latency exactly
+        self.request_id = None
+        self.phases = None
 
     def _stream_tokens(self, tokens):
         """Append newly emitted tokens to the live stream (scheduler
@@ -410,7 +426,8 @@ class _BatcherBase:
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
                frames: Optional[dict] = None,
-               prefix_ids=None) -> GenerationResult:
+               prefix_ids=None,
+               request_id: Optional[str] = None) -> GenerationResult:
         """Enqueue one prompt (1-D int sequence). Returns a future whose
         ``result()`` is the generated token list, trimmed at EOS and at
         the request's ``max_new_tokens`` (<= the batcher's).
@@ -433,6 +450,11 @@ class _BatcherBase:
         tokens and serves any part already in its prefix trie straight
         from cached KV pages. Only new tokens are returned. Requires a
         batcher built with ``max_prefix_tokens > 0``.
+
+        ``request_id`` tags the future (and its spans/phase breakdown)
+        with the fleet-wide trace id minted at the router; None is fine
+        for direct callers — phases still stamp, spans are just
+        unlinked.
 
         Submitting to a stopped (or crashed) batcher fails the future
         immediately with a RuntimeError — a request must never enqueue
@@ -458,6 +480,7 @@ class _BatcherBase:
                     f"prefix length {prefix.shape[0]} > batcher "
                     f"max_prefix_tokens {self.max_prefix}")
         fut = GenerationResult()
+        fut.request_id = request_id
         if not self.healthy:
             fut._fail(RuntimeError(
                 f"{self._label()} is not accepting requests (stopped, or "
@@ -646,6 +669,19 @@ class DynamicBatcher(_BatcherBase):
             emitted += n
             r.future.weights_version = version
             r.future.replica = self.name
+            r.future.phases = {
+                "queue_ms": max(r.future.queue_wait_ms, 0.0),
+                "decode_ms": dispatch_ms,
+            }
+            if _tracing.trace_enabled():
+                _tracing.span("trace.queue", _evus(r.future.enqueued_at),
+                              {"replica": self.name},
+                              request_id=r.future.request_id,
+                              end_us=_evus(t0))
+                _tracing.span("trace.decode", _evus(t0),
+                              {"replica": self.name, "tokens": n},
+                              request_id=r.future.request_id,
+                              end_us=_evus(now))
             r.future._resolve(tokens[i, :n].tolist())
             if r.future.first_token_at is not None:
                 ttft = (r.future.first_token_at
@@ -655,6 +691,9 @@ class DynamicBatcher(_BatcherBase):
         wd = self._watchdog
         if wd is not None:
             wd.notify_step(seconds=dispatch_ms / 1e3)
+            wd.note_request(inflight=self._queue.qsize(),
+                            request_id=reqs[-1].future.request_id,
+                            completed=len(reqs))
         reg.counter("infer/requests").inc(len(reqs))
         reg.counter("infer/tokens").inc(emitted)
         reg.gauge("infer/batch_occupancy").set(len(reqs) / self.slots)
@@ -670,7 +709,7 @@ class _Slot:
     """Host-side record of one OCCUPIED decode slot."""
 
     __slots__ = ("req", "carry", "length", "emitted", "finished",
-                 "admitted_seq", "version")
+                 "admitted_seq", "version", "active_at")
 
     def __init__(self, req, admitted_seq):
         self.req = req
@@ -680,6 +719,7 @@ class _Slot:
         self.finished = False
         self.admitted_seq = admitted_seq
         self.version = None
+        self.active_at = None    # perf_counter at activation (decode_ms)
 
 
 class ContinuousBatcher(_BatcherBase):
@@ -1063,11 +1103,25 @@ class ContinuousBatcher(_BatcherBase):
             if not r.future.done():
                 r.future.weights_version = s.version
                 r.future.replica = self.name
+                if s.active_at is not None:
+                    base = dict(r.future.phases or {})
+                    base["decode_ms"] = (now - s.active_at) * 1e3
+                    r.future.phases = base
+                    if _tracing.trace_enabled():
+                        _tracing.span("trace.decode", _evus(s.active_at),
+                                      {"replica": self.name,
+                                       "tokens": len(s.emitted)},
+                                      request_id=r.future.request_id,
+                                      end_us=_evus(now))
                 r.future._resolve(list(s.emitted))
             with self._stats_lock:
                 self.stats["retired"] += 1
             reg.counter("infer/requests").inc()
             reg.counter("infer/tokens").inc(len(s.emitted))
+            wd = self._watchdog
+            if wd is not None:
+                wd.note_request(request_id=r.future.request_id,
+                                completed=1)
 
     def _adopt(self, slot: int, frames: dict) -> bool:
         """Adopt prefilled KV frames (``serving.disagg``) into ``slot``'s
@@ -1442,6 +1496,7 @@ class ContinuousBatcher(_BatcherBase):
                 s.carry = int(fr["carry"])
                 s.emitted = [int(t) for t in fr["emitted"]]
                 s.version = version
+                s.active_at = t_admit
                 self._slots[slot] = s
                 self._seed_from_frames(slot, r, fr)
                 r.future.queue_wait_ms = \
@@ -1449,6 +1504,19 @@ class ContinuousBatcher(_BatcherBase):
                 self._note_wait(max(r.future.queue_wait_ms, 0.0))
                 reg.histogram("infer/queue_wait_ms").observe(
                     max(r.future.queue_wait_ms, 0.0))
+                r.future.phases = {
+                    "queue_ms": max(r.future.queue_wait_ms, 0.0),
+                    "prefill_ms": 0.0, "adopted": True}
+                if _tracing.trace_enabled():
+                    _tracing.span("trace.queue",
+                                  _evus(r.future.enqueued_at),
+                                  {"replica": self.name},
+                                  request_id=r.future.request_id,
+                                  end_us=_evus(t_admit))
+                    _tracing.span("trace.adopt", _evus(t_admit),
+                                  {"replica": self.name,
+                                   "tokens": len(s.emitted)},
+                                  request_id=r.future.request_id)
                 r.future._stream_tokens(list(s.emitted))
                 ttft = (r.future.first_token_at
                         - r.future.enqueued_at) * 1e3
@@ -1582,12 +1650,25 @@ class ContinuousBatcher(_BatcherBase):
         s.length = length  # cached target positions (prime + prefix)
         s.carry = first_tok
         s.version = version
+        s.active_at = time.perf_counter()
         s.emitted.append(s.carry)
         self._slots[slot] = s
         r.future.queue_wait_ms = (t0 - r.future.enqueued_at) * 1e3
         self._note_wait(max(r.future.queue_wait_ms, 0.0))
         reg.histogram("infer/queue_wait_ms").observe(
             max(r.future.queue_wait_ms, 0.0))
+        r.future.phases = {
+            "queue_ms": max(r.future.queue_wait_ms, 0.0),
+            "prefill_ms": (s.active_at - t0) * 1e3}
+        if _tracing.trace_enabled():
+            _tracing.span("trace.queue", _evus(r.future.enqueued_at),
+                          {"replica": self.name},
+                          request_id=r.future.request_id,
+                          end_us=_evus(t0))
+            _tracing.span("trace.prefill", _evus(t0),
+                          {"replica": self.name},
+                          request_id=r.future.request_id,
+                          end_us=_evus(s.active_at))
         r.future._stream_tokens([s.carry])
         ttft = (r.future.first_token_at - r.future.enqueued_at) * 1e3
         reg.histogram("infer/ttft_ms").observe(ttft)
@@ -1756,6 +1837,7 @@ class ContinuousBatcher(_BatcherBase):
         wd = self._watchdog
         if wd is not None:
             wd.notify_step(seconds=iter_ms / 1e3)
+            wd.note_request(inflight=len(live) + len(self._pending))
 
     def _poison(self, err):
         """A decode dispatch failed: the donated pool state is gone, so

@@ -206,9 +206,12 @@ def check(point: str, tag=None) -> Optional[dict]:
     # counter outside the lock: telemetry must not serialize hot paths
     try:
         from .. import telemetry as _tel
+        from . import tracing as _tracing  # lazy: no import cycle
 
         _tel.registry().counter("serve/faults_injected").inc()
-        _tel.instant("serve.fault", {"point": point, "tag": tag})
+        _tel.instant("serve.fault",
+                     {"point": point, "tag": tag, "spec": fired,
+                      "request_id": _tracing.current_request_id()})
     except Exception:  # noqa: BLE001 - accounting must not mask the fault
         pass
     return fired

@@ -36,6 +36,7 @@ from typing import Optional
 
 from .. import telemetry as _tel
 from ..telemetry.watchdog import read_heartbeat
+from . import tracing as _tracing
 from .batcher import GenerationResult
 from .router import Replica, ReplicaUnavailable
 from .transport import RpcClient, TransportError
@@ -55,11 +56,19 @@ class RemoteEngineHandle:
 
     def stage_checkpoint(self, path: str) -> None:
         """Phase 1: the worker loads ``path`` and stages it standby."""
-        self._client.call("stage", {"path": path})
+        payload = {"path": path}
+        ctx = _tracing.context()
+        if ctx is not None:
+            payload["trace"] = ctx
+        self._client.call("stage", payload)
 
     def swap_staged(self, version: str) -> str:
         """Phase 2: flip the staged buffer live under ``version``."""
-        out = self._client.call("swap", {"version": version})
+        payload = {"version": version}
+        ctx = _tracing.context()
+        if ctx is not None:
+            payload["trace"] = ctx
+        out = self._client.call("swap", payload)
         self.weights_version = out.get("version", version)
         return self.weights_version
 
@@ -81,12 +90,21 @@ class _RemoteBatcher:
         return self._client.dead is None
 
     def submit(self, prompt_ids, max_new_tokens=None,
-               deadline_ms=None, prefix_ids=None) -> GenerationResult:
-        extra = None
+               deadline_ms=None, prefix_ids=None,
+               request_id=None) -> GenerationResult:
+        extra = {}
         if prefix_ids is not None and len(prefix_ids) > 0:
-            extra = {"prefix_ids": [int(t) for t in prefix_ids]}
-        return self._client.submit(prompt_ids, max_new_tokens,
-                                   deadline_ms=deadline_ms, extra=extra)
+            extra["prefix_ids"] = [int(t) for t in prefix_ids]
+        if request_id is not None:
+            # trace context rides the submit frame: the worker adopts
+            # the id so its spans/phases link back to this request
+            extra["trace"] = {"request_id": request_id}
+        fut = self._client.submit(prompt_ids, max_new_tokens,
+                                  deadline_ms=deadline_ms,
+                                  extra=extra or None)
+        if request_id is not None:
+            fut.request_id = request_id
+        return fut
 
     def cancel_pending(self, error=None) -> int:
         err = error if error is not None else ReplicaUnavailable(
@@ -227,6 +245,27 @@ class RemoteReplica(Replica):
                     return False, f"heartbeat stale ({age:.1f}s)"
         return True, "ok"
 
+    def sample_clock(self) -> None:
+        """One ping round trip → a ``trace.clock_offset`` instant in
+        THIS process's event log (``tracing.note_clock_sample``): the
+        worker replies with its event clock, and the send/receive
+        bracket bounds the offset to within the RTT —
+        ``tools/fleet_trace.py`` keeps the min-RTT sample per peer.
+        No-op when tracing is off or the transport is down."""
+        if not _tracing.trace_enabled() or self._client.dead is not None:
+            return
+        try:
+            t0 = _tracing.clock_us()
+            msg = self._client.call("ping", {},
+                                    timeout_s=self._rpc_timeout_s or 5.0)
+            t1 = _tracing.clock_us()
+        except Exception:  # noqa: BLE001 - sampling is best-effort
+            return
+        if msg.get("clock_us") is None:
+            return  # worker predates the clock_us reply
+        _tracing.note_clock_sample(self.name, msg.get("pid"), t0, t1,
+                                   msg["clock_us"])
+
     def load(self) -> int:
         """Router-tracked in-flight plus the worker's last-reported
         backlog (queued + occupied slots, from the health probe)."""
@@ -260,7 +299,9 @@ class RemoteReplica(Replica):
 
     def submit_disagg(self, prefill_rep, prompt_ids, max_new_tokens=None,
                       deadline_ms: Optional[float] = None,
-                      klass: str = "interactive") -> GenerationResult:
+                      klass: str = "interactive",
+                      request_id: Optional[str] = None
+                      ) -> GenerationResult:
         """Disaggregated submit: ask ``prefill_rep`` (a prefill-role
         replica) to run the admission prefill and push the KV frames to
         THIS worker, then submit here with the handoff id — the decode
@@ -273,13 +314,14 @@ class RemoteReplica(Replica):
         decode worker prefills locally (``disagg/re_prefills``): the
         request is never lost to the handoff."""
         fut = GenerationResult()
+        fut.request_id = request_id
         deadline_at = None if deadline_ms is None \
             else time.perf_counter() + float(deadline_ms) / 1e3
         try:
             threading.Thread(
                 target=self._disagg_handoff,
                 args=(prefill_rep, prompt_ids, max_new_tokens,
-                      deadline_at, klass, fut),
+                      deadline_at, klass, fut, request_id),
                 name=f"mxtpu-disagg-{self.name}", daemon=True).start()
         except Exception as e:  # noqa: BLE001 - no thread, no handoff
             if not fut.done():
@@ -288,28 +330,39 @@ class RemoteReplica(Replica):
         return fut
 
     def _disagg_handoff(self, prefill_rep, prompt_ids, max_new,
-                        deadline_at, klass, fut):
+                        deadline_at, klass, fut, request_id=None):
         """Handoff thread body: prefill RPC (bounded by the remaining
         deadline), then the wire submit feeding the SAME future the
-        router already holds."""
+        router already holds. The prefill wall lands as the request's
+        ``handoff_ms`` phase (stamped BEFORE the wire submit, so the
+        worker's phase breakdown merges on top, never over it)."""
         handoff = uuid.uuid4().hex
         extra = {"klass": klass}
+        if request_id is not None:
+            extra["trace"] = {"request_id": request_id}
         budget = None
         if deadline_at is not None:
             budget = max(0.05, deadline_at - time.perf_counter())
+        t0 = time.perf_counter()
+        th0 = _tracing.clock_us()
         try:
             host, port = self._client.address
-            prefill_rep.client.call(
-                "prefill",
-                {"prompt": [int(t) for t in prompt_ids],
-                 "push_to": f"{host}:{port}", "handoff": handoff},
-                timeout_s=budget)
+            payload = {"prompt": [int(t) for t in prompt_ids],
+                       "push_to": f"{host}:{port}", "handoff": handoff}
+            if request_id is not None:
+                payload["trace"] = {"request_id": request_id}
+            prefill_rep.client.call("prefill", payload, timeout_s=budget)
             extra["handoff"] = handoff
+            _tracing.span("trace.handoff", th0,
+                          {"prefill": prefill_rep.name,
+                           "decode": self.name, "handoff": handoff},
+                          request_id=request_id)
         except Exception as e:  # noqa: BLE001 - fall back to local prefill
             _tel.registry().counter("disagg/re_prefills").inc()
             _tel.instant("disagg.push_failed",
                          {"handoff": handoff, "replica": self.name,
-                          "error": repr(e)})
+                          "request_id": request_id, "error": repr(e)})
+        fut.phases = {"handoff_ms": (time.perf_counter() - t0) * 1e3}
         remaining_ms = None
         if deadline_at is not None:
             remaining_ms = (deadline_at - time.perf_counter()) * 1e3

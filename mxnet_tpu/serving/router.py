@@ -76,8 +76,9 @@ from .. import telemetry as _tel
 from ..telemetry.watchdog import read_heartbeat
 from . import faults as _faults
 from . import prefix as _prefix
+from . import tracing as _tracing
 from .batcher import Backpressure, DeadlineExceeded, DynamicBatcher, \
-    GenerationResult
+    GenerationResult, _evus
 
 __all__ = ["Router", "Replica", "ReplicaUnavailable", "retry_max",
            "restart_backoff_s", "shed_queue_depth", "shed_wait_ms",
@@ -306,10 +307,11 @@ class _Routed:
 
     __slots__ = ("prompt", "max_new", "deadline", "outer", "replica",
                  "inner", "attempts", "next_try_at", "created", "klass",
-                 "prefix", "digest")
+                 "prefix", "digest", "request_id", "assigned_at")
 
     def __init__(self, prompt, max_new, deadline, outer,
-                 klass="interactive", prefix=None, digest=None):
+                 klass="interactive", prefix=None, digest=None,
+                 request_id=None):
         self.prompt = prompt
         self.max_new = max_new
         self.deadline = deadline  # absolute perf_counter instant or None
@@ -322,6 +324,8 @@ class _Routed:
         self.klass = klass  # SLO class: "interactive" | "batch"
         self.prefix = prefix  # forced history for prefix-cache replay
         self.digest = digest  # prompt digest for affinity placement
+        self.request_id = request_id  # fleet-wide trace id
+        self.assigned_at = None  # perf_counter of the LAST placement
 
 
 class Router:
@@ -353,6 +357,7 @@ class Router:
                  shed_wait_ms: Optional[float] = None,
                  shed_max_queue: Optional[int] = None,
                  disagg_min_prompt: Optional[int] = None,
+                 telemetry_scrape_s: Optional[float] = None,
                  start: bool = True):
         from . import router as _self  # module fns shadowed by kwargs
 
@@ -383,6 +388,16 @@ class Router:
         self._inflight: list = []
         self._respawn_at = None  # next respawn attempt instant
         self._respawn_attempt = 0
+        # fleet observability: periodic clock probes per remote replica
+        # (tools/fleet_trace.py alignment) and the telemetry scrape
+        # plane (MXTPU_SCRAPE_S / telemetry_scrape_s)
+        self._clock_sample_at: dict = {}
+        scrape_s = telemetry_scrape_s if telemetry_scrape_s is not None \
+            else _tracing.scrape_interval_s()
+        self._fleet_telemetry = None
+        if scrape_s > 0:
+            self._fleet_telemetry = _tracing.FleetTelemetry(
+                self._replica_snapshot, interval_s=scrape_s)
         self._stop = threading.Event()
         self._thread = None
         if start:
@@ -396,8 +411,12 @@ class Router:
         self._thread = threading.Thread(
             target=self._run, name="mxtpu-router", daemon=True)
         self._thread.start()
+        if self._fleet_telemetry is not None:
+            self._fleet_telemetry.start()
 
     def stop(self, stop_replicas: bool = True, timeout: float = 30.0):
+        if self._fleet_telemetry is not None:
+            self._fleet_telemetry.stop()
         self._stop.set()
         t, self._thread = self._thread, None
         if t is not None:
@@ -434,6 +453,13 @@ class Router:
         return [rep.engine for rep in self._replica_snapshot()
                 if not rep.evicted]
 
+    @property
+    def fleet_telemetry(self):
+        """The scrape/aggregation plane (``tracing.FleetTelemetry``),
+        or None when ``MXTPU_SCRAPE_S``/``telemetry_scrape_s`` left it
+        disabled."""
+        return self._fleet_telemetry
+
     # ------------------------------------------------------------- requests
     def submit(self, prompt_ids, max_new_tokens: Optional[int] = None,
                deadline_ms: Optional[float] = None,
@@ -463,6 +489,10 @@ class Router:
                 f"unknown request class {klass!r} "
                 f"(one of {REQUEST_CLASSES})")
         outer = GenerationResult()
+        # minted unconditionally (a uuid4 slice): SLO attribution and
+        # shed/failover/deadline event tagging must work even when span
+        # emission (MXTPU_TRACE) is off
+        outer.request_id = rid = _tracing.new_request_id()
         dl_ms = deadline_ms
         if dl_ms is None:
             slo = slo_batch_ms() if klass == "batch" \
@@ -475,7 +505,8 @@ class Router:
             prefix = [int(t) for t in prefix_ids]
             digest = _prefix.prompt_digest(prompt_ids)
         r = _Routed(prompt_ids, max_new_tokens, deadline, outer,
-                    klass=klass, prefix=prefix, digest=digest)
+                    klass=klass, prefix=prefix, digest=digest,
+                    request_id=rid)
         _tel.registry().counter("serve/requests").inc()
         try:
             with self._lock:
@@ -502,7 +533,8 @@ class Router:
         msg = "; ".join(parts)  # formatted OUTSIDE the router lock
         reg = _tel.registry()
         reg.counter(f"serve/shed_{kind}").inc()
-        _tel.instant("serve.shed", {"kind": kind, "reason": msg})
+        _tel.instant("serve.shed", {"kind": kind, "reason": msg,
+                                    "request_id": rid, "klass": klass})
         outer._fail(Backpressure(f"router shed the request: {msg}"))
         return outer
 
@@ -646,6 +678,7 @@ class Router:
                 return True  # monitor fails it on the next tick
         r.replica = rep
         r.attempts += 1
+        r.assigned_at = now
         rep.inflight += 1
         # hand off only prefill-HEAVY prompts: a short prompt's local
         # prefill is cheaper than the handoff's extra RPC hop, and the
@@ -658,14 +691,17 @@ class Router:
         if pre is not None:
             r.inner = rep.submit_disagg(pre, r.prompt, r.max_new,
                                         deadline_ms=remaining_ms,
-                                        klass=r.klass)
+                                        klass=r.klass,
+                                        request_id=r.request_id)
         elif r.prefix is not None:
             r.inner = rep.batcher.submit(r.prompt, r.max_new,
                                          deadline_ms=remaining_ms,
-                                         prefix_ids=r.prefix)
+                                         prefix_ids=r.prefix,
+                                         request_id=r.request_id)
         else:
             r.inner = rep.batcher.submit(r.prompt, r.max_new,
-                                         deadline_ms=remaining_ms)
+                                         deadline_ms=remaining_ms,
+                                         request_id=r.request_id)
         return True
 
     # ----------------------------------------------------------- elasticity
@@ -716,6 +752,16 @@ class Router:
         reg = _tel.registry()
         reg.gauge("serve/replicas_healthy").set(healthy)
         reg.gauge("serve/shed_degraded_replicas").set(degraded)
+        if _tracing.trace_enabled():
+            # throttled clock sampling piggybacks on the health cadence:
+            # one ping RTT per remote replica per second keeps the
+            # cross-process offset estimate fresh for trace merging
+            for rep in reps:
+                if rep.evicted or not hasattr(rep, "sample_clock"):
+                    continue
+                if now >= self._clock_sample_at.get(rep.name, 0.0):
+                    self._clock_sample_at[rep.name] = now + 1.0
+                    rep.sample_clock()
         if self._factory is not None and self._respawn_at is not None \
                 and now >= self._respawn_at:
             self._respawn()
@@ -726,8 +772,6 @@ class Router:
         rep.evicted = True
         reg = _tel.registry()
         reg.counter("serve/failovers").inc()
-        _tel.instant("serve.failover", {"replica": rep.name,
-                                        "reason": reason})
         # cancel what sits undispatched in its queue: the inner futures
         # fail with ReplicaUnavailable and the request pass resubmits
         try:
@@ -738,6 +782,7 @@ class Router:
         # a hung (not dead) dispatcher also holds requests it already
         # popped; their inner futures will never resolve — fail them over
         # too. A zombie completion later is ignored (outer settles once).
+        affected = []
         with self._lock:
             for r in self._inflight:
                 if r.replica is rep and r.inner is not None \
@@ -745,6 +790,13 @@ class Router:
                     r.inner = None
                     r.replica = None
                     r.next_try_at = 0.0
+                    if r.request_id is not None:
+                        affected.append(r.request_id)
+        # emitted outside the lock: the event write is I/O
+        _tel.instant("serve.failover", {"replica": rep.name,
+                                        "reason": reason,
+                                        "requests": affected[:8],
+                                        "n_requests": len(affected)})
         # stop the batcher without waiting on a possibly-hung thread
         try:
             rep.batcher.stop(drain=False, timeout=0.1)
@@ -786,6 +838,10 @@ class Router:
                 # waiting for a retry slot / a healthy replica
                 if r.deadline is not None and now > r.deadline:
                     reg.counter("serve/deadline_exceeded").inc()
+                    _tel.instant("serve.deadline",
+                                 {"request_id": r.request_id,
+                                  "replica": None, "klass": r.klass,
+                                  "where": "unplaced"})
                     r.outer._fail(DeadlineExceeded(
                         "request deadline passed before it could be "
                         "(re)placed on a healthy replica"))
@@ -828,10 +884,47 @@ class Router:
                             reg.histogram(
                                 "disagg/ttft_interactive_ms").observe(
                                     ttft)
+                    # SLO attribution: the per-phase breakdown stamped
+                    # by the worker (queue/prefill/decode), extended
+                    # with router-side phases. ``other_ms`` is the
+                    # residual and is deliberately UNCLAMPED so the
+                    # ``*_ms`` phases sum to the observed end-to-end
+                    # latency exactly, by construction.
+                    tdone = time.perf_counter()
+                    phases = dict(getattr(r.inner, "phases", None) or {})
+                    if r.attempts > 1 and r.assigned_at is not None:
+                        phases["retry_ms"] = \
+                            (r.assigned_at - r.created) * 1e3
+                    e2e_ms = (tdone - r.created) * 1e3
+                    named = sum(v for k, v in phases.items()
+                                if k.endswith("_ms")
+                                and isinstance(v, (int, float)))
+                    phases["other_ms"] = e2e_ms - named
+                    r.outer.phases = phases
+                    slo = slo_batch_ms() if r.klass == "batch" \
+                        else slo_interactive_ms()
+                    if slo > 0 and e2e_ms > slo:
+                        reg.counter(
+                            f"serve/slo_burn_{r.klass}").inc()
+                    if _tracing.trace_enabled():
+                        _tracing.span(
+                            "trace.request", _evus(r.created),
+                            {"replica": r.inner.replica,
+                             "klass": r.klass,
+                             "attempts": r.attempts,
+                             "e2e_ms": e2e_ms},
+                            request_id=r.request_id,
+                            end_us=_evus(tdone))
                     r.outer._resolve(r.inner.result())
                     reg.counter("serve/completed").inc()
                     done.append(r)
                 elif isinstance(err, DeadlineExceeded):
+                    _tel.instant("serve.deadline",
+                                 {"request_id": r.request_id,
+                                  "replica": getattr(
+                                      r.replica, "name", None),
+                                  "klass": r.klass,
+                                  "where": "batcher"})
                     r.outer._fail(err)  # counted at the batcher
                     done.append(r)
                 else:
@@ -843,6 +936,12 @@ class Router:
                 # deadline settles the OUTER future; a zombie inner
                 # completion is discarded
                 reg.counter("serve/deadline_exceeded").inc()
+                _tel.instant("serve.deadline",
+                             {"request_id": r.request_id,
+                              "replica": getattr(
+                                  r.replica, "name", None),
+                              "klass": r.klass,
+                              "where": "dispatched"})
                 r.outer._fail(DeadlineExceeded(
                     "request deadline passed while dispatched"))
                 done.append(r)
@@ -864,7 +963,13 @@ class Router:
                 f"(last error: {err!r})"))
             return
         reg.counter("serve/retries").inc()
+        rep_name = getattr(r.replica, "name", None)
         r.inner = None
         r.replica = None
         r.next_try_at = now + backoff_delay(
             self.retry_backoff_s, r.attempts - 1, cap=5.0)
+        _tracing.instant("trace.retry",
+                         {"replica": rep_name,
+                          "attempt": r.attempts,
+                          "error": type(err).__name__},
+                         request_id=r.request_id)

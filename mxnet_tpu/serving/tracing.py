@@ -1,0 +1,366 @@
+"""Fleet-scope request tracing + the telemetry scrape/aggregation plane.
+
+The serving fleet is multi-process (PR 12) and disaggregated (PR 14),
+but until now every process kept a private telemetry registry and spans
+never crossed a socket — a request handed off prefill→decode, retried
+after a SIGKILL, or shed left no artifact explaining where its latency
+went. This module is the missing spine, in three parts:
+
+- **Request tracing** (Dapper-style): ``Router.submit`` mints a
+  ``request_id``; the id rides RPC payloads as a ``trace`` dict
+  (submit/``prefill``/``kv_push``/``stage``/``swap`` verbs), worker
+  handlers adopt it into a thread-local scope
+  (``request_scope``/``current_request_id``), and every serving layer
+  emits ``trace.*`` spans/instants tagged with it. Spans whose
+  endpoints cross threads (enqueue→retire) use explicit-start emission
+  (``span(name, start_us)`` → one Chrome complete event).
+- **Clock alignment**: every process stamps events on its own trace
+  clock (``telemetry.clock_us``, µs since module import). The ``ping``/
+  ``health``/``telemetry`` verbs reply with the worker's ``clock_us``;
+  the router brackets each probe with its own clock and records
+  ``trace.clock_offset`` instants (midpoint estimator, min-RTT sample
+  wins — ``estimate_offset``). ``tools/fleet_trace.py`` shifts every
+  worker stream onto the router timeline and emits ONE Chrome trace for
+  the fleet.
+- **Scrape/aggregation** (the Prometheus model): ``FleetTelemetry``
+  polls the ``telemetry`` RPC verb on ``MXTPU_SCRAPE_S`` intervals,
+  sums counters / merges histogram summaries
+  (``telemetry.metrics.merge_summaries``) into a fleet aggregate with
+  per-replica breakdowns, and appends each raw scrape to a JSONL stream
+  (``fleet_telemetry.jsonl``). Aggregation is a pure function of the
+  recorded snapshots (``aggregate_snapshots``), so replaying the file
+  (``replay_scrapes``) re-derives identical aggregates by construction
+  — the sampling substrate ROADMAP item 6's fleet simulator draws from.
+
+Env knobs: ``MXTPU_TRACE=1`` turns span emission on (the ``force()``
+override exists for benches measuring tracing overhead);
+``MXTPU_TRACE_DIR`` routes each process's telemetry into its own
+subdirectory (``<dir>/<name>_<pid>/events.jsonl``) so the merge tool
+can find every stream; ``MXTPU_SCRAPE_S`` > 0 starts the router's
+scrape loop. Zero-overhead contract: with tracing off, every emission
+helper is one env/flag check.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Optional
+
+from .. import telemetry as _tel
+from ..telemetry.metrics import merge_summaries
+
+__all__ = ["trace_enabled", "force", "new_request_id", "request_scope",
+           "current_request_id", "context", "span", "instant",
+           "clock_us", "maybe_enable_process", "estimate_offset",
+           "note_clock_sample", "scrape_interval_s", "FleetTelemetry",
+           "aggregate_snapshots", "replay_scrapes"]
+
+_FORCE: Optional[bool] = None
+_TLS = threading.local()
+
+
+def trace_enabled() -> bool:
+    """Tracing gate: the ``force()`` override when set, else
+    ``MXTPU_TRACE``. Read live (a dict get) so tests and benches can
+    flip it without re-importing."""
+    f = _FORCE
+    if f is not None:
+        return f
+    return os.environ.get("MXTPU_TRACE", "0").lower() not in (
+        "0", "", "false", "no")
+
+
+def force(on: Optional[bool]):
+    """Programmatic override of ``MXTPU_TRACE``: True/False pin tracing
+    on/off (benches measure overhead by flipping this around identical
+    load), None restores env control."""
+    global _FORCE
+    _FORCE = on
+
+
+def new_request_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def current_request_id() -> Optional[str]:
+    """The request id adopted by the current thread (None outside a
+    ``request_scope``) — lets deep layers (``faults.fire``) attribute
+    events without threading the id through every signature."""
+    return getattr(_TLS, "rid", None)
+
+
+class request_scope:
+    """Thread-local request context: worker verb handlers enter it with
+    the RPC payload's ``trace.request_id`` so everything they touch
+    (spans, fault instants) is attributable. Re-entrant; restores the
+    previous id on exit. A None id is a no-op scope."""
+
+    __slots__ = ("rid", "_prev")
+
+    def __init__(self, request_id: Optional[str]):
+        self.rid = request_id
+
+    def __enter__(self):
+        self._prev = getattr(_TLS, "rid", None)
+        if self.rid is not None:
+            _TLS.rid = self.rid
+        return self.rid
+
+    def __exit__(self, *exc):
+        _TLS.rid = self._prev
+        return False
+
+
+def context(request_id: Optional[str] = None) -> Optional[dict]:
+    """The ``trace`` dict a client attaches to an RPC payload; None when
+    there is nothing to propagate (keeps untraced frames byte-identical
+    to the pre-tracing wire format)."""
+    rid = request_id if request_id is not None else current_request_id()
+    return {"request_id": rid} if rid is not None else None
+
+
+def clock_us() -> float:
+    return _tel.clock_us()
+
+
+def span(name: str, start_us: float, args: Optional[dict] = None,
+         request_id: Optional[str] = None, end_us: Optional[float] = None):
+    """Emit one complete span from an explicit start timestamp to now
+    (or ``end_us``), tagged with the in-scope request id. One flag check
+    when tracing is off."""
+    if not trace_enabled():
+        return
+    a = dict(args) if args else {}
+    rid = request_id if request_id is not None else current_request_id()
+    if rid is not None:
+        a.setdefault("request_id", rid)
+    end = clock_us() if end_us is None else end_us
+    _tel.complete(name, start_us, end - start_us, a)
+
+
+def instant(name: str, args: Optional[dict] = None,
+            request_id: Optional[str] = None):
+    if not trace_enabled():
+        return
+    a = dict(args) if args else {}
+    rid = request_id if request_id is not None else current_request_id()
+    if rid is not None:
+        a.setdefault("request_id", rid)
+    _tel.instant(name, a)
+
+
+def maybe_enable_process(name: Optional[str] = None) -> Optional[str]:
+    """Fleet trace capture: when ``MXTPU_TRACE_DIR`` is set (and tracing
+    on), enable telemetry into this process's own subdirectory —
+    ``<dir>/<name>_<pid>`` — so every fleet process writes a separate
+    ``events.jsonl`` that ``tools/fleet_trace.py`` can discover and
+    merge. Idempotent; a no-op when telemetry is already enabled (the
+    caller picked a directory) or the env is absent."""
+    root = os.environ.get("MXTPU_TRACE_DIR")
+    if not root or not trace_enabled():
+        return None
+    if _tel.enabled():
+        return None
+    d = os.path.join(root, f"{name or 'proc'}_{os.getpid()}")
+    _tel.enable(d)
+    return d
+
+
+# ------------------------------------------------------- clock alignment
+def estimate_offset(samples):
+    """Best clock-offset estimate from ping-style probe samples.
+
+    Each sample is ``(t_send_us, t_recv_us, peer_clock_us)`` — the
+    caller's clock bracketing one RPC whose reply carried the peer's
+    clock. The midpoint estimator assumes symmetric network delay, so
+    its error is bounded by RTT/2 — the MINIMUM-RTT sample is the best
+    estimate (NTP's selection rule). Returns ``(offset_us, rtt_us)``
+    with ``peer_ts + offset ≈ caller_ts``, or None for no samples."""
+    best = None
+    for t_send, t_recv, peer in samples:
+        rtt = t_recv - t_send
+        if best is None or rtt < best[1]:
+            best = ((t_send + t_recv) / 2.0 - peer, rtt)
+    return best
+
+
+def note_clock_sample(replica: str, peer_pid, t_send_us: float,
+                      t_recv_us: float, peer_clock_us: float):
+    """Record one clock probe as a ``trace.clock_offset`` instant in
+    THIS process's event stream — the merge tool reads these (min-RTT
+    per peer pid) to shift worker timelines onto the router's."""
+    if not trace_enabled() or peer_clock_us is None:
+        return
+    off = (t_send_us + t_recv_us) / 2.0 - peer_clock_us
+    instant("trace.clock_offset", {
+        "replica": replica,
+        "peer_pid": peer_pid,
+        "offset_us": off,
+        "rtt_us": t_recv_us - t_send_us,
+    })
+
+
+# ------------------------------------------------- scrape / aggregation
+def scrape_interval_s() -> float:
+    """``MXTPU_SCRAPE_S``: seconds between fleet telemetry scrapes;
+    0 (default) disables the scrape loop."""
+    try:
+        return float(os.environ.get("MXTPU_SCRAPE_S", "0") or 0.0)
+    except ValueError:
+        return 0.0
+
+
+def aggregate_snapshots(snapshots: dict) -> dict:
+    """Merge per-process registry snapshots (``{name: snapshot}``) into
+    one fleet view: counters sum, histogram summaries merge
+    (``merge_summaries``), gauges stay per-replica (summing last-write
+    gauges across processes is meaningless). Pure and deterministic —
+    the same function serves the live aggregate and the recorded-stream
+    replay, which is what makes the JSONL replayable by construction."""
+    counters: dict = {}
+    hists: dict = {}
+    per_replica: dict = {}
+    for name in sorted(snapshots):
+        snap = snapshots[name] or {}
+        per_replica[name] = snap
+        for k, v in (snap.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0) + v
+        for k, v in (snap.get("histograms") or {}).items():
+            hists.setdefault(k, []).append(v)
+    return {
+        "replicas": sorted(snapshots),
+        "counters": counters,
+        "histograms": {k: merge_summaries(v)
+                       for k, v in sorted(hists.items())},
+        "per_replica": per_replica,
+    }
+
+
+def replay_scrapes(path: str):
+    """Re-derive the aggregate stream from a recorded
+    ``fleet_telemetry.jsonl``: one ``{"t", "aggregate"}`` entry per
+    recorded scrape, skipping torn lines (append-only stream). Feeding
+    the recorded raw snapshots through the same ``aggregate_snapshots``
+    is the replay guarantee ROADMAP-6's simulator samples from."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            out.append({
+                "t": rec.get("t"),
+                "aggregate": aggregate_snapshots(
+                    rec.get("snapshots") or {}),
+            })
+    return out
+
+
+class FleetTelemetry:
+    """Router-side scrape/aggregation plane.
+
+    Polls each remote replica's ``telemetry`` RPC verb (a registry
+    snapshot + the worker's trace clock), folds in the local (router)
+    registry, appends the raw scrape to ``fleet_telemetry.jsonl``, and
+    keeps the latest snapshots for ``aggregate()``. Each scrape doubles
+    as a clock probe (``note_clock_sample``). Scrape RPCs run OUTSIDE
+    the lock — the lock only guards the latest-snapshot swap."""
+
+    def __init__(self, replicas, interval_s: Optional[float] = None,
+                 directory: Optional[str] = None, local_name: str = "router",
+                 rpc_timeout_s: float = 5.0):
+        # a sequence, or a zero-arg callable returning the CURRENT
+        # sequence — the router passes its snapshot method so replicas
+        # added by respawn/scale-up join the scrape without re-wiring
+        self._replicas = replicas
+        self.interval_s = float(interval_s if interval_s is not None
+                                else scrape_interval_s())
+        if directory is None:
+            jp = _tel.jsonl_path()
+            directory = os.path.dirname(jp) if jp else _tel.default_dir()
+        os.makedirs(directory, exist_ok=True)
+        self.path = os.path.join(directory, "fleet_telemetry.jsonl")
+        self.local_name = local_name
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self._lock = threading.Lock()
+        self._last: dict = {}
+        self._stop = threading.Event()
+        self._thread = None
+
+    # --------------------------------------------------------- control
+    def start(self):
+        if self._thread is not None or self.interval_s <= 0:
+            return self
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="mxtpu-fleet-telemetry", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=self.rpc_timeout_s + self.interval_s + 1.0)
+
+    def _run(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.scrape_once()
+            except Exception:  # noqa: BLE001 - scraping must not kill serving
+                _tel.registry().counter("fleet/scrape_errors").inc()
+
+    def _replica_list(self) -> list:
+        reps = self._replicas
+        return list(reps()) if callable(reps) else list(reps)
+
+    # ---------------------------------------------------------- scrape
+    def scrape_once(self) -> dict:
+        """One scrape pass: remote snapshots via the ``telemetry`` verb
+        (failures counted, never fatal), local registry under
+        ``local_name``, record + publish. Returns the snapshot map."""
+        reg = _tel.registry()
+        snaps = {}
+        for rep in self._replica_list():
+            client = getattr(rep, "client", None)
+            if client is None:
+                continue
+            t0 = clock_us()
+            try:
+                msg = client.call("telemetry", {},
+                                  timeout_s=self.rpc_timeout_s)
+            except Exception:  # noqa: BLE001 - dead replica: scrape on
+                reg.counter("fleet/scrape_errors").inc()
+                continue
+            t1 = clock_us()
+            snaps[rep.name] = msg.get("snapshot") or {}
+            note_clock_sample(rep.name, msg.get("pid"), t0, t1,
+                              msg.get("clock_us"))
+        snaps[self.local_name] = reg.snapshot()
+        reg.counter("fleet/scrapes").inc()
+        reg.gauge("fleet/replicas").set(len(snaps) - 1)
+        line = json.dumps({"t": time.time(), "snapshots": snaps},
+                          default=str)
+        with self._lock:
+            self._last = snaps
+        try:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except OSError:
+            pass
+        return snaps
+
+    def aggregate(self) -> dict:
+        """Fleet aggregate of the latest scrape (see
+        ``aggregate_snapshots``)."""
+        with self._lock:
+            snaps = dict(self._last)
+        return aggregate_snapshots(snaps)

@@ -425,6 +425,16 @@ class RpcClient:
             fut.weights_version = msg.get("weights_version")
             fut.replica = msg.get("replica", self.name)
             fut.queue_wait_ms = msg.get("queue_wait_ms")
+            if msg.get("request_id") is not None:
+                fut.request_id = msg.get("request_id")
+            phases = msg.get("phases")
+            if phases:
+                # merge, don't overwrite: the router side may have
+                # stamped its own phases (disagg handoff wall) before
+                # the worker's breakdown arrived
+                base = dict(fut.phases or {})
+                base.update(phases)
+                fut.phases = base
             if not fut.done():
                 fut._resolve([int(t) for t in msg.get("tokens", ())])
         elif not fut.done():

@@ -51,6 +51,7 @@ from typing import Callable, Optional
 from .. import checkpoint_sharded as _cs
 from .. import telemetry as _tel
 from . import faults as _faults
+from . import tracing as _tracing
 
 __all__ = ["CheckpointWatcher", "swap_poll_s", "version_for"]
 
@@ -161,7 +162,13 @@ class CheckpointWatcher:
         serving). Serialized: a caller-driven poll and the background
         thread never stage the same checkpoint twice."""
         with self._lock:
-            return self._poll_once_locked()
+            # one trace id per swap CYCLE: every stage/swap verb the
+            # barrier fans out carries it, so the merged fleet trace
+            # shows the whole two-phase flip as one operation
+            rid = _tracing.new_request_id() \
+                if _tracing.trace_enabled() else None
+            with _tracing.request_scope(rid):
+                return self._poll_once_locked()
 
     def _poll_once_locked(self) -> Optional[str]:
         found = _cs.latest_committed(self.directory)

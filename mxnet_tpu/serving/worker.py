@@ -74,6 +74,7 @@ from .. import telemetry as _tel
 from . import disagg as _disagg
 from . import faults as _faults
 from . import prefix as _prefix
+from . import tracing as _tracing
 from .transport import RpcClient, RpcServer, serve_port
 
 __all__ = ["ServingWorker", "WorkerHandle", "spawn_worker", "main",
@@ -213,6 +214,7 @@ class ServingWorker:
             "stage": self._handle_stage,
             "swap": self._handle_swap,
             "drain": self._handle_drain,
+            "telemetry": self._handle_telemetry,
         }, port=port, name=name)
 
     def _adopt_checkpoint(self, ckpt_dir: str):
@@ -286,7 +288,10 @@ class ServingWorker:
 
     # ------------------------------------------------------------ handlers
     def _handle_ping(self, msg, respond):
-        respond(pong=True, name=self.name, pid=os.getpid())
+        # clock_us lets the caller estimate this process's event-clock
+        # offset from one round trip (serving.tracing.estimate_offset)
+        respond(pong=True, name=self.name, pid=os.getpid(),
+                clock_us=_tel.clock_us())
 
     def _handle_health(self, msg, respond):
         bat = self.batcher
@@ -319,6 +324,16 @@ class ServingWorker:
                 disagg_re_prefills=re_prefilled,
                 prefix_digests=digests,
                 prefix_stats=prefix_stats,
+                name=self.name, pid=os.getpid(),
+                clock_us=_tel.clock_us())
+
+    def _handle_telemetry(self, msg, respond):
+        """Scrape verb: one frame with the full registry snapshot plus
+        this process's event clock, so the router-side aggregation plane
+        (``serving.tracing.FleetTelemetry``) gets counters, histogram
+        summaries, and a clock sample from a single round trip."""
+        respond(snapshot=_tel.registry().snapshot(),
+                clock_us=_tel.clock_us(),
                 name=self.name, pid=os.getpid())
 
     def _handle_submit(self, msg, respond):
@@ -351,7 +366,8 @@ class ServingWorker:
         fut = self.batcher.submit(
             prompt, msg.get("max_new_tokens"),
             deadline_ms=msg.get("deadline_ms"), frames=frames,
-            prefix_ids=msg.get("prefix_ids"))
+            prefix_ids=msg.get("prefix_ids"),
+            request_id=(msg.get("trace") or {}).get("request_id"))
         try:
             t = threading.Thread(target=self._stream_result,
                                  args=(fut, respond),
@@ -384,7 +400,8 @@ class ServingWorker:
                                      "message": str(e)})
             return
         respond(tokens=tokens, weights_version=fut.weights_version,
-                replica=self.name, queue_wait_ms=fut.queue_wait_ms)
+                replica=self.name, queue_wait_ms=fut.queue_wait_ms,
+                phases=fut.phases, request_id=fut.request_id)
 
     # ------------------------------------------------ disaggregated verbs
     def _peer(self, address) -> RpcClient:
@@ -449,15 +466,21 @@ class ServingWorker:
         callers), push, respond — exceptions relay as error frames (the
         transport's inline catch does not cover this thread)."""
         try:
-            self._prefill_and_push(msg, handoff, respond)
+            with _tracing.request_scope(
+                    (msg.get("trace") or {}).get("request_id")):
+                self._prefill_and_push(msg, handoff, respond)
         except BaseException as e:  # noqa: BLE001 - relay the failure
             respond(ok=False, error={"type": type(e).__name__,
                                      "message": str(e)})
 
     def _prefill_and_push(self, msg, handoff, respond):
+        tp0 = _tracing.clock_us()
         frames = self.prefiller.prefill(msg.get("prompt", ()))
+        _tracing.span("trace.prefill", tp0,
+                      {"replica": self.name, "handoff": handoff})
         nbytes = _disagg.frame_bytes(frames)
         t0 = time.perf_counter()
+        tk0 = _tracing.clock_us()
         # fault point: the push itself drops (raise) or crawls (delay) —
         # the decode side then re-prefills from the prompt
         _faults.fire("transport.kv_push",
@@ -478,6 +501,9 @@ class ServingWorker:
         reg.histogram("disagg/kv_push_ms").observe(
             (time.perf_counter() - t0) * 1e3)
         reg.counter("disagg/kv_bytes").inc(nbytes)
+        _tracing.span("trace.kv_push", tk0,
+                      {"replica": self.name, "handoff": handoff,
+                       "kv_bytes": nbytes, "spilled": bool(spill)})
         respond(pushed=True, handoff=handoff, kv_bytes=nbytes,
                 spilled=bool(spill))
 
@@ -502,10 +528,15 @@ class ServingWorker:
         path = msg.get("path")
         if not path:
             raise MXNetError("stage verb needs a checkpoint 'path'")
-        _faults.fire("ckpt.load", tag=path)
-        staged = self.engine.stage_params(_cs.load_sharded(path))
-        with self._lock:
-            self._staged = staged
+        with _tracing.request_scope(
+                (msg.get("trace") or {}).get("request_id")):
+            t0 = _tracing.clock_us()
+            _faults.fire("ckpt.load", tag=path)
+            staged = self.engine.stage_params(_cs.load_sharded(path))
+            with self._lock:
+                self._staged = staged
+            _tracing.span("trace.stage", t0,
+                          {"replica": self.name, "path": path})
         respond(staged=True, path=path)
 
     def _handle_swap(self, msg, respond):
@@ -516,8 +547,13 @@ class ServingWorker:
         if staged is None:
             raise MXNetError(
                 "swap verb with nothing staged (stage must precede swap)")
-        version = self.engine.swap_params(staged=staged,
-                                          version=msg.get("version"))
+        with _tracing.request_scope(
+                (msg.get("trace") or {}).get("request_id")):
+            t0 = _tracing.clock_us()
+            version = self.engine.swap_params(staged=staged,
+                                              version=msg.get("version"))
+            _tracing.span("trace.swap", t0,
+                          {"replica": self.name, "version": version})
         respond(version=version)
 
     def _handle_drain(self, msg, respond):
@@ -701,6 +737,10 @@ def main(argv=None) -> int:
     port = args.port if args.port is not None else serve_port()
     if port and rank:
         port += rank
+    # per-process trace sink (MXTPU_TRACE + MXTPU_TRACE_DIR): each
+    # worker writes its own events.jsonl; tools/fleet_trace.py merges
+    # them onto the router's timeline afterwards
+    _tracing.maybe_enable_process(name)
 
     if args.net_factory:
         net = _net_from_factory(args.net_factory)

@@ -35,16 +35,17 @@ import os
 import threading
 from typing import Optional
 
-from .events import EventLog, NULL_SPAN
-from .metrics import Counter, Gauge, Histogram, Registry
+from .events import EventLog, NULL_SPAN, now_us as _events_now_us
+from .metrics import Counter, Gauge, Histogram, Registry, merge_summaries
 from .watchdog import Watchdog
 
 __all__ = [
-    "enable", "disable", "enabled", "span", "instant", "registry",
-    "report", "dump", "record_step", "start_watchdog", "stop_watchdog",
-    "hbm_peak_bytes", "hbm_limit_bytes", "hbm_headroom_bytes",
-    "device_memory_stats", "set_info", "run_info", "Registry", "Counter",
-    "Gauge", "Histogram", "Watchdog", "EventLog", "NULL_SPAN",
+    "enable", "disable", "enabled", "span", "instant", "complete",
+    "clock_us", "registry", "report", "dump", "record_step",
+    "start_watchdog", "stop_watchdog", "hbm_peak_bytes",
+    "hbm_limit_bytes", "hbm_headroom_bytes", "device_memory_stats",
+    "set_info", "run_info", "Registry", "Counter", "Gauge", "Histogram",
+    "Watchdog", "EventLog", "NULL_SPAN", "merge_summaries",
 ]
 
 # module-level fast flag: hot paths read `telemetry._ENABLED` directly —
@@ -145,6 +146,22 @@ def instant(name: str, args: Optional[dict] = None):
     log = _LOG
     if _ENABLED and log is not None:
         log.instant(name, args)
+
+
+def complete(name: str, ts_us: float, dur_us: float,
+             args: Optional[dict] = None):
+    """Emit one complete span with explicit start/duration (request-
+    lifetime spans whose endpoints cross threads); no-op when disabled."""
+    log = _LOG
+    if _ENABLED and log is not None:
+        log.complete(name, ts_us, dur_us, args)
+
+
+def clock_us() -> float:
+    """The process trace clock (µs since telemetry module import) — the
+    timebase of every emitted event, exposed so the serving plane can
+    answer clock-alignment probes (``ping``/``telemetry`` verbs)."""
+    return _events_now_us()
 
 
 # ------------------------------------------------------------------- steps

@@ -23,7 +23,7 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ["EventLog", "span", "instant"]
+__all__ = ["EventLog", "span", "instant", "now_us"]
 
 # monotonic origin for Chrome-trace timestamps (microseconds since process
 # telemetry init; Chrome traces only need a consistent origin per file)
@@ -32,6 +32,14 @@ _T0 = time.perf_counter()
 
 def _now_us() -> float:
     return (time.perf_counter() - _T0) * 1e6
+
+
+def now_us() -> float:
+    """This process's trace clock (µs since module import). Every event
+    in this process's stream is stamped on this clock; cross-process
+    alignment (tools/fleet_trace.py) estimates per-process offsets from
+    it via the ``ping``/``telemetry`` verbs' ``clock_us`` reply field."""
+    return _now_us()
 
 
 class _NullSpan:
@@ -129,6 +137,24 @@ class EventLog:
 
     def span(self, name: str, args: Optional[dict] = None) -> _Span:
         return _Span(self, name, args)
+
+    def complete(self, name: str, ts_us: float, dur_us: float,
+                 args: Optional[dict] = None):
+        """Emit one complete span (``ph: "X"``) with explicit start/dur —
+        for request-lifetime spans whose endpoints live on different
+        threads (router submit→resolve, batcher enqueue→retire), where a
+        ``with``-block cannot bracket the interval. Bypasses the
+        thread-local nesting stack: depth/parent only make sense for
+        lexically nested spans."""
+        self.emit({
+            "name": name,
+            "ph": "X",
+            "ts": ts_us,
+            "dur": max(dur_us, 0.0),
+            "pid": os.getpid(),
+            "tid": threading.get_ident(),
+            "args": args or {},
+        })
 
     def instant(self, name: str, args: Optional[dict] = None):
         """Instant event (``ph: "i"``) — phase markers like checkpoint

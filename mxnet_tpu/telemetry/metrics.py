@@ -14,7 +14,40 @@ import math
 import threading
 from typing import Dict, Optional
 
-__all__ = ["Counter", "Gauge", "Histogram", "Registry"]
+__all__ = ["Counter", "Gauge", "Histogram", "Registry",
+           "merge_summaries"]
+
+
+def merge_summaries(summaries) -> dict:
+    """Merge per-replica ``Histogram.summary()`` dicts into one fleet
+    summary (the scrape/aggregation plane, ``serving.tracing``).
+
+    ``count``/``sum`` add exactly and ``min``/``max`` take extremes, so
+    the fleet mean is exact. Percentiles cannot be recovered from
+    summaries — the merged p50/p95/p99 are the count-weighted average of
+    the inputs' percentiles, a documented approximation that is exact
+    when the replicas' distributions agree and deterministic always
+    (replaying a recorded scrape stream re-derives identical values).
+    Empty inputs (count 0) are ignored; all-empty merges to the empty
+    summary."""
+    live = [s for s in summaries if s and s.get("count")]
+    if not live:
+        return {"count": 0, "sum": 0.0, "mean": None, "min": None,
+                "max": None, "p50": None, "p95": None, "p99": None}
+    count = sum(s["count"] for s in live)
+    total = sum(s["sum"] for s in live)
+    out = {
+        "count": count,
+        "sum": total,
+        "mean": total / count,
+        "min": min(s["min"] for s in live if s["min"] is not None),
+        "max": max(s["max"] for s in live if s["max"] is not None),
+    }
+    for q in ("p50", "p95", "p99"):
+        vals = [(s[q], s["count"]) for s in live if s[q] is not None]
+        w = sum(c for _v, c in vals)
+        out[q] = sum(v * c for v, c in vals) / w if w else None
+    return out
 
 
 class Counter:

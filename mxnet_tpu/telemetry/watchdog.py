@@ -76,6 +76,9 @@ class Watchdog:
         self._step = 0
         self._last_progress = time.monotonic()
         self._step_times = collections.deque(maxlen=64)
+        self._inflight = 0
+        self._last_request_id = None
+        self._requests_completed = 0
         self._stalled = False
         self.stall_count = 0
         self._stop = threading.Event()
@@ -109,6 +112,19 @@ class Watchdog:
                 self._step_times.append(float(seconds))
             self._stalled = False
 
+    def note_request(self, inflight=None, request_id=None, completed=0):
+        """Request-level progress for the heartbeat (serving batchers):
+        lets a health reader distinguish "hung with work" from "idle"
+        straight from ``heartbeat.json``, without an RPC scrape. Same
+        hot-path contract as ``notify_step`` — one lock, a few stores."""
+        with self._lock:
+            if inflight is not None:
+                self._inflight = int(inflight)
+            if request_id is not None:
+                self._last_request_id = request_id
+            if completed:
+                self._requests_completed += int(completed)
+
     # ------------------------------------------------------------- thread
     def _stall_threshold(self) -> Optional[float]:
         """None until a step time exists — a run that never stepped is a
@@ -128,6 +144,9 @@ class Watchdog:
                 "idle_s": idle,
                 "median_step_s": (statistics.median(self._step_times)
                                   if self._step_times else None),
+                "inflight": self._inflight,
+                "last_request_id": self._last_request_id,
+                "requests_completed": self._requests_completed,
             }
 
     def _write_heartbeat(self, status="alive"):

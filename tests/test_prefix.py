@@ -428,7 +428,8 @@ class _StubBatcher:
     def rolling_wait_ms(self):
         return None
 
-    def submit(self, prompt, max_new, deadline_ms=None, prefix_ids=None):
+    def submit(self, prompt, max_new, deadline_ms=None, prefix_ids=None,
+               request_id=None):
         self.calls.append((list(prompt),
                            None if prefix_ids is None else list(prefix_ids)))
         return GenerationResult()
@@ -470,7 +471,8 @@ class TestAffinityPlacement:
                 self.handoffs = []
 
             def submit_disagg(self, pre, prompt, max_new,
-                              deadline_ms=None, klass="interactive"):
+                              deadline_ms=None, klass="interactive",
+                              request_id=None):
                 self.handoffs.append(list(prompt))
                 return GenerationResult()
 

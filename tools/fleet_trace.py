@@ -1,0 +1,187 @@
+#!/usr/bin/env python
+"""Merge per-process event streams into ONE clock-aligned Chrome trace.
+
+Every traced process (router, prefill/decode workers — see
+``serving.tracing.maybe_enable_process``) appends spans to its OWN
+``events.jsonl``, stamped on its OWN trace clock (µs since telemetry
+init — ``telemetry.clock_us``). Those clocks share no origin, so the
+raw streams cannot be overlaid. The router, however, records
+``trace.clock_offset`` instants — one per ping/telemetry probe, each
+carrying the probed worker's pid, the midpoint offset estimate and the
+probe RTT. This tool:
+
+1. discovers every ``events.jsonl`` under the trace root,
+2. picks the stream containing the ``trace.clock_offset`` instants as
+   the REFERENCE timeline (the router's),
+3. per peer pid keeps the minimum-RTT probe (NTP's selection rule:
+   the midpoint estimator's error is bounded by RTT/2), and
+4. shifts every other stream onto the reference clock
+   (``ts' = ts + offset``), emitting one Chrome-trace JSON with a
+   ``process_name`` metadata record per process.
+
+Spans tagged with a ``request_id`` (the distributed-tracing id minted
+at ``Router.submit``) line up across processes: one request renders as
+queue → handoff → prefill → kv_push → adopt/decode → request, each
+segment in the process that actually ran it.
+
+Usage:
+  python tools/fleet_trace.py <trace-root> [-o fleet_trace.json]
+  python tools/fleet_trace.py <trace-root> --request 1f2e3d4c5b6a7988
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+__all__ = ["discover_streams", "load_stream", "offsets_from_events",
+           "merge_streams", "main"]
+
+
+def discover_streams(root):
+    """Every ``events.jsonl`` under ``root`` (root itself included),
+    sorted for determinism. Returns ``[(label, path)]`` where the label
+    is the stream's directory name (``<name>_<pid>``)."""
+    out = []
+    direct = os.path.join(root, "events.jsonl")
+    if os.path.exists(direct):
+        out.append((os.path.basename(os.path.normpath(root)), direct))
+    for path in sorted(glob.glob(os.path.join(root, "*", "events.jsonl"))):
+        out.append((os.path.basename(os.path.dirname(path)), path))
+    return out
+
+
+def load_stream(path):
+    """Parsed JSONL records, torn trailing lines skipped (the stream is
+    append-only and a SIGKILL'd worker may die mid-write — surviving
+    whole lines are exactly what the chaos tests assert on)."""
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                pass
+    return events
+
+
+def offsets_from_events(events):
+    """Min-RTT clock offset per peer pid from a reference stream's
+    ``trace.clock_offset`` instants. Returns
+    ``{peer_pid: (offset_us, rtt_us, replica)}`` with
+    ``peer_ts + offset ≈ reference_ts``."""
+    best = {}
+    for e in events:
+        if e.get("name") != "trace.clock_offset" or e.get("ph") != "i":
+            continue
+        a = e.get("args") or {}
+        pid, off, rtt = a.get("peer_pid"), a.get("offset_us"), \
+            a.get("rtt_us")
+        if pid is None or off is None or rtt is None:
+            continue
+        if pid not in best or rtt < best[pid][1]:
+            best[pid] = (float(off), float(rtt), a.get("replica"))
+    return best
+
+
+def _pick_reference(streams):
+    """The stream holding the most ``trace.clock_offset`` instants is
+    the reference timeline (the router probes everyone; workers probe
+    nobody). Returns its index, or None when no stream has any."""
+    ref, ref_n = None, 0
+    for i, (_, events) in enumerate(streams):
+        n = sum(1 for e in events
+                if e.get("name") == "trace.clock_offset")
+        if n > ref_n:
+            ref, ref_n = i, n
+    return ref
+
+
+def merge_streams(streams, request_id=None):
+    """``streams`` is ``[(label, events)]``. Returns
+    ``(trace_events, report)`` — the merged, clock-shifted Chrome event
+    list plus a dict describing the alignment (reference stream, per-pid
+    offsets, unaligned pids)."""
+    ref = _pick_reference(streams)
+    offsets = offsets_from_events(streams[ref][1]) if ref is not None \
+        else {}
+    merged = []
+    names = {}  # pid -> label, for the metadata records
+    unaligned = set()
+    for i, (label, events) in enumerate(streams):
+        for e in events:
+            pid = e.get("pid")
+            if pid is not None:
+                names.setdefault(pid, label)
+            shift = 0.0
+            if i != ref:
+                got = offsets.get(pid)
+                if got is not None:
+                    shift = got[0]
+                elif pid is not None:
+                    unaligned.add(pid)
+            if request_id is not None:
+                rid = (e.get("args") or {}).get("request_id")
+                if rid != request_id:
+                    continue
+            ce = dict(e)
+            if "ts" in ce:
+                ce["ts"] = float(ce["ts"]) + shift
+            merged.append(ce)
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    meta = [{"name": "process_name", "ph": "M", "pid": pid,
+             "args": {"name": label}}
+            for pid, label in sorted(names.items())]
+    report = {
+        "reference": streams[ref][0] if ref is not None else None,
+        "offsets": {str(pid): {"offset_us": off, "rtt_us": rtt,
+                               "replica": rep}
+                    for pid, (off, rtt, rep) in sorted(offsets.items())},
+        "unaligned_pids": sorted(unaligned),
+        "streams": [label for label, _ in streams],
+        "events": len(merged),
+    }
+    return meta + merged, report
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("root", help="trace root directory (MXTPU_TRACE_DIR)")
+    ap.add_argument("-o", "--out", default=None,
+                    help="output path (default <root>/fleet_trace.json)")
+    ap.add_argument("--request", default=None, metavar="RID",
+                    help="keep only events tagged with this request_id")
+    args = ap.parse_args(argv)
+
+    found = discover_streams(args.root)
+    if not found:
+        print(f"no events.jsonl under {args.root}", file=sys.stderr)
+        return 1
+    streams = [(label, load_stream(path)) for label, path in found]
+    events, report = merge_streams(streams, request_id=args.request)
+    out = args.out or os.path.join(args.root, "fleet_trace.json")
+    with open(out, "w") as f:
+        json.dump({"traceEvents": events, "displayTimeUnit": "ms",
+                   "otherData": report}, f)
+    print(f"{out}: {report['events']} events from "
+          f"{len(streams)} stream(s); reference={report['reference']}")
+    for pid, o in report["offsets"].items():
+        print(f"  pid {pid} ({o['replica']}): offset "
+              f"{o['offset_us'] / 1e3:+.3f} ms, rtt {o['rtt_us']:.0f} µs")
+    if report["unaligned_pids"]:
+        print(f"  WARNING: no clock samples for pid(s) "
+              f"{report['unaligned_pids']} — their timestamps are "
+              "unshifted", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

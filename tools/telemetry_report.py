@@ -40,6 +40,7 @@ KNOWN_METRIC_FAMILIES = {
     "device": "Host-side training",
     "watchdog": "Host-side training",
     "jax": "Compile (shape stability)",
+    "fleet": "Fleet observability",
 }
 
 # Span/instant families (Chrome-trace names are dotted); spans aggregate
@@ -47,7 +48,7 @@ KNOWN_METRIC_FAMILIES = {
 # surface the consistency pass checks, not a formatting choice.
 KNOWN_SPAN_FAMILIES = {
     "checkpoint", "dataloader", "disagg", "estimator", "imperative",
-    "infer", "input", "kvstore", "launch", "serve", "trainer",
+    "infer", "input", "kvstore", "launch", "serve", "trace", "trainer",
     "trainstep", "transport", "watchdog",
 }
 
@@ -454,6 +455,43 @@ def _print_shard_family(report_path):
               f"({total / 1e6:.1f} MB -> {per / 1e6:.1f} MB/device)")
 
 
+def _print_fleet_family(report_path):
+    """Surface the ``fleet/`` metric family (the telemetry scrape loop:
+    scrapes completed, scrape errors, replicas seen, per-request SLO
+    burn) from a ``report.json`` snapshot."""
+    if not os.path.exists(report_path):
+        return
+    try:
+        with open(report_path) as f:
+            report = json.load(f)
+    except ValueError:
+        return
+    counters = {k: v for k, v in report.get("counters", {}).items()
+                if k.startswith("fleet/")
+                or k.startswith("serve/slo_burn_")}
+    gauges = {k: v for k, v in report.get("gauges", {}).items()
+              if k.startswith("fleet/")}
+    if not counters and not gauges:
+        return
+    print("\n== Fleet observability ==")
+    for k in sorted(gauges):
+        print(f"  {k:<38} {gauges[k]}")
+    for k in sorted(counters):
+        print(f"  {k:<38} {counters[k]}")
+    errors = counters.get("fleet/scrape_errors", 0)
+    scrapes = counters.get("fleet/scrapes", 0)
+    if errors and errors >= max(scrapes, 1):
+        print(f"  WARNING: {errors} scrape error(s) vs {scrapes} "
+              "completed scrape(s) — workers are unreachable from the "
+              "telemetry loop (check transport health)")
+    burn = sum(v for k, v in counters.items()
+               if k.startswith("serve/slo_burn_"))
+    if burn:
+        print(f"  WARNING: {burn} request(s) finished past their class "
+              "SLO — inspect per-request phase breakdowns "
+              "(GenerationResult.phases) to attribute the overrun")
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(
         description=__doc__,
@@ -501,6 +539,7 @@ def main(argv=None):
         _print_serve_family(os.path.join(directory, "report.json"))
         _print_transport_family(os.path.join(directory, "report.json"))
         _print_disagg_family(os.path.join(directory, "report.json"))
+        _print_fleet_family(os.path.join(directory, "report.json"))
     return 0
 
 
